@@ -1,0 +1,15 @@
+"""petastorm_tpu: a TPU-native Parquet data-access framework for ML training.
+
+Brand-new implementation of the capabilities of petastorm
+(github.com/WeichenXu123/petastorm, surveyed in /root/repo/SURVEY.md), designed
+for JAX/XLA on TPU pods: each TPU-VM host reads a disjoint row-group shard
+(``cur_shard=jax.process_index()``), decodes on host CPUs in a worker pool,
+and collates batches into mesh-sharded ``jax.Array`` with double-buffered
+host->HBM staging (see ``petastorm_tpu.jax_loader``).
+"""
+
+__version__ = '0.1.0'
+
+from petastorm_tpu.reader import Reader, make_batch_reader, make_reader  # noqa: F401
+from petastorm_tpu.transform import TransformSpec  # noqa: F401
+from petastorm_tpu.unischema import Unischema, UnischemaField  # noqa: F401

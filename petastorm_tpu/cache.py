@@ -1,0 +1,144 @@
+"""Row-group caches.
+
+Parity: reference ``petastorm/cache.py`` (``CacheBase.get(key, fill_fn)``,
+``NullCache``) and ``petastorm/local_disk_cache.py`` /
+``local_disk_arrow_table_cache.py``.
+
+The reference uses the ``diskcache`` package (SQLite-backed FanoutCache).
+That package is not a TPU-VM given, so ``LocalDiskCache`` here is a small
+self-contained file-per-key cache designed for the local NVMe of a TPU-VM
+host: hashed filenames, atomic renames for crash safety, and lazy size-based
+LRU eviction.
+"""
+
+import hashlib
+import os
+import pickle
+import tempfile
+import threading
+
+import pyarrow as pa
+
+
+class CacheBase(object):
+    def get(self, key, fill_cache_func):
+        """Return the cached value for ``key``; on miss call ``fill_cache_func``
+        and store its result."""
+        raise NotImplementedError
+
+    def cleanup(self):
+        pass
+
+
+class NullCache(CacheBase):
+    """No-op cache: always calls the fill function."""
+
+    def get(self, key, fill_cache_func):
+        return fill_cache_func()
+
+
+class LocalDiskCache(CacheBase):
+    """File-per-key disk cache with size-limited LRU eviction.
+
+    :param path: cache directory (created if missing).
+    :param size_limit: approximate maximum total bytes; ``None`` = unlimited.
+    :param expected_row_size_bytes: accepted for reference-API parity
+        (``local_disk_cache.py:22``); unused by this implementation.
+    :param cleanup: if True, remove the whole cache dir on ``cleanup()``.
+    """
+
+    _SUFFIX = '.pkl'
+
+    def __init__(self, path, size_limit=None, expected_row_size_bytes=None,
+                 shards=None, cleanup=False, **_):
+        self._path = path
+        self._size_limit = size_limit
+        self._cleanup = cleanup
+        self._lock = threading.Lock()
+        os.makedirs(path, exist_ok=True)
+
+    def _key_path(self, key):
+        digest = hashlib.md5(str(key).encode('utf-8')).hexdigest()
+        return os.path.join(self._path, digest + self._SUFFIX)
+
+    def _serialize(self, value):
+        return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def _deserialize(self, blob):
+        return pickle.loads(blob)
+
+    def get(self, key, fill_cache_func):
+        target = self._key_path(key)
+        try:
+            with open(target, 'rb') as f:
+                blob = f.read()
+            os.utime(target, None)  # LRU touch
+            return self._deserialize(blob)
+        except (FileNotFoundError, EOFError, pickle.UnpicklingError):
+            pass
+        value = fill_cache_func()
+        blob = self._serialize(value)
+        fd, tmp = tempfile.mkstemp(dir=self._path, suffix='.tmp')
+        try:
+            with os.fdopen(fd, 'wb') as f:
+                f.write(blob)
+            os.replace(tmp, target)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        self._maybe_evict()
+        return value
+
+    def _maybe_evict(self):
+        if self._size_limit is None:
+            return
+        with self._lock:
+            entries = []
+            total = 0
+            for name in os.listdir(self._path):
+                if not name.endswith(self._SUFFIX):
+                    continue
+                full = os.path.join(self._path, name)
+                try:
+                    st = os.stat(full)
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, st.st_size, full))
+                total += st.st_size
+            if total <= self._size_limit:
+                return
+            entries.sort()  # oldest first
+            for _, size, full in entries:
+                try:
+                    os.unlink(full)
+                except OSError:
+                    continue
+                total -= size
+                if total <= self._size_limit:
+                    break
+
+    def cleanup(self):
+        if not self._cleanup:
+            return
+        import shutil
+        shutil.rmtree(self._path, ignore_errors=True)
+
+
+class LocalDiskArrowTableCache(LocalDiskCache):
+    """Disk cache specialized for ``pyarrow.Table`` values.
+
+    Serializes via the Arrow IPC stream format (zero pickle), matching the
+    role of reference ``local_disk_arrow_table_cache.py:20-40``.
+    """
+
+    def _serialize(self, table):
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as writer:
+            writer.write_table(table)
+        return sink.getvalue().to_pybytes()
+
+    def _deserialize(self, blob):
+        with pa.ipc.open_stream(pa.BufferReader(blob)) as reader:
+            return reader.read_all()

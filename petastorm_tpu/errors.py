@@ -1,0 +1,27 @@
+"""Exception types for petastorm_tpu.
+
+Parity: reference ``petastorm/errors.py`` (NoDataAvailableError) plus decode
+errors from ``petastorm/utils.py:50``.
+"""
+
+
+class PetastormTpuError(Exception):
+    """Base class for all petastorm_tpu errors."""
+
+
+class NoDataAvailableError(PetastormTpuError):
+    """Raised when sharding/filtering leaves a reader with no row-groups.
+
+    Parity: reference ``petastorm/errors.py:16`` raised at ``reader.py:495-497``.
+    """
+
+
+class DecodeFieldError(PetastormTpuError):
+    """Raised when a field value cannot be decoded by its codec.
+
+    Parity: reference ``petastorm/utils.py:50``.
+    """
+
+
+class SchemaError(PetastormTpuError):
+    """Raised for schema definition / inference problems."""

@@ -1,0 +1,37 @@
+"""ETL: dataset write path, metadata generation, row-group indexing.
+
+Parity: reference ``petastorm/etl/`` — ``materialize_dataset``
+(``etl/dataset_metadata.py:52``), row-group listing/indexing, metadata CLIs.
+"""
+
+from petastorm_tpu.etl.dataset_metadata import (PetastormMetadataError,  # noqa: F401
+                                                get_schema,
+                                                get_schema_from_dataset_url,
+                                                infer_or_load_unischema,
+                                                materialize_dataset)
+from petastorm_tpu.etl.writer import DatasetWriter, write_dataset  # noqa: F401
+
+
+class RowGroupIndexerBase(object):
+    """ABC for a row-group index builder.
+
+    Parity: reference ``petastorm/etl/__init__.py:21-50``.
+    """
+
+    @property
+    def index_name(self):
+        raise NotImplementedError
+
+    @property
+    def column_names(self):
+        raise NotImplementedError
+
+    @property
+    def indexed_values(self):
+        raise NotImplementedError
+
+    def get_row_group_indexes(self, value_key):
+        raise NotImplementedError
+
+    def build_index(self, decoded_rows, piece_index):
+        raise NotImplementedError

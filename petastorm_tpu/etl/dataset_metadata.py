@@ -1,0 +1,121 @@
+"""Dataset metadata: schema load/infer, materialization context managers.
+
+Parity: reference ``petastorm/etl/dataset_metadata.py`` — ``materialize_dataset``
+(``:52-132``), ``get_schema`` (``:339-368``), ``get_schema_from_dataset_url``
+(``:371-386``), ``infer_or_load_unischema`` (``:389-397``).
+
+The schema is stored as JSON under ``petastorm_tpu.unischema.v1`` in
+``_common_metadata`` (the reference pickles it — ``:189-190``; JSON is
+version/package-rename safe).
+"""
+
+import json
+import logging
+from contextlib import contextmanager
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.storage import UNISCHEMA_KEY, ParquetStore
+from petastorm_tpu.unischema import Unischema
+
+logger = logging.getLogger(__name__)
+
+
+class PetastormMetadataError(PetastormTpuError):
+    """Dataset lacks petastorm_tpu metadata (not a materialized store)."""
+
+
+class PetastormMetadataGenerationError(PetastormTpuError):
+    pass
+
+
+def get_schema(store):
+    """Load the Unischema stored in ``_common_metadata``; raise if absent."""
+    blob = store.common_metadata_value(UNISCHEMA_KEY)
+    if blob is None:
+        if not store.fs.exists(store.path):
+            raise IOError('Dataset path does not exist: {}'.format(store.url))
+        raise PetastormMetadataError(
+            'Dataset at {} has no petastorm_tpu schema metadata. Either materialize it '
+            'with DatasetWriter/materialize_dataset, regenerate metadata with '
+            'petastorm-tpu-generate-metadata, or read it with make_batch_reader '
+            '(schema inference).'.format(store.url))
+    return Unischema.from_json(json.loads(blob.decode('utf-8')))
+
+
+def get_schema_from_dataset_url(dataset_url, storage_options=None):
+    """Parity: reference ``etl/dataset_metadata.py:371-386``."""
+    return get_schema(ParquetStore(dataset_url, storage_options))
+
+
+def infer_or_load_unischema(store, omit_unsupported_fields=True):
+    """Stored schema if present, else inference from the Arrow schema.
+
+    Parity: reference ``etl/dataset_metadata.py:389-397``.
+    """
+    try:
+        return get_schema(store)
+    except PetastormMetadataError:
+        logger.debug('Dataset %s has no stored unischema; inferring from Arrow schema', store.url)
+        arrow_schema = store.read_arrow_schema()
+        partition_names = store.partition_names
+        return Unischema.from_arrow_schema(arrow_schema, partition_columns=partition_names,
+                                           omit_unsupported_fields=omit_unsupported_fields)
+
+
+@contextmanager
+def materialize_dataset(spark_or_url, dataset_url_or_schema=None, schema=None,
+                        row_group_size_mb=None, storage_options=None,
+                        rows_per_row_group=None, partition_fields=()):
+    """Materialization context manager, in two flavors:
+
+    **TPU-native (no Spark)** — yields a :class:`DatasetWriter`::
+
+        with materialize_dataset('file:///tmp/ds', schema, row_group_size_mb=32) as w:
+            w.write({'id': 0, 'image': ...})
+
+    **Spark-compat** (parity: reference ``etl/dataset_metadata.py:52-132``) —
+    pass a SparkSession first; inside the body run your own
+    ``df.write.parquet(url)``; on exit the petastorm_tpu metadata is generated
+    over whatever Spark wrote::
+
+        with materialize_dataset(spark, 'file:///tmp/ds', schema):
+            spark.createDataFrame(rows).write.parquet('file:///tmp/ds')
+    """
+    from petastorm_tpu.etl.writer import DatasetWriter, finalize_dataset_metadata
+
+    is_spark = not isinstance(spark_or_url, str)
+    if is_spark:
+        spark = spark_or_url
+        dataset_url = dataset_url_or_schema
+        if schema is None:
+            raise ValueError('materialize_dataset(spark, url, schema) requires a schema')
+        _configure_spark_row_group_size(spark, row_group_size_mb)
+        yield None
+        store = ParquetStore(dataset_url, storage_options)
+        finalize_dataset_metadata(store, schema, metadata_collector=None,
+                                  partition_fields=partition_fields)
+    else:
+        dataset_url = spark_or_url
+        the_schema = dataset_url_or_schema if schema is None else schema
+        if the_schema is None:
+            raise ValueError('materialize_dataset(url, schema) requires a schema')
+        writer = DatasetWriter(dataset_url, the_schema,
+                               row_group_size_mb=row_group_size_mb,
+                               rows_per_row_group=rows_per_row_group,
+                               partition_fields=partition_fields,
+                               storage_options=storage_options)
+        # Finalize metadata only on success: a partially-written store must not
+        # be blessed as complete (matches DatasetWriter.__exit__ semantics).
+        yield writer
+        writer.close()
+
+
+def _configure_spark_row_group_size(spark, row_group_size_mb):
+    """Best-effort Hadoop parquet.block.size config (reference ``:135-166``)."""
+    if row_group_size_mb is None:
+        return
+    try:
+        hadoop_conf = spark.sparkContext._jsc.hadoopConfiguration()
+        hadoop_conf.setInt('parquet.block.size', row_group_size_mb * 1024 * 1024)
+    except Exception:  # pragma: no cover - depends on JVM internals
+        logger.warning('Could not set parquet.block.size on the Spark session')

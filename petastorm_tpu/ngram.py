@@ -1,0 +1,130 @@
+"""NGram: sliding time-window readout over rows within a row-group.
+
+Parity: reference ``petastorm/ngram.py`` — per-offset field selection
+(``ngram.py:102-160``), ``delta_threshold`` continuity rule between
+consecutive timestamps (``:179-193``), regex field resolution (``:195-203``),
+per-timestep schema views (``:215-223``), window formation inside the worker
+per row-group (``:225-270``), and ``timestamp_overlap`` stride control
+(``:248-253``). Windows never cross row-group boundaries (``:85-91``).
+
+TPU note (SURVEY.md §5.7): the window output is a dict ``offset -> row``;
+``jax_loader`` can stack the per-offset fields into a leading ``[window]``
+axis for static-shape XLA consumption.
+"""
+
+from petastorm_tpu.unischema import UnischemaField, match_unischema_fields
+
+
+class NGram(object):
+    def __init__(self, fields, delta_threshold, timestamp_field, timestamp_overlap=True):
+        """
+        :param fields: dict ``{offset: [UnischemaField or regex str, ...]}``.
+        :param delta_threshold: max allowed gap between *consecutive* row
+            timestamps inside one window.
+        :param timestamp_field: UnischemaField (or name) used for ordering.
+        :param timestamp_overlap: if False, consecutive windows do not share
+            rows (stride = window length instead of 1).
+        """
+        if not isinstance(fields, dict) or not fields:
+            raise ValueError('fields must be a non-empty dict of offset -> field list')
+        for key, value in fields.items():
+            if not isinstance(key, int):
+                raise ValueError('NGram offsets must be ints, got {!r}'.format(key))
+            if not isinstance(value, (list, tuple)):
+                raise ValueError('NGram field lists must be lists, got {!r}'.format(value))
+        self._fields = {k: list(v) for k, v in fields.items()}
+        self._delta_threshold = delta_threshold
+        self._timestamp_field = timestamp_field
+        self.timestamp_overlap = timestamp_overlap
+        self._resolved = all(
+            isinstance(f, UnischemaField) for v in self._fields.values() for f in v)
+
+    @property
+    def fields(self):
+        return self._fields
+
+    @property
+    def delta_threshold(self):
+        return self._delta_threshold
+
+    @property
+    def length(self):
+        offsets = sorted(self._fields)
+        return offsets[-1] - offsets[0] + 1
+
+    @property
+    def timestamp_field_name(self):
+        if isinstance(self._timestamp_field, UnischemaField):
+            return self._timestamp_field.name
+        return self._timestamp_field
+
+    # --- resolution -------------------------------------------------------
+
+    def resolve_regex_field_names(self, schema):
+        """Replace regex strings with concrete fields (reference ``:195-203``)."""
+        if self._resolved:
+            return
+        for offset, field_list in self._fields.items():
+            self._fields[offset] = match_unischema_fields(schema, field_list,
+                                                          allow_empty_match=False)
+        self._resolved = True
+
+    def get_field_names_at_timestep(self, timestep):
+        if timestep not in self._fields:
+            return []
+        return sorted(f.name if isinstance(f, UnischemaField) else f
+                      for f in self._fields[timestep])
+
+    def get_field_names_at_all_timesteps(self):
+        names = {self.timestamp_field_name}
+        for offset in self._fields:
+            names.update(self.get_field_names_at_timestep(offset))
+        return sorted(names)
+
+    def get_schema_at_timestep(self, schema, timestep):
+        """Schema view of the fields requested at one window offset."""
+        names = [n for n in self.get_field_names_at_timestep(timestep)
+                 if n in schema.fields]
+        return schema.create_schema_view(names)
+
+    # --- window formation -------------------------------------------------
+
+    def form_ngram(self, data, schema):
+        """rows (list of dicts) -> list of ``{offset: row-dict}`` windows.
+
+        Rows are sorted by the timestamp field; a window is emitted only when
+        every consecutive timestamp gap is <= ``delta_threshold``.
+        Parity: reference ``ngram.py:225-270``.
+        """
+        ts_name = self.timestamp_field_name
+        rows = sorted(data, key=lambda r: r[ts_name])
+        offsets = sorted(self._fields)
+        base = offsets[0]
+        length = self.length
+        windows = []
+        i = 0
+        n = len(rows)
+        while i + length <= n:
+            window_rows = rows[i:i + length]
+            if self._delta_threshold is not None and not self._is_continuous(window_rows, ts_name):
+                i += 1
+                continue
+            window = {}
+            for offset in offsets:
+                source = window_rows[offset - base]
+                wanted = self.get_field_names_at_timestep(offset)
+                window[offset] = {k: v for k, v in source.items() if k in wanted}
+            windows.append(window)
+            i += length if not self.timestamp_overlap else 1
+        return windows
+
+    def _is_continuous(self, window_rows, ts_name):
+        for prev, cur in zip(window_rows, window_rows[1:]):
+            if cur[ts_name] - prev[ts_name] > self._delta_threshold:
+                return False
+        return True
+
+    def make_namedtuple(self, schema, window):
+        """Convert a window of plain dicts to per-offset namedtuples."""
+        return {offset: self.get_schema_at_timestep(schema, offset).make_namedtuple(**fields)
+                for offset, fields in window.items()}

@@ -1,0 +1,127 @@
+"""Row predicates evaluated inside reader workers.
+
+Parity: reference ``petastorm/predicates.py`` — a small combinator library of
+predicates with ``get_fields()`` (columns the predicate needs, enabling the
+two-phase predicate read at ``py_dict_reader_worker.py:188-252``) and
+``do_include(values)``.
+"""
+
+import hashlib
+
+import numpy as np
+
+
+class PredicateBase(object):
+    """Predicate interface: which fields it needs, and the row test."""
+
+    def get_fields(self):
+        raise NotImplementedError
+
+    def do_include(self, values):
+        """``values``: dict of field name -> value for fields in get_fields()."""
+        raise NotImplementedError
+
+
+class in_set(PredicateBase):
+    """Include rows whose field value is in a given set."""
+
+    def __init__(self, values, predicate_field):
+        self._values = set(values)
+        self._field = predicate_field
+
+    def get_fields(self):
+        return {self._field}
+
+    def do_include(self, values):
+        return values[self._field] in self._values
+
+
+class in_intersection(PredicateBase):
+    def __init__(self, values, predicate_field):
+        self._values = set(values)
+        self._field = predicate_field
+
+    def get_fields(self):
+        return {self._field}
+
+    def do_include(self, values):
+        return bool(self._values.intersection(values[self._field]))
+
+
+class in_negate(PredicateBase):
+    """Logical NOT of another predicate."""
+
+    def __init__(self, predicate):
+        self._predicate = predicate
+
+    def get_fields(self):
+        return self._predicate.get_fields()
+
+    def do_include(self, values):
+        return not self._predicate.do_include(values)
+
+
+class in_reduce(PredicateBase):
+    """Reduce multiple predicates with e.g. ``all`` or ``any``."""
+
+    def __init__(self, predicate_list, reduce_func):
+        self._predicates = list(predicate_list)
+        self._reduce = reduce_func
+
+    def get_fields(self):
+        fields = set()
+        for p in self._predicates:
+            fields |= set(p.get_fields())
+        return fields
+
+    def do_include(self, values):
+        return self._reduce([p.do_include(values) for p in self._predicates])
+
+
+class in_lambda(PredicateBase):
+    """Arbitrary user lambda over a declared set of fields."""
+
+    def __init__(self, fields, func, state_arg=None):
+        self._fields = set(fields)
+        self._func = func
+        self._state = state_arg
+
+    def get_fields(self):
+        return self._fields
+
+    def do_include(self, values):
+        if self._state is not None:
+            return self._func(values, self._state)
+        return self._func(values)
+
+
+def _stable_hash_fraction(value, num_buckets):
+    digest = hashlib.md5(str(value).encode('utf-8')).hexdigest()
+    return int(digest, 16) % num_buckets
+
+
+class in_pseudorandom_split(PredicateBase):
+    """Deterministic train/val/test split on a hash of a key field.
+
+    Parity: reference ``petastorm/predicates.py`` ``in_pseudorandom_split`` —
+    fraction list selects which bucket range is included.
+    """
+
+    _BUCKETS = 10000
+
+    def __init__(self, fraction_list, subset_index, predicate_field):
+        if not np.isclose(sum(fraction_list), 1.0) and sum(fraction_list) > 1.0:
+            raise ValueError('fractions must sum to <= 1.0')
+        self._fractions = list(fraction_list)
+        self._index = subset_index
+        self._field = predicate_field
+        bounds = np.cumsum([0.0] + self._fractions)
+        self._low = int(bounds[subset_index] * self._BUCKETS)
+        self._high = int(bounds[subset_index + 1] * self._BUCKETS)
+
+    def get_fields(self):
+        return {self._field}
+
+    def do_include(self, values):
+        bucket = _stable_hash_fraction(values[self._field], self._BUCKETS)
+        return self._low <= bucket < self._high

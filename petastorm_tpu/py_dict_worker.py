@@ -1,0 +1,157 @@
+"""Per-row row-group worker: Parquet read -> codec decode -> transform -> rows.
+
+Parity: reference ``petastorm/py_dict_reader_worker.py`` — one row-group per
+``process()`` call, cached loads (``:160``), two-phase predicate read
+(predicate columns first, early exit, then the rest — ``:188-252``), row-drop
+partitioning with ngram tail extension (``:254-274``), per-row TransformSpec
+(``:38-52``), ngram window formation (``:165-166``), and the paired results
+queue reader that buffers a chunk and pops single rows (``:64-97``).
+"""
+
+import hashlib
+
+from petastorm_tpu.unischema import decode_row
+from petastorm_tpu.workers.rowgroup_worker_base import (RowGroupWorkerBase,
+                                                        compute_row_slice)
+
+
+class PyDictWorker(RowGroupWorkerBase):
+    """Worker args (dict):
+      store_factory: picklable zero-arg -> ParquetStore
+      schema: Unischema view of fields to read+decode
+      full_schema: stored dataset Unischema
+      ngram: NGram or None
+      row_groups: list[RowGroupPiece]
+      cache: CacheBase
+      transform_spec: TransformSpec or None
+      transformed_schema: post-transform Unischema (for output filtering)
+      partition_names: list of hive partition column names
+      dataset_path_hash: stable dataset identity for cache keys
+    """
+
+    def process(self, piece_index, worker_predicate=None, shuffle_row_drop_partition=None):
+        piece = self.args['row_groups'][piece_index]
+        schema = self.args['schema']
+        ngram = self.args['ngram']
+
+        if worker_predicate is not None:
+            rows = self._load_rows_with_predicate(piece, worker_predicate)
+        else:
+            rows = self._load_rows_cached(piece)
+
+        row_slice = compute_row_slice(len(rows), shuffle_row_drop_partition, ngram)
+        if row_slice is not None:
+            rows = rows[row_slice[0]:row_slice[1]]
+
+        transform_spec = self.args.get('transform_spec')
+        if transform_spec is not None and transform_spec.func is not None and ngram is None:
+            rows = [self._apply_transform(row, transform_spec) for row in rows]
+
+        if ngram is not None:
+            rows = ngram.form_ngram(rows, schema)
+            if transform_spec is not None and transform_spec.func is not None:
+                rows = [{offset: self._apply_transform(r, transform_spec)
+                         for offset, r in window.items()} for window in rows]
+
+        if rows:
+            self.publish_func(rows)
+
+    def _apply_transform(self, row, transform_spec):
+        out = transform_spec.func(row)
+        for name in transform_spec.removed_fields:
+            out.pop(name, None)
+        return out
+
+    # --- loading ------------------------------------------------------
+
+    def _columns_to_read(self, field_names):
+        partition_names = set(self.args['partition_names'])
+        return [n for n in field_names if n not in partition_names]
+
+    def _read_columns(self, piece, column_names):
+        pf = self._parquet_file(piece.path)
+        physical = self._columns_to_read(column_names)
+        table = pf.read_row_group(piece.row_group, columns=physical)
+        encoded_rows = table.to_pylist()
+        for row in encoded_rows:
+            for name, value in piece.partition_values.items():
+                if name in column_names:
+                    row[name] = value
+        return encoded_rows
+
+    def _load_rows_cached(self, piece):
+        schema = self.args['schema']
+        if self.args['ngram'] is not None:
+            field_names = sorted(self.args['ngram'].get_field_names_at_all_timesteps())
+        else:
+            field_names = list(schema.fields)
+        cache_key = '{}:{}:{}:{}'.format(
+            self.args['dataset_path_hash'], piece.path, piece.row_group,
+            hashlib.md5(','.join(field_names).encode()).hexdigest()[:8])
+
+        def load():
+            encoded_rows = self._read_columns(piece, field_names)
+            decode_schema = (self.args['full_schema'].create_schema_view(
+                [n for n in field_names if n in self.args['full_schema'].fields])
+                if self.args['ngram'] is not None else schema)
+            return [decode_row(row, decode_schema) for row in encoded_rows]
+
+        return self.args['cache'].get(cache_key, load)
+
+    def _load_rows_with_predicate(self, piece, predicate):
+        """Two-phase read: predicate columns -> early exit -> remaining columns.
+
+        Parity: reference ``py_dict_reader_worker.py:188-252``.
+        """
+        schema = self.args['schema']
+        full_schema = self.args['full_schema']
+        predicate_fields = set(predicate.get_fields())
+        unknown = predicate_fields - set(full_schema.fields)
+        if unknown:
+            raise ValueError('Predicate uses unknown fields: {}'.format(sorted(unknown)))
+        other_fields = [n for n in schema.fields if n not in predicate_fields]
+
+        predicate_schema = full_schema.create_schema_view(sorted(predicate_fields))
+        encoded_pred_rows = self._read_columns(piece, sorted(predicate_fields))
+        decoded_pred_rows = [decode_row(row, predicate_schema) for row in encoded_pred_rows]
+        mask = [predicate.do_include(row) for row in decoded_pred_rows]
+        if not any(mask):
+            return []
+
+        if other_fields:
+            other_schema = schema.create_schema_view(other_fields)
+            encoded_other = self._read_columns(piece, other_fields)
+            result = []
+            for include, pred_row, other_row in zip(mask, decoded_pred_rows, encoded_other):
+                if not include:
+                    continue
+                decoded = decode_row(other_row, other_schema)
+                decoded.update({k: v for k, v in pred_row.items() if k in schema.fields})
+                result.append(decoded)
+            return result
+        return [{k: v for k, v in row.items() if k in schema.fields}
+                for row, include in zip(decoded_pred_rows, mask) if include]
+
+class PyDictResultsQueueReader(object):
+    """Consumer-side: buffers a published chunk, pops single rows.
+
+    Parity: reference ``py_dict_reader_worker.py:64-97``.
+    """
+
+    def __init__(self):
+        from collections import deque
+        self._buffer = deque()
+
+    @property
+    def batched_output(self):
+        return False
+
+    def read_next(self, pool, schema, ngram):
+        while not self._buffer:
+            rows = pool.get_results()
+            self._buffer.extend(rows)
+        row = self._buffer.popleft()
+        if ngram is not None:
+            return {offset: ngram.get_schema_at_timestep(schema, offset).make_namedtuple(**fields)
+                    for offset, fields in row.items()}
+        return schema.make_namedtuple(**row)

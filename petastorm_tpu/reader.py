@@ -1,0 +1,359 @@
+"""Reader core: ``make_reader`` / ``make_batch_reader`` / ``Reader``.
+
+Parity: reference ``petastorm/reader.py`` — factory validation & wiring
+(``reader.py:50-289``), row-group filtering by predicate-on-partition /
+selector index / shard (``:446-556``), seeded epoch ventilation (``:570-585``),
+``reset()`` (``:416-440``), context-manager stop/join (``:618-624``), and the
+``index % shard_count == cur_shard`` data-parallel sharding rule (``:501``)
+keyed on TPU pods by ``jax.process_index()/jax.process_count()``.
+"""
+
+import hashlib
+import logging
+import warnings
+
+from petastorm_tpu.arrow_worker import ArrowResultsQueueReader, ArrowWorker
+from petastorm_tpu.cache import LocalDiskArrowTableCache, LocalDiskCache, NullCache
+from petastorm_tpu.errors import NoDataAvailableError
+from petastorm_tpu.etl.dataset_metadata import (PetastormMetadataError,
+                                                get_schema,
+                                                infer_or_load_unischema)
+from petastorm_tpu.py_dict_worker import PyDictResultsQueueReader, PyDictWorker
+from petastorm_tpu.storage import ROWGROUP_INDEX_KEY, ParquetStore
+from petastorm_tpu.transform import transform_schema
+from petastorm_tpu.unischema import match_unischema_fields
+from petastorm_tpu.workers import EmptyResultError
+from petastorm_tpu.workers.dummy_pool import DummyPool
+from petastorm_tpu.workers.thread_pool import ThreadPool
+from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+
+logger = logging.getLogger(__name__)
+
+# Extra row-groups to ventilate ahead of the workers (reference reader.py:47)
+_VENTILATE_EXTRA_ROWGROUPS = 2
+
+
+def _make_pool(reader_pool_type, workers_count, results_queue_size, arrow_payloads=False):
+    if reader_pool_type == 'thread':
+        return ThreadPool(workers_count, results_queue_size)
+    if reader_pool_type == 'dummy':
+        return DummyPool()
+    if reader_pool_type == 'process':
+        from petastorm_tpu.workers.process_pool import ProcessPool
+        from petastorm_tpu.workers.serializers import (ArrowTableSerializer,
+                                                       PickleSerializer)
+        serializer = ArrowTableSerializer() if arrow_payloads else PickleSerializer()
+        return ProcessPool(workers_count, results_queue_size, serializer=serializer)
+    raise ValueError('Unknown reader_pool_type {!r}; expected thread|process|dummy'.format(
+        reader_pool_type))
+
+
+def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate,
+                arrow_cache=False, **extra):
+    if cache_type in (None, 'null'):
+        return NullCache()
+    if cache_type == 'local-disk':
+        if cache_location is None:
+            raise ValueError("cache_type='local-disk' requires cache_location")
+        cls = LocalDiskArrowTableCache if arrow_cache else LocalDiskCache
+        return cls(cache_location, size_limit=cache_size_limit,
+                   expected_row_size_bytes=cache_row_size_estimate, **extra)
+    raise ValueError('Unknown cache_type {!r}'.format(cache_type))
+
+
+def make_reader(dataset_url,
+                schema_fields=None,
+                reader_pool_type='thread', workers_count=10,
+                results_queue_size=50,
+                shuffle_row_groups=True, shuffle_row_drop_partitions=1,
+                seed=None,
+                predicate=None,
+                rowgroup_selector=None,
+                num_epochs=1,
+                cur_shard=None, shard_count=None,
+                cache_type='null', cache_location=None, cache_size_limit=None,
+                cache_row_size_estimate=None, cache_extra_settings=None,
+                hdfs_driver=None,
+                transform_spec=None,
+                storage_options=None):
+    """Reader for datasets materialized with petastorm_tpu codecs.
+
+    Parity: reference ``petastorm/reader.py:50-174``. Rejects plain Parquet
+    stores (use :func:`make_batch_reader`) — reference ``reader.py:131-135``.
+    """
+    store = ParquetStore(dataset_url, storage_options)
+    try:
+        stored_schema = get_schema(store)
+    except PetastormMetadataError as e:
+        raise RuntimeError(
+            'Currently make_reader supports reading only petastorm_tpu datasets '
+            '(materialized with DatasetWriter). Use make_batch_reader for plain '
+            'Parquet stores: {}'.format(e))
+
+    from petastorm_tpu.ngram import NGram
+    ngram = None
+    if isinstance(schema_fields, NGram):
+        ngram = schema_fields
+        schema_fields = None
+
+    cache = _make_cache(cache_type, cache_location, cache_size_limit,
+                        cache_row_size_estimate, arrow_cache=False,
+                        **(cache_extra_settings or {}))
+    pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
+    return Reader(store, stored_schema,
+                  schema_fields=schema_fields, ngram=ngram,
+                  worker_class=PyDictWorker,
+                  results_queue_reader=PyDictResultsQueueReader(),
+                  reader_pool=pool,
+                  shuffle_row_groups=shuffle_row_groups,
+                  shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                  seed=seed, predicate=predicate, rowgroup_selector=rowgroup_selector,
+                  num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
+                  cache=cache, transform_spec=transform_spec)
+
+
+def make_batch_reader(dataset_url,
+                      schema_fields=None,
+                      reader_pool_type='thread', workers_count=10,
+                      results_queue_size=50,
+                      shuffle_row_groups=True, shuffle_row_drop_partitions=1,
+                      seed=None,
+                      predicate=None,
+                      rowgroup_selector=None,
+                      num_epochs=1,
+                      cur_shard=None, shard_count=None,
+                      cache_type='null', cache_location=None, cache_size_limit=None,
+                      cache_row_size_estimate=None, cache_extra_settings=None,
+                      transform_spec=None,
+                      storage_options=None):
+    """Columnar batch reader for **any** Parquet store (no codecs needed).
+
+    Parity: reference ``petastorm/reader.py:177-289``. Warns when pointed at a
+    materialized petastorm_tpu store (``reader.py:242-249``).
+    """
+    store = ParquetStore(dataset_url, storage_options)
+    try:
+        get_schema(store)
+        warnings.warn('Dataset at {} is a petastorm_tpu store: consider using '
+                      'make_reader for codec-decoded rows. make_batch_reader will '
+                      'return raw (encoded) columns.'.format(dataset_url))
+    except PetastormMetadataError:
+        pass
+    stored_schema = infer_or_load_unischema(store)
+
+    if schema_fields is not None and not all(isinstance(f, str) for f in schema_fields):
+        raise ValueError('make_batch_reader schema_fields must be field-name strings/regexes')
+
+    cache = _make_cache(cache_type, cache_location, cache_size_limit,
+                        cache_row_size_estimate, arrow_cache=True,
+                        **(cache_extra_settings or {}))
+    pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
+                      arrow_payloads=True)
+    return Reader(store, stored_schema,
+                  schema_fields=schema_fields,
+                  worker_class=ArrowWorker,
+                  results_queue_reader=ArrowResultsQueueReader(),
+                  reader_pool=pool,
+                  shuffle_row_groups=shuffle_row_groups,
+                  shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                  seed=seed, predicate=predicate, rowgroup_selector=rowgroup_selector,
+                  num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
+                  cache=cache, transform_spec=transform_spec)
+
+
+class Reader(object):
+    """Iterates decoded rows (or row-group batches) off a worker pool."""
+
+    def __init__(self, store, stored_schema, schema_fields=None, worker_class=None,
+                 results_queue_reader=None, reader_pool=None,
+                 shuffle_row_groups=True, shuffle_row_drop_partitions=1,
+                 seed=None, predicate=None, rowgroup_selector=None,
+                 num_epochs=1, cur_shard=None, shard_count=None,
+                 cache=None, transform_spec=None, ngram=None):
+        self._store = store
+        self.stored_schema = stored_schema
+        self.ngram = ngram
+        if ngram is not None and not ngram.timestamp_overlap and shuffle_row_drop_partitions > 1:
+            raise NotImplementedError('shuffle_row_drop_partitions with non-overlapping ngrams '
+                                      'is not supported')
+
+        if ngram is not None:
+            ngram.resolve_regex_field_names(stored_schema)
+            field_names = ngram.get_field_names_at_all_timesteps()
+            self.schema = stored_schema.create_schema_view(
+                [n for n in field_names if n in stored_schema.fields])
+        elif schema_fields is not None:
+            selected = match_unischema_fields(stored_schema, schema_fields,
+                                              allow_empty_match=False)
+            self.schema = stored_schema.create_schema_view(selected)
+        else:
+            self.schema = stored_schema
+
+        self._transform_spec = transform_spec
+        self._transformed_schema = (transform_schema(self.schema, transform_spec)
+                                    if transform_spec is not None else self.schema)
+
+        if bool(cur_shard is None) != bool(shard_count is None):
+            raise ValueError('cur_shard and shard_count must be specified together')
+        if cur_shard is not None and not 0 <= cur_shard < shard_count:
+            raise ValueError('cur_shard {} out of range [0, {})'.format(cur_shard, shard_count))
+
+        all_pieces = store.row_groups()
+        filtered, worker_predicate = self._filter_row_groups(
+            all_pieces, predicate, rowgroup_selector, cur_shard, shard_count)
+        logger.debug('Reader will read %d of %d row-groups', len(filtered), len(all_pieces))
+        self._row_groups = filtered
+
+        self.last_row_consumed = False
+        self._stopped = False
+        self._results_queue_reader = results_queue_reader
+        self._workers_pool = reader_pool
+
+        worker_args = {
+            'store_factory': _StoreFactory(store.url, store.storage_options),
+            'schema': self.schema,
+            'full_schema': stored_schema,
+            'ngram': ngram,
+            'row_groups': self._row_groups,
+            'cache': cache or NullCache(),
+            'transform_spec': transform_spec,
+            'transformed_schema': self._transformed_schema,
+            'partition_names': store.partition_names,
+            'dataset_path_hash': hashlib.md5(store.url.encode()).hexdigest()[:12],
+        }
+
+        items = []
+        for piece_index in range(len(self._row_groups)):
+            for drop_partition in range(shuffle_row_drop_partitions):
+                items.append({'piece_index': piece_index,
+                              'worker_predicate': worker_predicate,
+                              'shuffle_row_drop_partition': (
+                                  drop_partition, shuffle_row_drop_partitions)})
+
+        self._ventilator = ConcurrentVentilator(
+            ventilate_fn=None,  # bound by pool.start
+            items_to_ventilate=items,
+            iterations=num_epochs,
+            randomize_item_order=shuffle_row_groups,
+            random_seed=seed,
+            max_ventilation_queue_size=self._pool_workers_count() + _VENTILATE_EXTRA_ROWGROUPS)
+        self._workers_pool.start(worker_class, worker_args, ventilator=self._ventilator)
+
+    def _pool_workers_count(self):
+        return getattr(self._workers_pool, 'workers_count', 1)
+
+    # --- filtering --------------------------------------------------------
+
+    def _filter_row_groups(self, pieces, predicate, rowgroup_selector, cur_shard, shard_count):
+        """Predicate-on-partition pruning -> selector index -> shard slice.
+
+        Parity: reference ``reader.py:446-556``.
+        """
+        # Selector first: the stored index maps values to positions in the
+        # original (sorted) row-group list, so it must run before any pruning.
+        if rowgroup_selector is not None:
+            selected = set(self._apply_rowgroup_selector(rowgroup_selector, pieces))
+            pieces = [p for i, p in enumerate(pieces) if i in selected]
+
+        worker_predicate = predicate
+        if predicate is not None:
+            predicate_fields = set(predicate.get_fields())
+            partition_names = set(self._store.partition_names)
+            if predicate_fields and predicate_fields <= partition_names:
+                # Partition-pruning fast path (reference reader.py:535-548).
+                pieces = [p for p in pieces
+                          if predicate.do_include({f: p.partition_values.get(f)
+                                                   for f in predicate_fields})]
+                worker_predicate = None
+
+        if shard_count is not None:
+            pieces = [p for i, p in enumerate(pieces) if i % shard_count == cur_shard]
+            if not pieces:
+                raise NoDataAvailableError(
+                    'No row-groups assigned to shard {} of {}. The dataset has too few '
+                    'row-groups for this shard count.'.format(cur_shard, shard_count))
+
+        if not pieces:
+            raise NoDataAvailableError(
+                'No row-groups left after filtering; cannot create a Reader')
+        return pieces, worker_predicate
+
+    def _apply_rowgroup_selector(self, selector, pieces):
+        """Resolve a selector against the stored row-group index.
+
+        Parity: reference ``reader.py:446-483``.
+        """
+        import json
+        blob = self._store.common_metadata_value(ROWGROUP_INDEX_KEY)
+        if blob is None:
+            raise ValueError('Dataset has no row-group index; run build_rowgroup_index first')
+        indexes = json.loads(blob.decode('utf-8'))
+        index_name = selector.get_index_name()
+        if index_name not in indexes:
+            raise ValueError('Index {!r} not found; available: {}'.format(
+                index_name, sorted(indexes)))
+        return selector.select_row_groups(indexes[index_name])
+
+    # --- iteration --------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stopped:
+            raise RuntimeError('Trying to iterate a stopped Reader')
+        try:
+            row = self._results_queue_reader.read_next(
+                self._workers_pool, self._transformed_schema, self.ngram)
+            return row
+        except EmptyResultError:
+            self.last_row_consumed = True
+            raise StopIteration
+
+    next = __next__
+
+    @property
+    def batched_output(self):
+        return self._results_queue_reader.batched_output
+
+    def reset(self):
+        """Restart the (finished) epoch sequence.
+
+        Parity: reference ``reader.py:416-440`` — only legal once the previous
+        epochs were fully consumed.
+        """
+        if not self.last_row_consumed:
+            raise NotImplementedError(
+                'Currently reset() is supported only after all rows were consumed')
+        self.last_row_consumed = False
+        self._ventilator.reset()
+
+    def stop(self):
+        self._workers_pool.stop()
+        self._stopped = True
+
+    def join(self):
+        self._workers_pool.join()
+
+    @property
+    def diagnostics(self):
+        return self._workers_pool.diagnostics
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        self.join()
+        return False
+
+
+class _StoreFactory(object):
+    """Picklable ParquetStore factory for out-of-process workers."""
+
+    def __init__(self, url, storage_options=None):
+        self._url = url
+        self._storage_options = storage_options
+
+    def __call__(self):
+        return ParquetStore(self._url, self._storage_options)

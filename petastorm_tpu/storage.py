@@ -1,0 +1,275 @@
+"""Parquet store discovery: files, hive partitions, row-group pieces, metadata.
+
+This replaces the reference's reliance on the legacy
+``pyarrow.parquet.ParquetDataset`` pieces API (``reader.py:357``,
+``etl/dataset_metadata.py:231-336``) with a small self-contained layer over
+fsspec + ``pq.ParquetFile``, because modern pyarrow removed the legacy dataset
+pieces. The unit of IO is still the **Parquet row-group**
+(:class:`RowGroupPiece`).
+
+Row-group listing strategies (parity with reference
+``etl/dataset_metadata.py:231-336``):
+  1. ``_metadata`` summary file (one footer read for the whole store);
+  2. the ``{file -> num_row_groups}`` JSON index stored in
+     ``_common_metadata`` by our writer;
+  3. parallel per-file footer reads as a fallback.
+"""
+
+import json
+import logging
+import os
+import posixpath
+from concurrent.futures import ThreadPoolExecutor
+
+import pyarrow.parquet as pq
+
+from petastorm_tpu.fs import FilesystemResolver
+
+logger = logging.getLogger(__name__)
+
+# _common_metadata keys (JSON payloads, not pickle — see unischema.py docstring)
+UNISCHEMA_KEY = b'petastorm_tpu.unischema.v1'
+NUM_ROW_GROUPS_KEY = b'petastorm_tpu.num_row_groups_per_file.v1'
+ROWGROUP_INDEX_KEY = b'petastorm_tpu.rowgroups_index.v1'
+
+_METADATA_FILE = '_metadata'
+_COMMON_METADATA_FILE = '_common_metadata'
+
+
+class RowGroupPiece(object):
+    """One row-group of one Parquet file — the unit of reader work."""
+
+    __slots__ = ('path', 'row_group', 'partition_values', 'num_rows')
+
+    def __init__(self, path, row_group, partition_values=None, num_rows=None):
+        self.path = path
+        self.row_group = row_group
+        self.partition_values = partition_values or {}
+        self.num_rows = num_rows
+
+    def __repr__(self):
+        return 'RowGroupPiece({!r}, rg={}, partitions={}, rows={})'.format(
+            self.path, self.row_group, self.partition_values, self.num_rows)
+
+    def __eq__(self, other):
+        return (isinstance(other, RowGroupPiece) and self.path == other.path
+                and self.row_group == other.row_group)
+
+    def __hash__(self):
+        return hash((self.path, self.row_group))
+
+
+def _parse_partition_values(root, file_path):
+    """Extract hive-style ``key=value`` directory components."""
+    rel = posixpath.relpath(file_path, root)
+    values = {}
+    for segment in rel.split('/')[:-1]:
+        if '=' in segment:
+            key, _, value = segment.partition('=')
+            values[key] = value
+    return values
+
+
+def _coerce_partition_value(value):
+    """Hive partition values are strings on disk; try int then float."""
+    for cast in (int, float):
+        try:
+            return cast(value)
+        except (TypeError, ValueError):
+            continue
+    return value
+
+
+class ParquetStore(object):
+    """A discovered Parquet dataset: file list, partitions, metadata access."""
+
+    def __init__(self, dataset_url, storage_options=None, filesystem=None, path=None):
+        self.storage_options = dict(storage_options or {})
+        if filesystem is not None:
+            self.fs = filesystem
+            self.path = path if path is not None else dataset_url
+            self.url = dataset_url
+        else:
+            resolver = FilesystemResolver(dataset_url, storage_options)
+            self.fs = resolver.filesystem()
+            self.path = resolver.get_dataset_path()
+            self.url = resolver.dataset_url
+        self._files = None
+        self._common_metadata = None
+        self._common_metadata_loaded = False
+
+    # --- file discovery ---------------------------------------------------
+
+    @property
+    def files(self):
+        """Sorted data file paths (deterministic across hosts — parity with
+        the sorted piece order at ``etl/dataset_metadata.py:263-265``)."""
+        if self._files is None:
+            if not self.fs.exists(self.path):
+                raise IOError('Dataset path does not exist: {}'.format(self.url))
+            if self.fs.isfile(self.path):
+                self._files = [self.path]
+            else:
+                found = self.fs.find(self.path)
+                self._files = sorted(
+                    f for f in found
+                    if not os.path.basename(f).startswith(('_', '.')) and not f.endswith('.crc'))
+        return self._files
+
+    @property
+    def partition_names(self):
+        names = []
+        for f in self.files:
+            for key in _parse_partition_values(self.path, f):
+                if key not in names:
+                    names.append(key)
+        return names
+
+    def partition_values_for(self, file_path):
+        raw = _parse_partition_values(self.path, file_path)
+        return {k: _coerce_partition_value(v) for k, v in raw.items()}
+
+    # --- metadata ---------------------------------------------------------
+
+    def _metadata_path(self, name):
+        return posixpath.join(self.path, name)
+
+    def read_common_metadata(self):
+        """Key-value metadata dict from ``_common_metadata`` (or None)."""
+        if not self._common_metadata_loaded:
+            self._common_metadata_loaded = True
+            target = self._metadata_path(_COMMON_METADATA_FILE)
+            if self.fs.exists(target):
+                with self.fs.open(target, 'rb') as f:
+                    schema = pq.read_schema(f)
+                self._common_metadata = dict(schema.metadata or {})
+            else:
+                self._common_metadata = None
+        return self._common_metadata
+
+    def write_common_metadata(self, arrow_schema, extra_metadata):
+        """Write/update ``_common_metadata`` with ``extra_metadata`` key-values.
+
+        Parity: reference ``petastorm/utils.py:90-134``
+        (``add_to_dataset_metadata``).
+        """
+        existing = self.read_common_metadata() or {}
+        merged = dict(existing)
+        for key, value in extra_metadata.items():
+            key = key if isinstance(key, bytes) else key.encode('utf-8')
+            value = value if isinstance(value, bytes) else value.encode('utf-8')
+            merged[key] = value
+        schema = arrow_schema.with_metadata(merged)
+        target = self._metadata_path(_COMMON_METADATA_FILE)
+        with self.fs.open(target, 'wb') as f:
+            pq.write_metadata(schema, f)
+        self._common_metadata = merged
+        self._common_metadata_loaded = True
+        crc = self._metadata_path('.' + _COMMON_METADATA_FILE + '.crc')
+        if self.fs.exists(crc):  # stale checksum removal, utils.py:128-133
+            self.fs.rm(crc)
+
+    def common_metadata_value(self, key, default=None):
+        md = self.read_common_metadata()
+        if md is None:
+            return default
+        return md.get(key, default)
+
+    def read_arrow_schema(self):
+        """Arrow schema of the data files (first file's footer)."""
+        target = self._metadata_path(_COMMON_METADATA_FILE)
+        if self.fs.exists(target):
+            with self.fs.open(target, 'rb') as f:
+                schema = pq.read_schema(f)
+            if schema.names:
+                return schema
+        with self.fs.open(self.files[0], 'rb') as f:
+            return pq.read_schema(f)
+
+    # --- row-group listing ------------------------------------------------
+
+    def row_groups(self, max_footer_workers=10):
+        """List all :class:`RowGroupPiece` using the fastest strategy available."""
+        pieces = self._row_groups_from_summary_metadata()
+        if pieces is None:
+            pieces = self._row_groups_from_json_index()
+        if pieces is None:
+            pieces = self._row_groups_from_footers(max_footer_workers)
+        return pieces
+
+    def _row_groups_from_summary_metadata(self):
+        """Strategy 1: single ``_metadata`` summary footer
+        (parity: ``etl/dataset_metadata.py:279-312``)."""
+        target = self._metadata_path(_METADATA_FILE)
+        if not self.fs.exists(target):
+            return None
+        with self.fs.open(target, 'rb') as f:
+            metadata = pq.read_metadata(f)
+        per_file = {}
+        for i in range(metadata.num_row_groups):
+            rg = metadata.row_group(i)
+            file_path = rg.column(0).file_path
+            if not file_path:
+                return None
+            full = posixpath.join(self.path, file_path)
+            per_file.setdefault(full, []).append(rg.num_rows)
+        pieces = []
+        for full in sorted(per_file):
+            partitions = self.partition_values_for(full)
+            for idx, num_rows in enumerate(per_file[full]):
+                pieces.append(RowGroupPiece(full, idx, partitions, num_rows))
+        return pieces
+
+    def _row_groups_from_json_index(self):
+        """Strategy 2: ``{relative_file -> num_row_groups}`` JSON in
+        ``_common_metadata`` (parity: ``etl/dataset_metadata.py:246-273``)."""
+        blob = self.common_metadata_value(NUM_ROW_GROUPS_KEY)
+        if blob is None:
+            return None
+        counts = json.loads(blob.decode('utf-8'))
+        pieces = []
+        file_set = set(self.files)
+        for rel in sorted(counts):
+            full = posixpath.join(self.path, rel)
+            if full not in file_set:
+                logger.warning('Row-group index mentions missing file %s; falling back to footers', rel)
+                return None
+            partitions = self.partition_values_for(full)
+            for idx in range(counts[rel]):
+                pieces.append(RowGroupPiece(full, idx, partitions))
+        return pieces
+
+    def _row_groups_from_footers(self, max_workers):
+        """Strategy 3: read every file footer, in parallel
+        (parity: ``etl/dataset_metadata.py:323-336``)."""
+        def footer(path):
+            with self.fs.open(path, 'rb') as f:
+                md = pq.read_metadata(f)
+            return path, [md.row_group(i).num_rows for i in range(md.num_row_groups)]
+
+        files = self.files
+        results = {}
+        if len(files) == 1:
+            path, rows = footer(files[0])
+            results[path] = rows
+        else:
+            with ThreadPoolExecutor(max_workers=min(max_workers, max(1, len(files)))) as pool:
+                for path, rows in pool.map(footer, files):
+                    results[path] = rows
+        pieces = []
+        for path in sorted(results):
+            partitions = self.partition_values_for(path)
+            for idx, num_rows in enumerate(results[path]):
+                pieces.append(RowGroupPiece(path, idx, partitions, num_rows))
+        return pieces
+
+    def num_row_groups_per_file(self):
+        """``{relative_path: count}`` for the JSON index."""
+        counts = {}
+        for piece in self.row_groups():
+            rel = posixpath.relpath(piece.path, self.path)
+            counts[rel] = counts.get(rel, 0) + 1
+        return counts
+
+    def open_file(self, path):
+        return self.fs.open(path, 'rb')

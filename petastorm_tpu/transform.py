@@ -1,0 +1,59 @@
+"""TransformSpec: user transforms executed inside reader workers.
+
+Parity: reference ``petastorm/transform.py`` — a function applied per
+row (dict) or per batch (pandas DataFrame for the Arrow worker), plus
+declarative schema edits (``edit_fields``) and ``removed_fields`` so the
+post-transform schema remains statically known (``transform.py:19-64``).
+"""
+
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+class TransformSpec(object):
+    def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None):
+        """
+        :param func: callable applied inside the worker. For row readers it
+            receives/returns a dict; for batch (Arrow) readers a pandas
+            DataFrame.
+        :param edit_fields: list of ``UnischemaField`` (or 4/5-tuples
+            ``(name, dtype, shape, [codec,] nullable)``) added/replaced in the
+            output schema.
+        :param removed_fields: list of field names removed by ``func``.
+        :param selected_fields: if set, the output schema keeps only these
+            field names (applied after edits/removals).
+        """
+        self.func = func
+        self.edit_fields = [self._as_field(f) for f in (edit_fields or [])]
+        self.removed_fields = list(removed_fields or [])
+        self.selected_fields = list(selected_fields) if selected_fields is not None else None
+
+    @staticmethod
+    def _as_field(f):
+        if isinstance(f, UnischemaField):
+            return f
+        if isinstance(f, (tuple, list)):
+            if len(f) == 4:
+                name, dtype, shape, nullable = f
+                return UnischemaField(name, dtype, shape, None, nullable)
+            if len(f) == 5:
+                name, dtype, shape, codec, nullable = f
+                return UnischemaField(name, dtype, shape, codec, nullable)
+        raise TypeError('edit_fields entries must be UnischemaField or 4/5-tuples, got {!r}'.format(f))
+
+
+def transform_schema(schema, transform_spec):
+    """Compute the post-transform schema.
+
+    Parity: reference ``petastorm/transform.py:43-64``.
+    """
+    fields = dict(schema.fields)
+    for name in transform_spec.removed_fields:
+        fields.pop(name, None)
+    for f in transform_spec.edit_fields:
+        fields[f.name] = f
+    if transform_spec.selected_fields is not None:
+        missing = [n for n in transform_spec.selected_fields if n not in fields]
+        if missing:
+            raise ValueError('selected_fields not present after transform: {}'.format(missing))
+        fields = {n: fields[n] for n in transform_spec.selected_fields}
+    return Unischema(schema.name, list(fields.values()))

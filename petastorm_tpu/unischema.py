@@ -1,0 +1,342 @@
+"""Unischema: a framework-neutral dataset schema with per-field codecs.
+
+Parity: reference ``petastorm/unischema.py`` — named fields with numpy dtype,
+shape (``None`` = variable dim), codec and nullability; schema views by field
+object or full-match regex (``unischema.py:188-229,414-441``); namedtuple row
+types with a cache so repeated calls return the identical type
+(``unischema.py:83-103``); inference from an Arrow schema including partition
+columns (``unischema.py:291-340``); encode-on-write (``dict_to_spark_row``,
+``unischema.py:343-383``) and ``insert_explicit_nulls`` (``:386-401``).
+
+TPU-first differences:
+  * Schemas serialize to/from JSON (``to_json``/``from_json``) instead of
+    pickle, so dataset metadata survives package renames and Python upgrades.
+  * Encoding targets Arrow tables directly (``encode_row`` +
+    ``arrow_schema()``) — no Spark Row/StructType on the write path.
+"""
+
+import copy
+import re
+from collections import OrderedDict, namedtuple
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.codecs import (CompressedImageCodec, NdarrayCodec,  # noqa: F401
+                                  ScalarCodec, codec_from_json)
+from petastorm_tpu.errors import SchemaError
+
+
+class UnischemaField(object):
+    """A single schema field: ``(name, numpy_dtype, shape, codec, nullable)``.
+
+    ``shape`` is a tuple; ``None`` entries are variable-size dimensions.
+    Equality intentionally ignores the codec, matching the reference
+    (``petastorm/unischema.py:35-43``) so that schema views and re-encoded
+    datasets compare equal.
+    """
+
+    __slots__ = ('name', 'numpy_dtype', 'shape', 'codec', 'nullable')
+
+    def __init__(self, name, numpy_dtype, shape=(), codec=None, nullable=False):
+        self.name = name
+        self.numpy_dtype = np.dtype(numpy_dtype)
+        self.shape = tuple(shape)
+        self.codec = codec
+        self.nullable = nullable
+
+    def _key(self):
+        return (self.name, self.numpy_dtype, self.shape, self.nullable)
+
+    def __eq__(self, other):
+        if not isinstance(other, UnischemaField):
+            return NotImplemented
+        return self._key() == other._key()
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __repr__(self):
+        return 'UnischemaField({!r}, {}, {}, {}, nullable={})'.format(
+            self.name, self.numpy_dtype, self.shape, self.codec, self.nullable)
+
+    @property
+    def is_scalar(self):
+        return self.shape == ()
+
+    def resolved_codec(self):
+        """The codec to use: explicit one, else a default inferred from shape.
+
+        Scalar fields default to a native typed column; tensor fields default
+        to ``NdarrayCodec`` bytes.
+        """
+        if self.codec is not None:
+            return self.codec
+        if self.is_scalar:
+            return ScalarCodec(self.numpy_dtype)
+        return NdarrayCodec()
+
+    def to_json(self):
+        return {
+            'name': self.name,
+            'dtype': self.numpy_dtype.str,
+            'shape': [d if d is not None else None for d in self.shape],
+            'codec': self.codec.to_json() if self.codec is not None else None,
+            'nullable': bool(self.nullable),
+        }
+
+    @classmethod
+    def from_json(cls, spec):
+        return cls(spec['name'], np.dtype(spec['dtype']),
+                   tuple(spec.get('shape', ())),
+                   codec_from_json(spec.get('codec')),
+                   spec.get('nullable', False))
+
+
+class _NamedtupleCache(object):
+    """Caches generated namedtuple types by (schema name, field names).
+
+    Needed so e.g. tf.data sees the *same* Python type across epochs — parity
+    with reference ``petastorm/unischema.py:83-103``.
+    """
+
+    _store = {}
+
+    @classmethod
+    def get(cls, parent_name, field_names):
+        key = (parent_name, tuple(field_names))
+        if key not in cls._store:
+            cls._store[key] = namedtuple('{}_view'.format(parent_name), list(field_names))
+        return cls._store[key]
+
+
+class Unischema(object):
+    """An ordered collection of :class:`UnischemaField`.
+
+    Fields are accessible as attributes (``schema.my_field``) and via the
+    ordered dict ``schema.fields``.
+    """
+
+    def __init__(self, name, fields):
+        self._name = name
+        self._fields = OrderedDict((f.name, f) for f in sorted(fields, key=lambda f: f.name))
+        for f in self._fields.values():
+            if not _valid_attr_name(f.name):
+                raise SchemaError('Field name {!r} is not a valid identifier'.format(f.name))
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def fields(self):
+        return self._fields
+
+    def __getattr__(self, item):
+        fields = object.__getattribute__(self, '_fields')
+        if item in fields:
+            return fields[item]
+        raise AttributeError('{!r} object has no attribute/field {!r}'.format(
+            type(self).__name__, item))
+
+    def __repr__(self):
+        lines = ['Unischema({!r}, ['.format(self._name)]
+        lines.extend('  {!r},'.format(f) for f in self._fields.values())
+        lines.append('])')
+        return '\n'.join(lines)
+
+    # --- views ------------------------------------------------------------
+
+    def create_schema_view(self, fields_or_patterns):
+        """Subset view by field objects and/or full-match regex strings.
+
+        Parity: reference ``petastorm/unischema.py:188-229`` (regex resolution
+        at ``:414-441``). Unknown fields / non-matching patterns raise.
+        """
+        selected = match_unischema_fields(self, fields_or_patterns, allow_empty_match=False)
+        view_fields = []
+        for f in selected:
+            if f.name not in self._fields or self._fields[f.name] != f:
+                raise SchemaError('create_schema_view: field {!r} does not belong to schema {!r}'.format(
+                    f.name, self._name))
+            view_fields.append(self._fields[f.name])
+        return Unischema(self._name, view_fields)
+
+    # --- row types --------------------------------------------------------
+
+    def make_namedtuple(self, **kwargs):
+        """Build a row namedtuple instance from keyword values."""
+        return self.namedtuple_type()(**{k: kwargs[k] for k in self._fields})
+
+    def make_namedtuple_tf(self, *args, **kwargs):
+        return self.namedtuple_type()(*args, **kwargs)
+
+    def namedtuple_type(self):
+        return _NamedtupleCache.get(self._name, list(self._fields))
+
+    # --- (de)serialization ------------------------------------------------
+
+    def to_json(self):
+        return {'name': self._name,
+                'fields': [f.to_json() for f in self._fields.values()]}
+
+    @classmethod
+    def from_json(cls, spec):
+        return cls(spec['name'], [UnischemaField.from_json(f) for f in spec['fields']])
+
+    # --- arrow ------------------------------------------------------------
+
+    def arrow_schema(self, partition_fields=()):
+        """Arrow schema of the *encoded* representation (for the write path).
+
+        ``partition_fields`` are excluded — they become directory names.
+        """
+        cols = []
+        for f in self._fields.values():
+            if f.name in partition_fields:
+                continue
+            cols.append(pa.field(f.name, f.resolved_codec().arrow_type(), nullable=True))
+        return pa.schema(cols)
+
+    @classmethod
+    def from_arrow_schema(cls, arrow_schema, schema_name='inferred_schema',
+                          partition_columns=(), omit_unsupported_fields=False):
+        """Infer a Unischema from a plain Arrow/Parquet schema.
+
+        Used for non-petastorm Parquet stores (``make_batch_reader`` path).
+        Parity: reference ``petastorm/unischema.py:291-340``.
+        """
+        fields = []
+        for name in arrow_schema.names:
+            arrow_field = arrow_schema.field(name)
+            try:
+                np_dtype, shape = _arrow_to_numpy_dtype(arrow_field.type)
+            except SchemaError:
+                if omit_unsupported_fields:
+                    continue
+                raise
+            fields.append(UnischemaField(name, np_dtype, shape, codec=None,
+                                         nullable=arrow_field.nullable))
+        for name in partition_columns:
+            if not any(f.name == name for f in fields):
+                fields.append(UnischemaField(name, np.dtype('O'), (), codec=None, nullable=False))
+        return cls(schema_name, fields)
+
+
+def _valid_attr_name(name):
+    return re.match(r'^[A-Za-z_][A-Za-z0-9_]*$', name) is not None
+
+
+def _arrow_to_numpy_dtype(arrow_type):
+    """Map an Arrow type to (numpy dtype, shape) — lists become 1-D fields.
+
+    Parity: reference ``petastorm/unischema.py:444-477``.
+    """
+    if pa.types.is_list(arrow_type) or pa.types.is_large_list(arrow_type):
+        inner, inner_shape = _arrow_to_numpy_dtype(arrow_type.value_type)
+        if inner_shape != ():
+            raise SchemaError('Nested lists are not supported: {}'.format(arrow_type))
+        return inner, (None,)
+    if pa.types.is_string(arrow_type) or pa.types.is_large_string(arrow_type):
+        return np.dtype('O'), ()
+    if pa.types.is_binary(arrow_type) or pa.types.is_large_binary(arrow_type):
+        return np.dtype('O'), ()
+    if pa.types.is_decimal(arrow_type):
+        return np.dtype('O'), ()
+    if pa.types.is_timestamp(arrow_type) or pa.types.is_date(arrow_type):
+        return np.dtype('datetime64[ns]'), ()
+    if pa.types.is_dictionary(arrow_type):
+        return _arrow_to_numpy_dtype(arrow_type.value_type)
+    try:
+        return np.dtype(arrow_type.to_pandas_dtype()), ()
+    except NotImplementedError:
+        raise SchemaError('Unsupported Arrow type: {}'.format(arrow_type))
+
+
+def match_unischema_fields(schema, fields_or_patterns, allow_empty_match=True):
+    """Resolve a mixed list of UnischemaField objects and regex strings.
+
+    Regexes are full-match against field names (reference
+    ``petastorm/unischema.py:414-441``).
+    """
+    if fields_or_patterns is None:
+        return list(schema.fields.values())
+    resolved = OrderedDict()
+    for item in fields_or_patterns:
+        if isinstance(item, UnischemaField):
+            resolved[item.name] = item
+        elif isinstance(item, str):
+            pattern = re.compile(item)
+            matched = [f for n, f in schema.fields.items() if pattern.fullmatch(n)]
+            if not matched and not allow_empty_match:
+                raise SchemaError('Pattern {!r} matched no fields of schema {!r}'.format(
+                    item, schema.name))
+            for f in matched:
+                resolved[f.name] = f
+        else:
+            raise TypeError('Expected UnischemaField or str pattern, got {!r}'.format(item))
+    return list(resolved.values())
+
+
+def insert_explicit_nulls(schema, row_dict):
+    """Add ``None`` for missing nullable fields; raise for missing non-nullable.
+
+    Parity: reference ``petastorm/unischema.py:386-401``.
+    """
+    for name, field in schema.fields.items():
+        if name not in row_dict:
+            if field.nullable:
+                row_dict[name] = None
+            else:
+                raise ValueError('Field {!r} is not nullable but is missing from the row'.format(name))
+
+
+def encode_row(schema, row_dict):
+    """Encode a user row dict into Parquet-storable cell values.
+
+    Parity: reference ``dict_to_spark_row`` (``petastorm/unischema.py:343-383``)
+    minus the Spark Row wrapper — the output feeds ``pa.Table`` construction.
+    """
+    if not isinstance(row_dict, dict):
+        raise TypeError('row must be a dict, got {}'.format(type(row_dict)))
+    row = dict(row_dict)
+    unknown = set(row.keys()) - set(schema.fields.keys())
+    if unknown:
+        raise ValueError('Row has fields not in schema {!r}: {}'.format(schema.name, sorted(unknown)))
+    insert_explicit_nulls(schema, row)
+    encoded = {}
+    for name, field in schema.fields.items():
+        value = row[name]
+        if value is None:
+            if not field.nullable:
+                raise ValueError('Field {!r} is not nullable but got None'.format(name))
+            encoded[name] = None
+        else:
+            encoded[name] = field.resolved_codec().encode(field, value)
+    return encoded
+
+
+def decode_row(row, schema):
+    """Decode an encoded row dict back into user-facing numpy values.
+
+    Parity: reference ``petastorm/utils.py:54-87`` (``decode_row``).
+    """
+    from petastorm_tpu.errors import DecodeFieldError
+    decoded = {}
+    for name, value in row.items():
+        if name not in schema.fields:
+            continue
+        field = schema.fields[name]
+        if value is None:
+            decoded[name] = None
+            continue
+        try:
+            decoded[name] = field.resolved_codec().decode(field, value)
+        except Exception as e:
+            raise DecodeFieldError('Unable to decode field {!r}: {}'.format(name, e)) from e
+    return decoded
+
+
+def copy_schema(schema, name=None):
+    """Deep-copy a schema (used by transform_schema edits)."""
+    return Unischema(name or schema.name, [copy.copy(f) for f in schema.fields.values()])

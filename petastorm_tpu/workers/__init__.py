@@ -1,0 +1,39 @@
+"""Worker pools: ventilator-fed parallel execution with bounded results queues.
+
+Parity: reference ``petastorm/workers_pool/`` — sentinel messages
+(``workers_pool/__init__.py:16-26``), ``WorkerBase`` protocol
+(``worker_base.py:18-35``), thread/process/dummy pools, ventilator.
+"""
+
+
+class EmptyResultError(Exception):
+    """Raised by ``pool.get_results()`` when all work is done (end of epoch)."""
+
+
+class TimeoutWaitingForResultError(Exception):
+    pass
+
+
+class VentilatedItemProcessedMessage(object):
+    """Sentinel a worker publishes after fully processing one ventilated item."""
+
+
+class WorkerBase(object):
+    """Parity: reference ``workers_pool/worker_base.py:18-35``."""
+
+    def __init__(self, worker_id, publish_func, args):
+        self.worker_id = worker_id
+        self.publish_func = publish_func
+        self.args = args
+
+    def initialize(self):
+        """Called once in the worker context before processing items."""
+
+    def process(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def publish_func(self, data):  # pragma: no cover - replaced in __init__
+        raise NotImplementedError
+
+    def shutdown(self):
+        """Called when the pool stops."""

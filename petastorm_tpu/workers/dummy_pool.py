@@ -1,0 +1,76 @@
+"""Single-threaded synchronous pool: work happens inside ``get_results()``.
+
+Parity: reference ``petastorm/workers_pool/dummy_pool.py`` — used for
+debugging, deterministic tests, and profiler-friendly in-main-thread
+execution (``dummy_pool.py:24-25``).
+"""
+
+from collections import deque
+
+from petastorm_tpu.workers import (EmptyResultError,
+                                   VentilatedItemProcessedMessage)
+
+
+class DummyPool(object):
+    def __init__(self, workers_count=None):
+        self._results = deque()
+        self._ventilated = deque()
+        self._worker = None
+        self._ventilator = None
+        self._stopped = False
+
+    def start(self, worker_class, worker_args=None, ventilator=None):
+        self._worker = worker_class(0, self._results.append, worker_args)
+        self._worker.initialize()
+        self._ventilator = ventilator
+        if ventilator is not None:
+            ventilator._ventilate_fn = self.ventilate
+            ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        self._ventilated.append((args, kwargs))
+
+    def get_results(self):
+        while True:
+            while self._results:
+                result = self._results.popleft()
+                if isinstance(result, VentilatedItemProcessedMessage):
+                    if self._ventilator is not None:
+                        self._ventilator.processed_item()
+                    continue
+                if isinstance(result, Exception):
+                    raise result
+                return result
+            if not self._ventilated:
+                # Read `completed` BEFORE re-checking the deque: once completed
+                # is observed no further ventilation can occur, so a still-empty
+                # deque really means end of data (no lost-item race).
+                if self._ventilator is None or self._ventilator.completed():
+                    if not self._ventilated and not self._results:
+                        raise EmptyResultError()
+                continue
+            args, kwargs = self._ventilated.popleft()
+            try:
+                self._worker.process(*args, **kwargs)
+                self._results.append(VentilatedItemProcessedMessage())
+            except Exception as e:  # noqa: BLE001 - parity: exceptions surface to consumer
+                self._results.append(e)
+
+    def stop(self):
+        self._stopped = True
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        if self._worker is not None:
+            self._worker.shutdown()
+
+    def join(self):
+        pass
+
+    @property
+    def diagnostics(self):
+        return {'output_queue_size': len(self._results),
+                'ventilation_queue_size': len(self._ventilated)}
+
+    @property
+    def results_qsize(self):
+        return len(self._results)

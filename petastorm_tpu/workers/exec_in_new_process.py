@@ -1,0 +1,49 @@
+"""Spawn (not fork) a Python callable in a brand-new interpreter.
+
+Parity: reference ``petastorm/workers_pool/exec_in_new_process.py`` — the
+callable + args are dill-dumped to a temp file and a fresh ``python -m``
+process re-hydrates and runs them (``:26-69``). Spawning avoids inheriting
+JVM/driver/TPU-client state into data workers (``process_pool.py:15-17`` —
+on TPU-VMs, forking a process holding a libtpu client handle is unsafe).
+"""
+
+import os
+import subprocess
+import sys
+
+import dill
+
+
+def exec_in_new_process(func, *args, **kwargs):
+    """Launch ``func(*args, **kwargs)`` in a new python process; returns Popen."""
+    import tempfile
+    fd, payload_path = tempfile.mkstemp(suffix='.dill')
+    with os.fdopen(fd, 'wb') as f:
+        # sys.path rides along (as a separate first record, so it can be
+        # applied before the func record resolves imports) — by-reference
+        # pickles of classes in e.g. test modules then import cleanly.
+        dill.dump(list(sys.path), f)
+        dill.dump((func, args, kwargs), f, recurse=False)
+    process = subprocess.Popen(
+        [sys.executable, '-m', 'petastorm_tpu.workers.exec_in_new_process', payload_path],
+        close_fds=True)
+    return process
+
+
+def _main():
+    payload_path = sys.argv[1]
+    with open(payload_path, 'rb') as f:
+        parent_sys_path = dill.load(f)
+        for entry in reversed(parent_sys_path):
+            if entry not in sys.path:
+                sys.path.insert(0, entry)
+        func, args, kwargs = dill.load(f)
+    try:
+        os.unlink(payload_path)
+    except OSError:
+        pass
+    func(*args, **kwargs)
+
+
+if __name__ == '__main__':
+    _main()

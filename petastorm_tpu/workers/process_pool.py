@@ -1,0 +1,254 @@
+"""Out-of-process worker pool over ZeroMQ.
+
+Parity: reference ``petastorm/workers_pool/process_pool.py`` — PUSH
+(ventilate) / PUB (control) / PULL (results) sockets on random localhost TCP
+ports (protocol diagram ``:52-74``); workers spawned, never forked (``:15-17``)
+via :func:`exec_in_new_process`; startup barrier waiting for a started
+indicator per worker (``:208-214``); results as 2-part multipart
+``[control-pickle, data(serializer)]`` (``:317-321``); orphan watchdog thread
+killing the worker if the parent dies (``:324-331``); slow-joiner-safe
+shutdown rebroadcasting FINISHED (``:287-304``).
+
+On TPU-VM hosts this pool sidesteps the GIL for CPU-bound python decode;
+spawning keeps libtpu/JAX client state out of data workers.
+"""
+
+import logging
+import os
+import pickle
+import threading
+import time
+
+import zmq
+
+from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
+                                   VentilatedItemProcessedMessage)
+from petastorm_tpu.workers.exec_in_new_process import exec_in_new_process
+from petastorm_tpu.workers.serializers import PickleSerializer
+
+logger = logging.getLogger(__name__)
+
+_WORKER_STARTED = '__worker_started__'
+_CONTROL_FINISHED = b'FINISHED'
+_SOCKET_LINGER_MS = 1000
+_DEFAULT_TIMEOUT_S = 60
+_STARTUP_TIMEOUT_S = 120
+_JOIN_REBROADCAST_INTERVAL_S = 0.2
+
+
+class _WorkerError(object):
+    def __init__(self, exception, traceback_str):
+        self.exception = exception
+        self.traceback_str = traceback_str
+
+
+class ProcessPool(object):
+    def __init__(self, workers_count, results_queue_size=50, serializer=None,
+                 zmq_copy_buffers=True):
+        self._workers_count = workers_count
+        self._results_queue_size = results_queue_size
+        self._serializer = serializer or PickleSerializer()
+        self._zmq_copy_buffers = zmq_copy_buffers
+
+        self._context = None
+        self._ventilator_send = None
+        self._control_sender = None
+        self._results_receiver = None
+        self._processes = []
+        self._ventilator = None
+        self._ventilated_unprocessed = 0
+        self._count_lock = threading.Lock()
+        self._stopped = False
+
+    @property
+    def workers_count(self):
+        return self._workers_count
+
+    def start(self, worker_class, worker_args=None, ventilator=None):
+        if self._processes:
+            raise RuntimeError('ProcessPool already started')
+        self._context = zmq.Context()
+
+        self._ventilator_send = self._context.socket(zmq.PUSH)
+        ventilator_port = self._ventilator_send.bind_to_random_port('tcp://127.0.0.1')
+        self._control_sender = self._context.socket(zmq.PUB)
+        control_port = self._control_sender.bind_to_random_port('tcp://127.0.0.1')
+        self._results_receiver = self._context.socket(zmq.PULL)
+        self._results_receiver.set(zmq.RCVHWM, self._results_queue_size)
+        results_port = self._results_receiver.bind_to_random_port('tcp://127.0.0.1')
+
+        for worker_id in range(self._workers_count):
+            process = exec_in_new_process(
+                _worker_bootstrap, worker_class, worker_id, worker_args,
+                ventilator_port, control_port, results_port,
+                type(self._serializer), os.getpid())
+            self._processes.append(process)
+
+        # Startup barrier (parity: process_pool.py:208-214).
+        started = 0
+        deadline = time.monotonic() + _STARTUP_TIMEOUT_S
+        while started < self._workers_count:
+            if time.monotonic() > deadline:
+                self.stop()
+                raise RuntimeError('Timed out waiting for {} worker processes to start '
+                                   '({} started)'.format(self._workers_count, started))
+            if self._results_receiver.poll(1000):
+                message = self._results_receiver.recv_multipart()
+                control = pickle.loads(message[0])
+                if control == _WORKER_STARTED:
+                    started += 1
+
+        self._ventilator = ventilator
+        if ventilator is not None:
+            ventilator._ventilate_fn = self.ventilate
+            ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        with self._count_lock:
+            self._ventilated_unprocessed += 1
+        self._ventilator_send.send_pyobj((args, kwargs))
+
+    def get_results(self, timeout=_DEFAULT_TIMEOUT_S):
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        while True:
+            if self._results_receiver.poll(50):
+                message = self._results_receiver.recv_multipart()
+                control = pickle.loads(message[0])
+                if control == _WORKER_STARTED:
+                    continue
+                if isinstance(control, VentilatedItemProcessedMessage):
+                    with self._count_lock:
+                        self._ventilated_unprocessed -= 1
+                    if self._ventilator is not None:
+                        self._ventilator.processed_item()
+                    continue
+                if isinstance(control, _WorkerError):
+                    self.stop()
+                    self.join()
+                    logger.error('Worker traceback:\n%s', control.traceback_str)
+                    raise control.exception
+                # Data message: payload in the second frame.
+                return self._serializer.deserialize(message[1])
+            if self._all_done():
+                raise EmptyResultError()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutWaitingForResultError()
+
+    def _all_done(self):
+        # `completed` must be observed FIRST (see thread_pool._all_done).
+        ventilator_done = self._ventilator is None or self._ventilator.completed()
+        if not ventilator_done:
+            return False
+        with self._count_lock:
+            return self._ventilated_unprocessed == 0
+
+    def stop(self):
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        self._stopped = True
+        if self._control_sender is not None:
+            self._control_sender.send(_CONTROL_FINISHED)
+
+    def join(self):
+        # Slow-joiner-safe shutdown: rebroadcast FINISHED until every worker
+        # exits (parity: process_pool.py:287-304).
+        if not self._stopped:
+            self.stop()
+        while True:
+            alive = [p for p in self._processes if p.poll() is None]
+            if not alive:
+                break
+            self._control_sender.send(_CONTROL_FINISHED)
+            # Drain results so workers blocked on a full PUSH can exit.
+            while self._results_receiver.poll(0):
+                self._results_receiver.recv_multipart()
+            time.sleep(_JOIN_REBROADCAST_INTERVAL_S)
+        for sock in (self._ventilator_send, self._control_sender, self._results_receiver):
+            if sock is not None:
+                sock.close(linger=_SOCKET_LINGER_MS)
+        if self._context is not None:
+            self._context.term()
+        self._processes = []
+
+    @property
+    def diagnostics(self):
+        with self._count_lock:
+            return {'ventilated_unprocessed': self._ventilated_unprocessed,
+                    'workers_count': self._workers_count}
+
+    @property
+    def results_qsize(self):
+        return 0  # unknown for zmq transport
+
+
+def _worker_bootstrap(worker_class, worker_id, worker_args,
+                      ventilator_port, control_port, results_port,
+                      serializer_type, parent_pid):
+    """Entry point of a spawned worker process.
+
+    Parity: reference ``process_pool.py:334-417``.
+    """
+    import traceback
+
+    serializer = serializer_type()
+    context = zmq.Context()
+
+    work_receiver = context.socket(zmq.PULL)
+    work_receiver.connect('tcp://127.0.0.1:{}'.format(ventilator_port))
+    control_receiver = context.socket(zmq.SUB)
+    control_receiver.connect('tcp://127.0.0.1:{}'.format(control_port))
+    control_receiver.setsockopt(zmq.SUBSCRIBE, b'')
+    results_sender = context.socket(zmq.PUSH)
+    results_sender.connect('tcp://127.0.0.1:{}'.format(results_port))
+
+    _start_orphan_watchdog(parent_pid)
+
+    def publish(data):
+        results_sender.send_multipart([pickle.dumps('data'), serializer.serialize(data)])
+
+    worker = worker_class(worker_id, publish, worker_args)
+    try:
+        worker.initialize()
+    except Exception as e:  # noqa: BLE001
+        results_sender.send_multipart([
+            pickle.dumps(_WorkerError(e, traceback.format_exc())), b''])
+        return
+
+    results_sender.send_multipart([pickle.dumps(_WORKER_STARTED), b''])
+
+    poller = zmq.Poller()
+    poller.register(work_receiver, zmq.POLLIN)
+    poller.register(control_receiver, zmq.POLLIN)
+    try:
+        while True:
+            socks = dict(poller.poll())
+            if socks.get(control_receiver) == zmq.POLLIN:
+                if control_receiver.recv() == _CONTROL_FINISHED:
+                    break
+            if socks.get(work_receiver) == zmq.POLLIN:
+                args, kwargs = work_receiver.recv_pyobj()
+                try:
+                    worker.process(*args, **kwargs)
+                    results_sender.send_multipart([
+                        pickle.dumps(VentilatedItemProcessedMessage()), b''])
+                except Exception as e:  # noqa: BLE001
+                    results_sender.send_multipart([
+                        pickle.dumps(_WorkerError(e, traceback.format_exc())), b''])
+    finally:
+        worker.shutdown()
+        for sock in (work_receiver, control_receiver, results_sender):
+            sock.close(linger=_SOCKET_LINGER_MS)
+        context.term()
+
+
+def _start_orphan_watchdog(parent_pid):
+    """Kill this worker if the parent process dies (parity: ``:324-331``)."""
+    import psutil
+
+    def watch():
+        while True:
+            if not psutil.pid_exists(parent_pid):
+                os._exit(1)
+            time.sleep(1)
+
+    threading.Thread(target=watch, daemon=True).start()

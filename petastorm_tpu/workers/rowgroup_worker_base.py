@@ -1,0 +1,68 @@
+"""Shared machinery for row-group workers (dict & arrow flavors).
+
+Hosts the per-worker Parquet file-handle LRU cache and the
+shuffle-row-drop-partition slice computation so the two worker
+implementations cannot drift apart.
+"""
+
+from collections import OrderedDict
+
+import pyarrow.parquet as pq
+
+from petastorm_tpu.workers import WorkerBase
+
+_PARQUET_FILE_CACHE_SIZE = 32
+
+
+class RowGroupWorkerBase(WorkerBase):
+    """Worker base with a lazily-connected store and an LRU of open files."""
+
+    def __init__(self, worker_id, publish_func, args):
+        super().__init__(worker_id, publish_func, args)
+        self._store = None
+        self._file_cache = OrderedDict()
+
+    def initialize(self):
+        self._store = self.args['store_factory']()
+
+    def _parquet_file(self, path):
+        pf = self._file_cache.get(path)
+        if pf is not None:
+            self._file_cache.move_to_end(path)
+            return pf
+        if len(self._file_cache) >= _PARQUET_FILE_CACHE_SIZE:
+            _, old = self._file_cache.popitem(last=False)  # least recently used
+            try:
+                old.close()
+            except Exception:  # noqa: BLE001
+                pass
+        pf = pq.ParquetFile(self._store.open_file(path))
+        self._file_cache[path] = pf
+        return pf
+
+    def shutdown(self):
+        for pf in self._file_cache.values():
+            try:
+                pf.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._file_cache = OrderedDict()
+
+
+def compute_row_slice(num_rows, shuffle_row_drop_partition, ngram=None):
+    """(start, stop) row bounds for one drop-partition of a row-group.
+
+    Parity: reference ``py_dict_reader_worker.py:254-274`` — for ngram the
+    kept slice is tail-extended so windows spanning the boundary survive.
+    Returns None when the whole range is kept.
+    """
+    if shuffle_row_drop_partition is None:
+        return None
+    this_partition, num_partitions = shuffle_row_drop_partition
+    if num_partitions <= 1:
+        return None
+    bounds = [int(round(i * num_rows / num_partitions)) for i in range(num_partitions + 1)]
+    start, stop = bounds[this_partition], bounds[this_partition + 1]
+    if ngram is not None:
+        stop = min(num_rows, stop + ngram.length - 1)
+    return start, stop

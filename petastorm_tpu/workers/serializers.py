@@ -1,0 +1,32 @@
+"""Cross-process payload serializers.
+
+Parity: reference ``petastorm/reader_impl/{pickle,pyarrow,arrow_table}_serializer.py``.
+(``pyarrow.serialize`` is long removed from Arrow, so the Arrow path here is the
+IPC record-batch stream, matching ``arrow_table_serializer.py:18-33``.)
+"""
+
+import pickle
+
+import pyarrow as pa
+
+
+class PickleSerializer(object):
+    def serialize(self, rows):
+        return pickle.dumps(rows, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def deserialize(self, blob):
+        return pickle.loads(blob)
+
+
+class ArrowTableSerializer(object):
+    """Serializes ``pa.Table`` via the Arrow IPC stream format (zero pickle)."""
+
+    def serialize(self, table):
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as writer:
+            writer.write_table(table)
+        return sink.getvalue().to_pybytes()
+
+    def deserialize(self, blob):
+        with pa.ipc.open_stream(pa.BufferReader(blob)) as reader:
+            return reader.read_all()

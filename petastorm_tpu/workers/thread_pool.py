@@ -1,0 +1,179 @@
+"""In-process thread pool with ventilator feed and bounded results queue.
+
+Parity: reference ``petastorm/workers_pool/thread_pool.py`` — per-worker
+threads polling the ventilation queue (``thread_pool.py:61``), bounded
+results queue with stop-aware put (``:200-214``), end-of-data detection
+(queue empty AND all ventilated items processed AND ventilator completed,
+``:155-160``), worker exceptions re-raised in the consumer (``:68-73``,
+``:169-172``), and optional per-thread cProfile (``:48-49``, ``:190-198``).
+"""
+
+import pstats
+import queue
+import threading
+
+from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
+                                   VentilatedItemProcessedMessage)
+
+_DEFAULT_RESULTS_QUEUE_SIZE = 50
+_VENTILATION_POLL_TIMEOUT_S = 0.001
+_RESULTS_POLL_TIMEOUT_S = 0.01
+
+
+class _WorkerTerminationRequested(Exception):
+    pass
+
+
+class WorkerThread(threading.Thread):
+    def __init__(self, pool, worker, profiling_enabled=False):
+        super().__init__(daemon=True)
+        self._pool = pool
+        self._worker = worker
+        self._profiling_enabled = profiling_enabled
+        self.profile = None
+
+    def run(self):
+        if self._profiling_enabled:
+            import cProfile
+            self.profile = cProfile.Profile()
+            self.profile.enable()
+        try:
+            self._worker.initialize()
+            while not self._pool._stop_event.is_set():
+                try:
+                    args, kwargs = self._pool._ventilator_queue.get(
+                        timeout=_VENTILATION_POLL_TIMEOUT_S)
+                except queue.Empty:
+                    continue
+                try:
+                    self._worker.process(*args, **kwargs)
+                    self._pool._put_result(VentilatedItemProcessedMessage())
+                except _WorkerTerminationRequested:
+                    return
+                except Exception as e:  # noqa: BLE001 - surfaces to consumer
+                    self._pool._put_result(e)
+        except _WorkerTerminationRequested:
+            return
+        finally:
+            if self._profiling_enabled and self.profile is not None:
+                self.profile.disable()
+            self._worker.shutdown()
+
+
+class ThreadPool(object):
+    def __init__(self, workers_count, results_queue_size=_DEFAULT_RESULTS_QUEUE_SIZE,
+                 profiling_enabled=False):
+        self._workers_count = workers_count
+        self._results_queue = queue.Queue(maxsize=results_queue_size)
+        self._ventilator_queue = queue.Queue()
+        self._stop_event = threading.Event()
+        self._workers = []
+        self._ventilator = None
+        self._profiling_enabled = profiling_enabled
+        self._ventilated_unprocessed = 0
+        self._count_lock = threading.Lock()
+
+    @property
+    def workers_count(self):
+        return self._workers_count
+
+    def start(self, worker_class, worker_args=None, ventilator=None):
+        if self._workers:
+            raise RuntimeError('ThreadPool already started')
+        for worker_id in range(self._workers_count):
+            worker = worker_class(worker_id, self._put_result, worker_args)
+            thread = WorkerThread(self, worker, self._profiling_enabled)
+            self._workers.append(thread)
+            thread.start()
+        self._ventilator = ventilator
+        if ventilator is not None:
+            ventilator._ventilate_fn = self.ventilate
+            ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        with self._count_lock:
+            self._ventilated_unprocessed += 1
+        self._ventilator_queue.put((args, kwargs))
+
+    def _put_result(self, data):
+        # Stop-aware bounded put (parity: thread_pool.py:200-214): never block
+        # forever on a full queue if the pool is being stopped.
+        while True:
+            if self._stop_event.is_set():
+                raise _WorkerTerminationRequested()
+            try:
+                self._results_queue.put(data, timeout=_RESULTS_POLL_TIMEOUT_S)
+                return
+            except queue.Full:
+                continue
+
+    def get_results(self, timeout=None):
+        import time
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        while True:
+            try:
+                result = self._results_queue.get(timeout=_RESULTS_POLL_TIMEOUT_S)
+            except queue.Empty:
+                if self._all_done():
+                    raise EmptyResultError()
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutWaitingForResultError()
+                continue
+            if isinstance(result, VentilatedItemProcessedMessage):
+                with self._count_lock:
+                    self._ventilated_unprocessed -= 1
+                if self._ventilator is not None:
+                    self._ventilator.processed_item()
+                continue
+            if isinstance(result, Exception):
+                self.stop()
+                self.join()
+                raise result
+            return result
+
+    def _all_done(self):
+        # Order matters: observe `completed` FIRST. After it is set no further
+        # ventilation can occur, so the subsequent counter/queue reads cannot
+        # miss in-flight items (they only drain monotonically).
+        ventilator_done = self._ventilator is None or self._ventilator.completed()
+        if not ventilator_done:
+            return False
+        with self._count_lock:
+            nothing_in_flight = self._ventilated_unprocessed == 0
+        return (nothing_in_flight
+                and self._results_queue.empty() and self._ventilator_queue.empty())
+
+    def stop(self):
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        self._stop_event.set()
+
+    def join(self):
+        for thread in self._workers:
+            thread.join()
+        if self._profiling_enabled:
+            self._print_profiles()
+        self._workers = []
+
+    def _print_profiles(self):
+        profiles = [t.profile for t in self._workers if t.profile is not None]
+        if not profiles:
+            return
+        stats = None
+        for profile in profiles:
+            if stats is None:
+                stats = pstats.Stats(profile)
+            else:
+                stats.add(profile)
+        if stats is not None:
+            stats.sort_stats('cumulative').print_stats(30)
+
+    @property
+    def diagnostics(self):
+        return {'output_queue_size': self._results_queue.qsize(),
+                'ventilation_queue_size': self._ventilator_queue.qsize(),
+                'ventilated_unprocessed': self._ventilated_unprocessed}
+
+    @property
+    def results_qsize(self):
+        return self._results_queue.qsize()

@@ -1,0 +1,145 @@
+"""Ventilator: backpressure-controlled work feeder.
+
+Parity: reference ``petastorm/workers_pool/ventilator.py`` —
+``Ventilator`` ABC (``:26-52``) and ``ConcurrentVentilator`` (``:55-166``):
+runs on its own daemon thread, caps in-flight items at
+``max_ventilation_queue_size``, optionally reshuffles item order every epoch,
+``iterations=None`` means infinite epochs, and exposes the
+``processed_item()`` / ``completed()`` / ``reset()`` protocol.
+
+TPU-first improvement: shuffling is **seeded and reproducible**
+(``random_seed``), unlike the reference's unseeded ``random.shuffle``
+(``ventilator.py:143-144``) — determinism across pod hosts matters for
+synchronized input pipelines (SURVEY.md §7 "Determinism across hosts").
+"""
+
+import random
+import threading
+
+
+class Ventilator(object):
+    def __init__(self, ventilate_fn):
+        self._ventilate_fn = ventilate_fn
+
+    def start(self):
+        raise NotImplementedError
+
+    def processed_item(self):
+        raise NotImplementedError
+
+    def completed(self):
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+    def stop(self):
+        raise NotImplementedError
+
+
+class ConcurrentVentilator(Ventilator):
+    def __init__(self, ventilate_fn, items_to_ventilate,
+                 iterations=1, randomize_item_order=False,
+                 random_seed=None,
+                 max_ventilation_queue_size=None,
+                 ventilation_interval=0.01):
+        """
+        :param ventilate_fn: called with ``**item`` for each ventilated item.
+        :param items_to_ventilate: list of dicts of kwargs.
+        :param iterations: number of epochs; ``None`` = infinite.
+        :param randomize_item_order: reshuffle before each epoch.
+        :param random_seed: seed for reproducible shuffling (``None`` = os random).
+        :param max_ventilation_queue_size: cap on unprocessed in-flight items;
+            defaults to ``len(items_to_ventilate)``.
+        """
+        if iterations is not None and iterations <= 0:
+            raise ValueError('iterations must be positive or None, got {}'.format(iterations))
+        super().__init__(ventilate_fn)
+        self._items_to_ventilate = list(items_to_ventilate)
+        self._iterations = iterations
+        self._iterations_remaining = iterations
+        self._randomize_item_order = randomize_item_order
+        self._rng = random.Random(random_seed)
+        self._max_ventilation_queue_size = (max_ventilation_queue_size
+                                            if max_ventilation_queue_size is not None
+                                            else len(self._items_to_ventilate))
+        self._ventilation_interval = ventilation_interval
+
+        self._current_item_to_ventilate = 0
+        self._in_flight = 0
+        self._in_flight_lock = threading.Lock()
+        self._ventilation_thread = None
+        self._stop_event = threading.Event()
+        self._wakeup = threading.Event()
+        self._completed_flag = threading.Event()
+
+    def start(self):
+        if self._ventilation_thread is not None:
+            raise RuntimeError('Ventilator already started')
+        if not self._items_to_ventilate or (self._iterations is not None and self._iterations == 0):
+            self._completed_flag.set()
+            return
+        if self._randomize_item_order:
+            self._rng.shuffle(self._items_to_ventilate)
+        self._ventilation_thread = threading.Thread(target=self._ventilate, daemon=True)
+        self._ventilation_thread.start()
+
+    def _ventilate(self):
+        while not self._stop_event.is_set():
+            if self._current_item_to_ventilate >= len(self._items_to_ventilate):
+                # Epoch boundary.
+                if self._iterations_remaining is not None:
+                    self._iterations_remaining -= 1
+                    if self._iterations_remaining <= 0:
+                        self._completed_flag.set()
+                        return
+                self._current_item_to_ventilate = 0
+                if self._randomize_item_order:
+                    self._rng.shuffle(self._items_to_ventilate)
+            with self._in_flight_lock:
+                below_cap = self._in_flight < self._max_ventilation_queue_size
+            if below_cap:
+                item = self._items_to_ventilate[self._current_item_to_ventilate]
+                self._current_item_to_ventilate += 1
+                with self._in_flight_lock:
+                    self._in_flight += 1
+                self._ventilate_fn(**item)
+            else:
+                self._wakeup.wait(self._ventilation_interval)
+                self._wakeup.clear()
+
+    def processed_item(self):
+        with self._in_flight_lock:
+            self._in_flight = max(0, self._in_flight - 1)
+        self._wakeup.set()
+
+    def completed(self):
+        return self._completed_flag.is_set()
+
+    def reset(self):
+        """Restart ventilation for another round of `iterations` epochs.
+
+        Parity: reference ``ventilator.py:118-134`` (used by ``Reader.reset()``).
+        """
+        if self._ventilation_thread is not None:
+            if self._completed_flag.is_set():
+                # Completed but possibly still in final teardown — wait it out
+                # rather than spuriously refusing the reset.
+                self._ventilation_thread.join()
+            elif self._ventilation_thread.is_alive():
+                raise RuntimeError('Cannot reset a ventilator that is still ventilating')
+        self._ventilation_thread = None
+        self._iterations_remaining = self._iterations
+        self._current_item_to_ventilate = 0
+        with self._in_flight_lock:
+            self._in_flight = 0
+        self._completed_flag.clear()
+        self._stop_event.clear()
+        self.start()
+
+    def stop(self):
+        self._stop_event.set()
+        self._wakeup.set()
+        if self._ventilation_thread is not None:
+            self._ventilation_thread.join()
+            self._ventilation_thread = None

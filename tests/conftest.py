@@ -1,0 +1,126 @@
+"""Shared fixtures: synthetic datasets written once per session.
+
+Mirrors the reference's fixture strategy (``petastorm/tests/conftest.py`` +
+``test_common.py:97-294``): session-scoped synthetic stores — a full-unischema
+dataset (images, matrices, scalars, nullables, partitioned), a plain-parquet
+scalar dataset, and a many-columns store — generated with pyarrow (no Spark).
+
+JAX runs on a virtual 8-device CPU platform so multi-chip sharding is testable
+without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax import (anywhere in the test process).
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+xla_flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in xla_flags:
+    os.environ['XLA_FLAGS'] = (xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec,
+                                  NdarrayCodec, ScalarCodec)
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+TestSchema = Unischema('TestSchema', [
+    UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField('id2', np.int32, (), ScalarCodec(np.int32), False),
+    UnischemaField('partition_key', np.str_, (), ScalarCodec(np.str_), False),
+    UnischemaField('image_png', np.uint8, (32, 16, 3), CompressedImageCodec('png'), False),
+    UnischemaField('matrix', np.float32, (4, 5), NdarrayCodec(), False),
+    UnischemaField('matrix_compressed', np.float64, (3, 3), CompressedNdarrayCodec(), False),
+    UnischemaField('varlen', np.int64, (None,), NdarrayCodec(), False),
+    UnischemaField('sensor_name', np.str_, (), ScalarCodec(np.str_), False),
+    UnischemaField('nullable_field', np.int32, (), ScalarCodec(np.int32), True),
+])
+
+
+def _row(i, rng):
+    return {
+        'id': i,
+        'id2': i % 5,
+        'partition_key': 'p_{}'.format(i % 4),
+        'image_png': rng.integers(0, 255, (32, 16, 3), dtype=np.uint8),
+        'matrix': rng.random((4, 5), dtype=np.float32),
+        'matrix_compressed': rng.random((3, 3)),
+        'varlen': np.arange(i % 7 + 1, dtype=np.int64),
+        'sensor_name': 'sensor_{}'.format(i % 3),
+        'nullable_field': None if i % 3 == 0 else i * 2,
+    }
+
+
+ROWS_COUNT = 50
+
+
+@pytest.fixture(scope='session')
+def synthetic_dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('synthetic') / 'dataset'
+    url = 'file://' + str(path)
+    rng = np.random.default_rng(42)
+    rows = [_row(i, rng) for i in range(ROWS_COUNT)]
+    write_dataset(url, TestSchema, rows, rows_per_row_group=10)
+
+    class _Dataset:
+        pass
+
+    ds = _Dataset()
+    ds.url = url
+    ds.path = str(path)
+    ds.data = rows
+    return ds
+
+
+@pytest.fixture(scope='session')
+def scalar_dataset(tmp_path_factory):
+    """Plain Parquet store with no unischema metadata (for make_batch_reader)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = tmp_path_factory.mktemp('scalar') / 'dataset'
+    os.makedirs(path, exist_ok=True)
+    rng = np.random.default_rng(0)
+    n = 100
+    table = pa.table({
+        'id': pa.array(np.arange(n, dtype=np.int64)),
+        'float_col': pa.array(rng.random(n)),
+        'int_fixed': pa.array(rng.integers(0, 100, n, dtype=np.int32)),
+        'string_col': pa.array(['value_{}'.format(i % 10) for i in range(n)]),
+        'list_col': pa.array([[float(i), float(i + 1)] for i in range(n)]),
+    })
+    pq.write_table(table, str(path / 'data.parquet'), row_group_size=20)
+
+    class _Dataset:
+        pass
+
+    ds = _Dataset()
+    ds.url = 'file://' + str(path)
+    ds.path = str(path)
+    ds.table = table
+    return ds
+
+
+@pytest.fixture(scope='session')
+def partitioned_synthetic_dataset(tmp_path_factory):
+    """Unischema dataset hive-partitioned by partition_key."""
+    path = tmp_path_factory.mktemp('partitioned') / 'dataset'
+    url = 'file://' + str(path)
+    rng = np.random.default_rng(7)
+    rows = [_row(i, rng) for i in range(ROWS_COUNT)]
+    write_dataset(url, TestSchema, rows, rows_per_row_group=5,
+                  partition_fields=('partition_key',))
+
+    class _Dataset:
+        pass
+
+    ds = _Dataset()
+    ds.url = url
+    ds.path = str(path)
+    ds.data = rows
+    return ds
+
+
+def pytest_configure(config):
+    config.addinivalue_line('markers', 'processpool: spawns real worker processes (slower)')

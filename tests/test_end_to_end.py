@@ -1,0 +1,237 @@
+"""End-to-end reader tests over pool flavors.
+
+Parity: reference ``petastorm/tests/test_end_to_end.py`` — factory-parametrized
+over dummy/thread pools and batch readers; covers round-trip equality,
+predicates, sharding disjointness, shuffle, epochs/reset, transforms, cache.
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import TransformSpec, make_batch_reader, make_reader
+from petastorm_tpu.errors import NoDataAvailableError
+from petastorm_tpu.predicates import in_lambda, in_set
+
+# Reader factories parametrizing the pool flavors (reference test_end_to_end.py:37-53)
+READER_FACTORIES = [
+    lambda url, **kw: make_reader(url, reader_pool_type='dummy', **kw),
+    lambda url, **kw: make_reader(url, reader_pool_type='thread', workers_count=3, **kw),
+]
+
+
+def _rows_by_id(reader):
+    return {row.id: row for row in reader}
+
+
+@pytest.mark.parametrize('reader_factory', READER_FACTORIES)
+def test_full_round_trip(synthetic_dataset, reader_factory):
+    with reader_factory(synthetic_dataset.url) as reader:
+        seen = _rows_by_id(reader)
+    assert len(seen) == len(synthetic_dataset.data)
+    for expected in synthetic_dataset.data:
+        actual = seen[expected['id']]
+        np.testing.assert_array_equal(actual.image_png, expected['image_png'])
+        np.testing.assert_array_equal(actual.matrix, expected['matrix'])
+        np.testing.assert_array_equal(actual.varlen, expected['varlen'])
+        assert actual.sensor_name == expected['sensor_name']
+        if expected['nullable_field'] is None:
+            assert actual.nullable_field is None
+        else:
+            assert actual.nullable_field == expected['nullable_field']
+
+
+@pytest.mark.parametrize('reader_factory', READER_FACTORIES)
+def test_schema_fields_subset(synthetic_dataset, reader_factory):
+    with reader_factory(synthetic_dataset.url, schema_fields=['id', 'matrix']) as reader:
+        row = next(reader)
+        assert set(row._fields) == {'id', 'matrix'}
+
+
+def test_schema_fields_regex(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     schema_fields=['id.*']) as reader:
+        row = next(reader)
+        assert set(row._fields) == {'id', 'id2'}
+
+
+@pytest.mark.parametrize('reader_factory', READER_FACTORIES)
+def test_predicate(synthetic_dataset, reader_factory):
+    with reader_factory(synthetic_dataset.url,
+                        predicate=in_lambda(['id'], lambda v: v['id'] % 2 == 0)) as reader:
+        ids = {row.id for row in reader}
+    assert ids == {r['id'] for r in synthetic_dataset.data if r['id'] % 2 == 0}
+
+
+def test_predicate_on_partition_prunes(partitioned_synthetic_dataset):
+    with make_reader(partitioned_synthetic_dataset.url, reader_pool_type='dummy',
+                     predicate=in_set({'p_1'}, 'partition_key')) as reader:
+        rows = list(reader)
+    expected = [r for r in partitioned_synthetic_dataset.data if r['partition_key'] == 'p_1']
+    assert {r.id for r in rows} == {r['id'] for r in expected}
+    assert all(r.partition_key == 'p_1' for r in rows)
+
+
+def test_partitioned_round_trip(partitioned_synthetic_dataset):
+    with make_reader(partitioned_synthetic_dataset.url, reader_pool_type='thread',
+                     workers_count=2) as reader:
+        seen = _rows_by_id(reader)
+    assert len(seen) == len(partitioned_synthetic_dataset.data)
+    for expected in partitioned_synthetic_dataset.data:
+        assert seen[expected['id']].partition_key == expected['partition_key']
+
+
+def test_sharding_disjoint_union(synthetic_dataset):
+    """Multi-node sharding tested single-process (reference ``:426-448``)."""
+    all_ids = []
+    shard_count = 3
+    for shard in range(shard_count):
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         cur_shard=shard, shard_count=shard_count,
+                         shuffle_row_groups=False) as reader:
+            ids = [row.id for row in reader]
+        assert ids, 'shard {} got no data'.format(shard)
+        all_ids.extend(ids)
+    assert sorted(all_ids) == sorted(r['id'] for r in synthetic_dataset.data)
+
+
+def test_too_many_shards_raises(synthetic_dataset):
+    with pytest.raises(NoDataAvailableError):
+        make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                    cur_shard=999, shard_count=1000)
+
+
+def test_num_epochs(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     num_epochs=3, shuffle_row_groups=False) as reader:
+        rows = list(reader)
+    assert len(rows) == 3 * len(synthetic_dataset.data)
+
+
+def test_reset_after_epoch(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     num_epochs=1, shuffle_row_groups=False) as reader:
+        first = [r.id for r in reader]
+        reader.reset()
+        second = [r.id for r in reader]
+    assert first == second
+
+
+def test_shuffle_changes_order(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     shuffle_row_groups=False) as reader:
+        ordered = [r.id for r in reader]
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     shuffle_row_groups=True, seed=123) as reader:
+        shuffled = [r.id for r in reader]
+    assert sorted(ordered) == sorted(shuffled)
+    assert ordered != shuffled
+
+
+def test_shuffle_seed_reproducible(synthetic_dataset):
+    def read(seed):
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         shuffle_row_groups=True, seed=seed) as reader:
+            return [r.id for r in reader]
+
+    assert read(7) == read(7)
+    assert read(7) != read(8)
+
+
+@pytest.mark.parametrize('reader_factory', READER_FACTORIES)
+def test_transform_spec(synthetic_dataset, reader_factory):
+    def double_id(row):
+        row['id'] = row['id'] * 2
+        return row
+
+    spec = TransformSpec(double_id)
+    with reader_factory(synthetic_dataset.url, schema_fields=['id'],
+                        transform_spec=spec) as reader:
+        ids = sorted(row.id for row in reader)
+    assert ids == sorted(r['id'] * 2 for r in synthetic_dataset.data)
+
+
+def test_transform_spec_removes_field(synthetic_dataset):
+    def drop_matrix(row):
+        del row['matrix']
+        return row
+
+    spec = TransformSpec(drop_matrix, removed_fields=['matrix'])
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     schema_fields=['id', 'matrix'], transform_spec=spec) as reader:
+        row = next(reader)
+    assert set(row._fields) == {'id'}
+
+
+def test_shuffle_row_drop_partitions(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     shuffle_row_drop_partitions=2) as reader:
+        ids = sorted(r.id for r in reader)
+    assert ids == sorted(r['id'] for r in synthetic_dataset.data)
+
+
+def test_local_disk_cache(synthetic_dataset, tmp_path):
+    for _ in range(2):  # second pass hits the cache
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         cache_type='local-disk', cache_location=str(tmp_path),
+                         shuffle_row_groups=False) as reader:
+            ids = sorted(r.id for r in reader)
+        assert ids == sorted(r['id'] for r in synthetic_dataset.data)
+    assert any(tmp_path.iterdir()), 'cache directory is empty'
+
+
+def test_stopped_reader_raises(synthetic_dataset):
+    reader = make_reader(synthetic_dataset.url, reader_pool_type='dummy')
+    reader.stop()
+    reader.join()
+    with pytest.raises(RuntimeError):
+        next(reader)
+
+
+# --- batch reader (plain parquet) -----------------------------------------
+
+def test_batch_reader_round_trip(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                           shuffle_row_groups=False) as reader:
+        batches = list(reader)
+    assert all(reader.batched_output for _ in [0])
+    ids = np.concatenate([b.id for b in batches])
+    assert sorted(ids.tolist()) == list(range(100))
+    floats = np.concatenate([b.float_col for b in batches])
+    assert floats.dtype == np.float64
+    lists = np.concatenate([b.list_col for b in batches])
+    assert lists.shape == (100, 2)
+
+
+def test_batch_reader_thread_pool(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='thread',
+                           workers_count=3) as reader:
+        total = sum(len(b.id) for b in reader)
+    assert total == 100
+
+
+def test_batch_reader_schema_fields(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                           schema_fields=['id', 'string_col']) as reader:
+        batch = next(reader)
+    assert set(batch._fields) == {'id', 'string_col'}
+
+
+def test_batch_reader_predicate(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                           predicate=in_lambda(['id'], lambda v: v['id'] < 10)) as reader:
+        ids = np.concatenate([b.id for b in reader])
+    assert sorted(ids.tolist()) == list(range(10))
+
+
+def test_batch_reader_transform(scalar_dataset):
+    spec = TransformSpec(lambda df: df.assign(id=df.id + 1000),
+                         selected_fields=['id'])
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                           transform_spec=spec) as reader:
+        ids = np.concatenate([b.id for b in reader])
+    assert sorted(ids.tolist()) == [i + 1000 for i in range(100)]
+
+
+def test_make_reader_on_plain_parquet_raises(scalar_dataset):
+    with pytest.raises(RuntimeError):
+        make_reader(scalar_dataset.url)

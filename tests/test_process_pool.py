@@ -1,0 +1,66 @@
+"""ProcessPool end-to-end tests (zmq transport, spawned workers).
+
+Parity: reference process-pool coverage in
+``workers_pool/tests/test_workers_pool.py`` + ``tests/test_end_to_end.py``
+process-pool parametrization.
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.workers import EmptyResultError, WorkerBase
+from petastorm_tpu.workers.process_pool import ProcessPool
+from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+
+pytestmark = pytest.mark.processpool
+
+
+class EchoWorker(WorkerBase):
+    def process(self, value):
+        self.publish_func([value * 2])
+
+
+class FailingWorker(WorkerBase):
+    def process(self, value):
+        raise ValueError('boom {}'.format(value))
+
+
+def test_process_pool_basic():
+    pool = ProcessPool(2)
+    ventilator = ConcurrentVentilator(None, [{'value': i} for i in range(20)],
+                                      iterations=1)
+    pool.start(EchoWorker, None, ventilator)
+    results = []
+    with pytest.raises(EmptyResultError):
+        while True:
+            results.extend(pool.get_results())
+    pool.stop()
+    pool.join()
+    assert sorted(results) == [i * 2 for i in range(20)]
+
+
+def test_process_pool_exception_propagates():
+    pool = ProcessPool(2)
+    ventilator = ConcurrentVentilator(None, [{'value': i} for i in range(4)],
+                                      iterations=1)
+    pool.start(FailingWorker, None, ventilator)
+    with pytest.raises(ValueError, match='boom'):
+        while True:
+            pool.get_results()
+
+
+def test_make_reader_process_pool(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='process',
+                     workers_count=2) as reader:
+        seen = {row.id: row for row in reader}
+    assert len(seen) == len(synthetic_dataset.data)
+    expected = synthetic_dataset.data[7]
+    np.testing.assert_array_equal(seen[expected['id']].image_png, expected['image_png'])
+
+
+def test_make_batch_reader_process_pool(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='process',
+                           workers_count=2) as reader:
+        total = sum(len(b.id) for b in reader)
+    assert total == 100

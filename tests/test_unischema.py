@@ -1,0 +1,157 @@
+"""Schema/codec unit tests (parity: reference ``tests/test_unischema.py``,
+``test_codec_*.py``)."""
+
+import json
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec,
+                                  NdarrayCodec, ScalarCodec)
+from petastorm_tpu.errors import SchemaError
+from petastorm_tpu.unischema import (Unischema, UnischemaField, decode_row,
+                                     encode_row, insert_explicit_nulls,
+                                     match_unischema_fields)
+
+
+def _schema():
+    return Unischema('S', [
+        UnischemaField('int_field', np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField('string_field', np.str_, (), ScalarCodec(np.str_), False),
+        UnischemaField('matrix', np.float32, (2, 3), NdarrayCodec(), False),
+        UnischemaField('image', np.uint8, (8, 8, 3), CompressedImageCodec('png'), False),
+        UnischemaField('opt', np.int32, (), ScalarCodec(np.int32), True),
+    ])
+
+
+def test_fields_sorted_and_attr_access():
+    s = _schema()
+    assert list(s.fields) == sorted(s.fields)
+    assert s.int_field.numpy_dtype == np.int64
+    with pytest.raises(AttributeError):
+        s.nonexistent_field
+
+
+def test_create_schema_view_by_field_and_regex():
+    s = _schema()
+    v1 = s.create_schema_view([s.int_field])
+    assert list(v1.fields) == ['int_field']
+    v2 = s.create_schema_view(['.*_field'])
+    assert list(v2.fields) == ['int_field', 'string_field']
+    with pytest.raises(SchemaError):
+        s.create_schema_view(['no_such_.*'])
+
+
+def test_regex_is_fullmatch():
+    s = _schema()
+    # 'int' alone must not match 'int_field' (full-match semantics)
+    with pytest.raises(SchemaError):
+        s.create_schema_view(['int'])
+
+
+def test_namedtuple_type_is_cached():
+    s = _schema()
+    assert s.namedtuple_type() is s.namedtuple_type()
+    row = s.make_namedtuple(int_field=1, string_field='a',
+                            matrix=np.zeros((2, 3), np.float32),
+                            image=np.zeros((8, 8, 3), np.uint8), opt=None)
+    assert row.int_field == 1
+
+
+def test_json_round_trip():
+    s = _schema()
+    restored = Unischema.from_json(json.loads(json.dumps(s.to_json())))
+    assert list(restored.fields) == list(s.fields)
+    for name in s.fields:
+        assert restored.fields[name] == s.fields[name]
+        assert restored.fields[name].codec == s.fields[name].codec
+
+
+def test_encode_decode_round_trip():
+    s = _schema()
+    rng = np.random.default_rng(0)
+    row = {'int_field': 42, 'string_field': 'hello',
+           'matrix': rng.random((2, 3), dtype=np.float32),
+           'image': rng.integers(0, 255, (8, 8, 3), dtype=np.uint8),
+           'opt': None}
+    encoded = encode_row(s, row)
+    assert isinstance(encoded['matrix'], bytes)
+    assert isinstance(encoded['image'], bytes)
+    decoded = decode_row(encoded, s)
+    np.testing.assert_array_equal(decoded['matrix'], row['matrix'])
+    np.testing.assert_array_equal(decoded['image'], row['image'])  # png lossless
+    assert decoded['int_field'] == 42
+    assert decoded['opt'] is None
+
+
+def test_encode_shape_mismatch_raises():
+    s = _schema()
+    with pytest.raises(ValueError):
+        encode_row(s, {'int_field': 1, 'string_field': 'x',
+                       'matrix': np.zeros((3, 3), np.float32),
+                       'image': np.zeros((8, 8, 3), np.uint8)})
+
+
+def test_encode_missing_non_nullable_raises():
+    s = _schema()
+    with pytest.raises(ValueError):
+        encode_row(s, {'int_field': 1})
+
+
+def test_insert_explicit_nulls():
+    s = Unischema('S', [UnischemaField('a', np.int32, (), None, True)])
+    row = {}
+    insert_explicit_nulls(s, row)
+    assert row == {'a': None}
+
+
+def test_compressed_ndarray_round_trip():
+    f = UnischemaField('m', np.float64, (3, 3), CompressedNdarrayCodec(), False)
+    value = np.eye(3)
+    codec = f.codec
+    np.testing.assert_array_equal(codec.decode(f, codec.encode(f, value)), value)
+
+
+def test_jpeg_codec_lossy_round_trip():
+    f = UnischemaField('img', np.uint8, (16, 16, 3), CompressedImageCodec('jpeg', 90), False)
+    value = np.full((16, 16, 3), 128, dtype=np.uint8)
+    decoded = f.codec.decode(f, f.codec.encode(f, value))
+    assert decoded.shape == (16, 16, 3)
+    assert np.abs(decoded.astype(int) - 128).mean() < 10
+
+
+def test_variable_shape_field():
+    f = UnischemaField('v', np.int64, (None,), NdarrayCodec(), False)
+    codec = f.codec
+    for n in (0, 1, 5):
+        v = np.arange(n, dtype=np.int64)
+        np.testing.assert_array_equal(codec.decode(f, codec.encode(f, v)), v)
+
+
+def test_from_arrow_schema():
+    arrow = pa.schema([
+        pa.field('a', pa.int64()),
+        pa.field('b', pa.float32()),
+        pa.field('c', pa.string()),
+        pa.field('d', pa.list_(pa.int32())),
+    ])
+    s = Unischema.from_arrow_schema(arrow)
+    assert s.fields['a'].numpy_dtype == np.int64
+    assert s.fields['b'].numpy_dtype == np.float32
+    assert s.fields['c'].numpy_dtype == np.dtype('O')
+    assert s.fields['d'].shape == (None,)
+    assert s.fields['d'].numpy_dtype == np.int32
+
+
+def test_match_unischema_fields_mixed():
+    s = _schema()
+    fields = match_unischema_fields(s, ['int_field', s.matrix])
+    assert {f.name for f in fields} == {'int_field', 'matrix'}
+
+
+def test_field_equality_ignores_codec():
+    a = UnischemaField('x', np.int32, (), ScalarCodec(np.int32), False)
+    b = UnischemaField('x', np.int32, (), None, False)
+    assert a == b
+    assert hash(a) == hash(b)
