@@ -83,11 +83,16 @@ def test_ventilator_backpressure():
         time.sleep(0.01)
     time.sleep(0.1)  # give it a chance to (wrongly) exceed the cap
     assert len(ventilated) == 5  # capped until processed_item() calls
-    for _ in range(100):
-        ventilator.processed_item()
-    deadline = time.monotonic() + 5
-    while len(ventilated) < 100 and time.monotonic() < deadline:
-        time.sleep(0.01)
+    # Acknowledge items as they arrive (credits are not banked ahead of
+    # in-flight items — the counter floors at zero).
+    acked = 0
+    deadline = time.monotonic() + 10
+    while acked < 100 and time.monotonic() < deadline:
+        if acked < len(ventilated):
+            ventilator.processed_item()
+            acked += 1
+        else:
+            time.sleep(0.001)
     assert len(ventilated) == 100
     ventilator.stop()
 
