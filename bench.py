@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Throughput benchmark. Prints ONE JSON line.
+
+Workload parity: the reference's benchmark tutorial measures its hello_world
+dataset read rate (``docs/benchmarks_tutorial.rst:20-21`` -> 709.84
+samples/sec; harness ``petastorm/benchmark/throughput.py``). This bench
+recreates the same schema (id + 128x256x3 png image + 4-D uint8 ndarray,
+``examples/hello_world/petastorm_dataset/generate_petastorm_dataset.py:29-62``)
+and measures our reader's decoded-samples/sec through a thread pool, then the
+JAX device-staging path.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_BASELINE_SAMPLES_PER_SEC = 709.84  # docs/benchmarks_tutorial.rst:20-21
+_DATASET_DIR = '/tmp/petastorm_tpu_bench_dataset'
+_ROWS = 400
+_WARMUP_SAMPLES = 200
+_MEASURE_SAMPLES = 2000
+
+
+def _ensure_dataset():
+    from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    marker = os.path.join(_DATASET_DIR, '_common_metadata')
+    if os.path.exists(marker):
+        return 'file://' + _DATASET_DIR
+
+    schema = Unischema('HelloWorldSchema', [
+        UnischemaField('id', np.int32, (), ScalarCodec(np.int32), False),
+        UnischemaField('image1', np.uint8, (128, 256, 3), CompressedImageCodec('png'), False),
+        UnischemaField('array_4d', np.uint8, (None, 128, 30, None), NdarrayCodec(), False),
+    ])
+    rng = np.random.default_rng(0)
+
+    def rows():
+        for i in range(_ROWS):
+            yield {'id': i,
+                   'image1': rng.integers(0, 255, (128, 256, 3), dtype=np.uint8),
+                   'array_4d': rng.integers(0, 255, (4, 128, 30, 3), dtype=np.uint8)}
+
+    write_dataset('file://' + _DATASET_DIR, schema, rows(), rows_per_row_group=32)
+    return 'file://' + _DATASET_DIR
+
+
+def _measure_reader(url, workers):
+    """Decoded samples/sec through make_reader + thread pool (the reference's
+    benchmark quantity)."""
+    from petastorm_tpu import make_reader
+
+    with make_reader(url, reader_pool_type='thread', workers_count=workers,
+                     num_epochs=None, shuffle_row_groups=True, seed=0) as reader:
+        for _ in range(_WARMUP_SAMPLES):
+            next(reader)
+        start = time.perf_counter()
+        for _ in range(_MEASURE_SAMPLES):
+            next(reader)
+        elapsed = time.perf_counter() - start
+    return _MEASURE_SAMPLES / elapsed
+
+
+def _measure_jax_staging(url, workers):
+    """Batches staged to the default JAX device (TPU when present)."""
+    try:
+        import jax
+
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.jax_loader import JaxLoader, PadTo
+
+        batch = 32
+        n_batches = 40
+        with make_reader(url, reader_pool_type='thread', workers_count=workers,
+                         num_epochs=None, shuffle_row_groups=True, seed=0) as reader:
+            with JaxLoader(reader, batch,
+                           shape_policies={'array_4d': PadTo((4, 128, 30, 3))}) as loader:
+                first = next(loader)          # warmup + compile-free staging
+                jax.block_until_ready(first.image1)
+                start = time.perf_counter()
+                got = 0
+                for b in loader:
+                    jax.block_until_ready(b.image1)
+                    got += 1
+                    if got >= n_batches:
+                        break
+                elapsed = time.perf_counter() - start
+        return batch * got / elapsed
+    except Exception as e:  # noqa: BLE001 - staging is a secondary metric
+        print('jax staging measurement failed: {}'.format(e), file=sys.stderr)
+        return None
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import psutil
+    workers = min(10, (psutil.cpu_count(logical=True) or 4))
+
+    url = _ensure_dataset()
+    reader_rate = _measure_reader(url, workers)
+    staging_rate = _measure_jax_staging(url, workers)
+
+    result = {
+        'metric': 'hello_world_samples_per_sec',
+        'value': round(reader_rate, 2),
+        'unit': 'samples/s',
+        'vs_baseline': round(reader_rate / _BASELINE_SAMPLES_PER_SEC, 3),
+    }
+    if staging_rate is not None:
+        result['jax_staged_samples_per_sec'] = round(staging_rate, 2)
+    print(json.dumps(result))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,425 @@
+"""JAX loader: the TPU-native framework adapter (the point of the project).
+
+The reference feeds TF via ``tf_utils.py`` and torch via ``pytorch.py``
+(SURVEY.md §2.6). This module is their TPU equivalent, designed per
+SURVEY.md §7.6:
+
+  * fixed-size batch re-chunking of row-group output (the reference's
+    ``BatchingTableQueue`` idea, ``pyarrow_helpers/batching_table_queue.py``),
+  * optional seeded row-level shuffling (``RandomShufflingBuffer``),
+  * dtype sanitization to TPU-supported dtypes (cf. ``pytorch.py:36-66`` /
+    ``tf_utils.py:58-97``),
+  * ragged-field shape policies (pad/crop) because XLA needs static shapes —
+    a decision the reference never had to make (SURVEY.md §7 "Hard parts"),
+  * device staging: ``jax.make_array_from_process_local_data`` onto a
+    ``Mesh``-sharded layout (each pod host contributes its disjoint reader
+    shard), or plain ``device_put`` single-chip,
+  * a double-buffered background prefetcher so host->HBM transfer of batch
+    N+1 hides under XLA step N.
+"""
+
+import logging
+import queue
+import threading
+import warnings
+from collections import namedtuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_END = object()
+
+
+# --------------------------------------------------------------------------
+# shape policies
+# --------------------------------------------------------------------------
+
+class ShapePolicy(object):
+    """How to give a ragged field a static shape."""
+
+    def apply(self, array):
+        raise NotImplementedError
+
+
+class PadTo(ShapePolicy):
+    """Pad (and clip) every sample to ``target_shape`` with ``fill_value``."""
+
+    def __init__(self, target_shape, fill_value=0):
+        self.target_shape = tuple(target_shape)
+        self.fill_value = fill_value
+
+    def apply(self, array):
+        array = np.asarray(array)
+        if array.shape == self.target_shape:
+            return array
+        out = np.full(self.target_shape, self.fill_value, dtype=array.dtype)
+        slices = tuple(slice(0, min(a, t)) for a, t in zip(array.shape, self.target_shape))
+        out[slices] = array[slices]
+        return out
+
+
+class CropTo(ShapePolicy):
+    """Center-crop every sample to ``target_shape`` (must fit)."""
+
+    def __init__(self, target_shape):
+        self.target_shape = tuple(target_shape)
+
+    def apply(self, array):
+        array = np.asarray(array)
+        if array.shape == self.target_shape:
+            return array
+        starts = [(a - t) // 2 for a, t in zip(array.shape, self.target_shape)]
+        if any(s < 0 for s in starts):
+            raise ValueError('CropTo{}: sample shape {} too small'.format(
+                self.target_shape, array.shape))
+        slices = tuple(slice(s, s + t) for s, t in zip(starts, self.target_shape))
+        return array[slices]
+
+
+# --------------------------------------------------------------------------
+# dtype sanitization
+# --------------------------------------------------------------------------
+
+def _sanitize_dtype(np_dtype, x64=False):
+    """Map a numpy dtype to its TPU-friendly dtype (or None if unsupported).
+
+    Parity role: reference ``pytorch.py:36-66`` / ``tf_utils.py:58-97``.
+    """
+    np_dtype = np.dtype(np_dtype)
+    if np_dtype.kind in ('O', 'U', 'S'):
+        return None
+    if np_dtype.kind == 'M':
+        # datetime64 -> ns-epoch int64. Without x64 the values cannot be
+        # represented (int32 would wrap) — treat as unsupported rather than
+        # silently corrupt.
+        return np.dtype('int64') if x64 else None
+    if not x64:
+        if np_dtype == np.float64:
+            return np.dtype('float32')
+        if np_dtype == np.int64:
+            return np.dtype('int32')
+        if np_dtype == np.uint64:
+            return np.dtype('uint32')
+    return np_dtype
+
+
+def _sanitize_array(array, x64=False):
+    array = np.asarray(array)
+    target = _sanitize_dtype(array.dtype, x64)
+    if target is None:
+        return None
+    if array.dtype.kind == 'M':
+        array = array.astype('datetime64[ns]').astype(np.int64)
+    return np.ascontiguousarray(array.astype(target, copy=False))
+
+
+# --------------------------------------------------------------------------
+# host-side batch assembly (no jax dependency — independently testable)
+# --------------------------------------------------------------------------
+
+def iter_numpy_batches(reader, batch_size, shape_policies=None,
+                       shuffling_queue_capacity=0, min_after_dequeue=None,
+                       seed=None, last_batch='drop', x64=False):
+    """Yield dicts of numpy arrays with exact leading dim ``batch_size``.
+
+    Works over both row readers (``make_reader``) and batch readers
+    (``make_batch_reader``); re-chunks row-group-sized output into fixed
+    batches. ``last_batch``: 'drop' | 'pad' (repeat-pad the final partial
+    batch) | 'partial' (yield it short).
+    """
+    if last_batch not in ('drop', 'pad', 'partial'):
+        raise ValueError("last_batch must be drop|pad|partial, got {!r}".format(last_batch))
+    shape_policies = dict(shape_policies or {})
+
+    field_names = None
+    dropped = set()
+    columns = {}
+    count = 0
+
+    shuffler = None
+    if shuffling_queue_capacity and shuffling_queue_capacity > 0:
+        from petastorm_tpu.shuffling_buffer import RandomShufflingBuffer
+        if min_after_dequeue is None:
+            min_after_dequeue = shuffling_queue_capacity * 4 // 5
+        shuffler = RandomShufflingBuffer(shuffling_queue_capacity,
+                                         min_after_dequeue, seed=seed,
+                                         extra_capacity=100000)
+
+    def _is_tensor_like(probe, name):
+        """True if a sample value can become a TPU tensor (possibly via policy)."""
+        if probe is None:
+            # Field with None values cannot batch; dropped with a warning.
+            # (A later None in a kept field raises a clear error in
+            # _stack_column.) Fill nullables via TransformSpec to keep them.
+            return False
+        arr = np.asarray(probe)
+        if arr.dtype.kind not in ('O', 'U', 'S'):
+            return True
+        # Object values may still be numeric ndarrays (ragged) — keep when a
+        # shape policy exists, or when the payload itself is numeric.
+        if isinstance(probe, np.ndarray) and probe.dtype.kind not in ('O', 'U', 'S'):
+            return True
+        return name in shape_policies
+
+    def select_fields(sample):
+        nonlocal field_names
+        names = []
+        for name in sample._fields:
+            value = getattr(sample, name)
+            if reader.batched_output:
+                column = np.asarray(value)
+                probe = column[0] if (column.dtype.kind == 'O' and len(column)) else column
+            else:
+                probe = value
+            if _is_tensor_like(probe, name):
+                names.append(name)
+            else:
+                dropped.add(name)
+        if dropped:
+            warnings.warn('jax loader dropping non-tensor fields: {} '
+                          '(select fields explicitly or add a TransformSpec '
+                          'to keep them)'.format(sorted(dropped)))
+        field_names = names
+
+    def to_rows(sample):
+        """Batched sample -> per-row tuples (reference pytorch.py:166-175)."""
+        cols = [getattr(sample, n) for n in field_names]
+        return list(zip(*cols))
+
+    def add_sample_columns(sample):
+        nonlocal count
+        for name in field_names:
+            value = getattr(sample, name)
+            columns.setdefault(name, []).append(value)
+        count += 1
+
+    def emit_batches(final=False):
+        nonlocal columns, count
+        while count >= batch_size:
+            batch = {}
+            for name in field_names:
+                batch[name] = _stack_column(columns[name][:batch_size], name,
+                                            shape_policies, x64)
+                columns[name] = columns[name][batch_size:]
+            count -= batch_size
+            yield batch
+        if final and count:
+            if last_batch == 'drop':
+                columns = {}
+                count = 0
+            elif last_batch in ('pad', 'partial'):
+                batch = {}
+                for name in field_names:
+                    col = columns[name]
+                    if last_batch == 'pad':
+                        col = col + [col[-1]] * (batch_size - len(col))
+                    batch[name] = _stack_column(col, name, shape_policies, x64)
+                columns = {}
+                count = 0
+                yield batch
+
+    for sample in reader:
+        if field_names is None:
+            select_fields(sample)
+        if reader.batched_output:
+            rows = to_rows(sample)
+        else:
+            rows = [tuple(getattr(sample, n) for n in field_names)]
+        if shuffler is not None:
+            shuffler.add_many(rows)
+            while shuffler.can_retrieve():
+                row = shuffler.retrieve()
+                for name, value in zip(field_names, row):
+                    columns.setdefault(name, []).append(value)
+                count += 1
+                if count >= batch_size:
+                    yield from emit_batches()
+        else:
+            for row in rows:
+                for name, value in zip(field_names, row):
+                    columns.setdefault(name, []).append(value)
+                count += 1
+            yield from emit_batches()
+
+    if shuffler is not None:
+        shuffler.finish()
+        while shuffler.can_retrieve():
+            row = shuffler.retrieve()
+            for name, value in zip(field_names, row):
+                columns.setdefault(name, []).append(value)
+            count += 1
+        yield from emit_batches(final=True)
+    else:
+        yield from emit_batches(final=True)
+
+
+def _stack_column(values, name, shape_policies, x64):
+    if any(v is None for v in values):
+        raise ValueError(
+            'Field {!r} contains None (nullable) values; fill or drop them with a '
+            'TransformSpec before batching for TPU'.format(name))
+    policy = shape_policies.get(name)
+    if policy is not None:
+        values = [policy.apply(v) for v in values]
+    try:
+        stacked = np.stack([np.asarray(v) for v in values])
+    except ValueError as e:
+        raise ValueError(
+            'Field {!r} has ragged shapes and no shape policy; pass '
+            "shape_policies={{'{}': PadTo(...)}} or CropTo(...): {}".format(
+                name, name, e)) from e
+    sanitized = _sanitize_array(stacked, x64)
+    if sanitized is None:
+        raise ValueError('Field {!r} dtype {} is not TPU-compatible'.format(
+            name, stacked.dtype))
+    return sanitized
+
+
+# --------------------------------------------------------------------------
+# device staging + prefetch
+# --------------------------------------------------------------------------
+
+class JaxLoader(object):
+    """Iterates mesh-sharded ``jax.Array`` batches off a Reader.
+
+    :param reader: a ``make_reader``/``make_batch_reader`` Reader (each pod
+        host should construct it with ``cur_shard=jax.process_index()``).
+    :param batch_size: **global** batch size when ``mesh``/``sharding`` is
+        given (each host contributes ``batch_size / process_count`` rows);
+        plain host batch size otherwise.
+    :param mesh: ``jax.sharding.Mesh`` — batches are sharded over its 'data'
+        axis (override via ``sharding``).
+    :param sharding: explicit ``NamedSharding`` (or dict field->sharding).
+    :param prefetch: device batches staged ahead (double-buffering default 2).
+    :param shape_policies: dict field -> ShapePolicy for ragged fields.
+    :param last_batch: 'drop' (pod-safe default) | 'pad' | 'partial'.
+    """
+
+    def __init__(self, reader, batch_size, mesh=None, sharding=None,
+                 batch_axis='data', prefetch=2, shape_policies=None,
+                 shuffling_queue_capacity=0, min_after_dequeue=None, seed=None,
+                 last_batch='drop'):
+        import jax
+
+        self._reader = reader
+        self._mesh = mesh
+        self._sharding = sharding
+        self._batch_axis = batch_axis
+        self._jax = jax
+        x64 = bool(jax.config.jax_enable_x64)
+
+        if mesh is not None or sharding is not None:
+            n_proc = jax.process_count()
+            if batch_size % n_proc:
+                raise ValueError('global batch_size {} not divisible by process_count {}'
+                                 .format(batch_size, n_proc))
+            local_batch = batch_size // n_proc
+        else:
+            local_batch = batch_size
+        self._global_batch = batch_size
+        self._local_batch = local_batch
+
+        if last_batch == 'partial' and (mesh is not None or sharding is not None):
+            raise ValueError("last_batch='partial' breaks fixed global shapes on a mesh; "
+                             "use 'drop' or 'pad'")
+
+        self._host_iter = iter_numpy_batches(
+            reader, local_batch, shape_policies=shape_policies,
+            shuffling_queue_capacity=shuffling_queue_capacity,
+            min_after_dequeue=min_after_dequeue, seed=seed,
+            last_batch=last_batch, x64=x64)
+
+        self._queue = queue.Queue(maxsize=max(1, prefetch))
+        self._stop = threading.Event()
+        self._exhausted = False
+        self._thread = threading.Thread(target=self._stage_loop, daemon=True)
+        self._thread.start()
+        self._namedtuple_cache = {}
+
+    # -- staging thread --------------------------------------------------
+
+    def _field_sharding(self, name):
+        if self._sharding is not None:
+            if isinstance(self._sharding, dict):
+                return self._sharding[name]
+            return self._sharding
+        from petastorm_tpu.parallel.mesh import batch_sharding
+        return batch_sharding(self._mesh, self._batch_axis)
+
+    def _stage(self, host_batch):
+        jax = self._jax
+        out = {}
+        for name, array in host_batch.items():
+            if self._mesh is not None or self._sharding is not None:
+                sharding = self._field_sharding(name)
+                out[name] = jax.make_array_from_process_local_data(sharding, array)
+            else:
+                out[name] = jax.device_put(array)
+        return out
+
+    def _stage_loop(self):
+        try:
+            for host_batch in self._host_iter:
+                if self._stop.is_set():
+                    return
+                staged = self._stage(host_batch)
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(staged, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except Exception as e:  # noqa: BLE001 - surfaced to consumer
+            if not self._stop.is_set():
+                self._queue.put(e)
+            return
+        self._queue.put(_END)
+
+    # -- consumer --------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration
+        item = self._queue.get()
+        if item is _END:
+            self._exhausted = True
+            raise StopIteration
+        if isinstance(item, Exception):
+            self._exhausted = True
+            raise item
+        names = tuple(sorted(item))
+        nt = self._namedtuple_cache.get(names)
+        if nt is None:
+            nt = namedtuple('JaxBatch', names)
+            self._namedtuple_cache[names] = nt
+        return nt(**{k: item[k] for k in names})
+
+    def stop(self):
+        self._stop.set()
+        self._exhausted = True
+        # Drain so the stager can exit.
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=10)
+        self._reader.stop()
+        self._reader.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+
+def make_jax_loader(reader, batch_size, **kwargs):
+    """Factory mirroring the reference adapter entry points
+    (``tf_utils.tf_tensors`` / ``pytorch.DataLoader``)."""
+    return JaxLoader(reader, batch_size, **kwargs)

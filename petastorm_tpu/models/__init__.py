@@ -1,0 +1,9 @@
+"""Reference training workloads fed by petastorm_tpu readers.
+
+The reference ships example workloads (``examples/mnist``, ``examples/imagenet``
+— SURVEY.md §2.8) that define its end-to-end story. These are their TPU-native
+equivalents: flax models consumed through ``jax_loader`` with mesh sharding.
+"""
+
+from petastorm_tpu.models.mlp import MLP  # noqa: F401
+from petastorm_tpu.models.resnet import ResNet, ResNet18, ResNet50  # noqa: F401

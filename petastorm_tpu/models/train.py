@@ -1,0 +1,95 @@
+"""Sharded training step: jit over a Mesh with dp (data) + tp (model) axes.
+
+The input pipeline delivers batches already laid out on the mesh
+(``jax_loader``), so the train step is a pure pjit program: parameters are
+replicated over 'data' and (for the wide classifier head) sharded over
+'model'; XLA inserts the gradient all-reduce over ICI from the sharding
+annotations — no hand-rolled collectives (SURVEY.md §5.8).
+"""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+class TrainState(train_state.TrainState):
+    batch_stats: Any = None
+
+
+def _param_spec(path, value, mesh):
+    """Sharding rule: classifier-head kernel is tensor-parallel over 'model';
+    everything else replicated."""
+    if mesh is None or 'model' not in mesh.axis_names:
+        return PartitionSpec()
+    names = [getattr(p, 'key', getattr(p, 'name', '')) for p in path]
+    if 'head' in names and names[-1] == 'kernel' and value.ndim == 2:
+        return PartitionSpec(None, 'model')
+    return PartitionSpec()
+
+
+def create_train_state(rng, model, input_shape, mesh=None, learning_rate=1e-3,
+                       momentum=0.9, tx=None):
+    """Initialize (optionally mesh-sharded) training state."""
+    variables = model.init(rng, jnp.ones(input_shape, jnp.float32), train=False)
+    params = variables['params']
+    batch_stats = variables.get('batch_stats')
+    if tx is None:
+        tx = optax.sgd(learning_rate, momentum=momentum)
+    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx,
+                              batch_stats=batch_stats)
+    if mesh is not None:
+        def place(path, leaf):
+            return jax.device_put(leaf, NamedSharding(mesh, _param_spec(path, leaf, mesh)))
+        state = jax.tree_util.tree_map_with_path(place, state)
+    return state
+
+
+def make_train_step(mesh=None, batch_axis='data'):
+    """Build a jitted train step ``(state, images, labels) -> (state, metrics)``."""
+
+    def train_step(state, images, labels):
+        if mesh is not None:
+            images = jax.lax.with_sharding_constraint(
+                images, NamedSharding(mesh, PartitionSpec((batch_axis,))))
+            labels = jax.lax.with_sharding_constraint(
+                labels, NamedSharding(mesh, PartitionSpec((batch_axis,))))
+
+        def loss_fn(params):
+            variables = {'params': params}
+            if state.batch_stats is not None:
+                variables['batch_stats'] = state.batch_stats
+                logits, updates = state.apply_fn(variables, images, train=True,
+                                                 mutable=['batch_stats'])
+                new_batch_stats = updates['batch_stats']
+            else:
+                logits = state.apply_fn(variables, images, train=True)
+                new_batch_stats = None
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            return loss, (logits, new_batch_stats)
+
+        (loss, (logits, new_batch_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        state = state.apply_gradients(grads=grads)
+        if new_batch_stats is not None:
+            state = state.replace(batch_stats=new_batch_stats)
+        accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+        return state, {'loss': loss, 'accuracy': accuracy}
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+def make_eval_step():
+    def eval_step(state, images, labels):
+        variables = {'params': state.params}
+        if state.batch_stats is not None:
+            variables['batch_stats'] = state.batch_stats
+        logits = state.apply_fn(variables, images, train=False)
+        return {'loss': optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean(),
+                'accuracy': jnp.mean(jnp.argmax(logits, -1) == labels)}
+
+    return jax.jit(eval_step)

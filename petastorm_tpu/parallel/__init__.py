@@ -1,0 +1,4 @@
+"""Mesh/sharding utilities for pod-scale input pipelines."""
+
+from petastorm_tpu.parallel.mesh import (batch_sharding, make_mesh,  # noqa: F401
+                                         process_shard)

@@ -1,0 +1,58 @@
+"""Device-mesh helpers: the TPU-native stand-in for the reference's
+rank-based multi-GPU coordination.
+
+The reference coordinates multi-node training purely by static input sharding
+(``cur_shard=rank, shard_count=world`` — ``petastorm/reader.py:485-502``,
+SURVEY.md §5.8). Here the same rule is keyed by ``jax.process_index()`` /
+``jax.process_count()``, and cross-chip data movement is XLA's ICI/DCN via
+``jax.sharding`` — never hand-rolled collectives.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def process_shard():
+    """``(cur_shard, shard_count)`` for this host — feed to make_reader.
+
+    Parity target: BASELINE.json north-star ("cur_shard=jax.process_index()").
+    """
+    return jax.process_index(), jax.process_count()
+
+
+def make_mesh(axis_shapes, devices=None):
+    """Build a ``Mesh`` from ``{'axis': size}`` (``-1`` = fill with remaining).
+
+    Example: ``make_mesh({'data': -1, 'model': 2})`` on 8 devices gives a
+    (4, 2) mesh with axes ('data', 'model').
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axis_shapes)
+    sizes = list(axis_shapes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError('At most one axis may be -1')
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if len(devices) % known:
+        raise ValueError('{} devices not divisible by fixed axes {}'.format(
+            len(devices), axis_shapes))
+    sizes = [len(devices) // known if s == -1 else s for s in sizes]
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError('Mesh {} does not cover {} devices'.format(
+            dict(zip(names, sizes)), len(devices)))
+    device_array = np.asarray(devices).reshape(sizes)
+    return Mesh(device_array, tuple(names))
+
+
+def batch_sharding(mesh, batch_axes='data'):
+    """NamedSharding placing the leading (batch) dim on ``batch_axes``.
+
+    Remaining dims are replicated — the standard data-parallel input layout.
+    """
+    if isinstance(batch_axes, str):
+        batch_axes = (batch_axes,)
+    return NamedSharding(mesh, PartitionSpec(tuple(batch_axes)))
+
+
+def replicated_sharding(mesh):
+    return NamedSharding(mesh, PartitionSpec())

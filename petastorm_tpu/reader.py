@@ -316,6 +316,11 @@ class Reader(object):
     def batched_output(self):
         return self._results_queue_reader.batched_output
 
+    @property
+    def transformed_schema(self):
+        """The schema of yielded rows (after any TransformSpec)."""
+        return self._transformed_schema
+
     def reset(self):
         """Restart the (finished) epoch sequence.
 

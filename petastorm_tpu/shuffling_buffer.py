@@ -1,0 +1,117 @@
+"""Bounded random shuffling buffer for row-level decorrelation.
+
+Parity: reference ``petastorm/reader_impl/shuffling_buffer.py`` —
+``ShufflingBufferBase`` (``:22``), ``NoopShufflingBuffer`` (``:75``),
+``RandomShufflingBuffer`` (``:103-180``) with the swap-with-last O(1) random
+pop (``:158-167``) and the ``min_after_retrieve`` decorrelation floor.
+
+TPU-first improvement: the RNG is seedable for cross-host reproducibility.
+"""
+
+import numpy as np
+
+
+class ShufflingBufferBase(object):
+    def add_many(self, items):
+        raise NotImplementedError
+
+    def retrieve(self):
+        raise NotImplementedError
+
+    def can_add(self):
+        raise NotImplementedError
+
+    def can_retrieve(self):
+        raise NotImplementedError
+
+    @property
+    def size(self):
+        raise NotImplementedError
+
+    def finish(self):
+        """Signal no more items will be added; drain below the floor."""
+        raise NotImplementedError
+
+
+class NoopShufflingBuffer(ShufflingBufferBase):
+    """Pass-through FIFO."""
+
+    def __init__(self):
+        from collections import deque
+        self._store = deque()
+        self._done = False
+
+    def add_many(self, items):
+        self._store.extend(items)
+
+    def retrieve(self):
+        return self._store.popleft()
+
+    def can_add(self):
+        return not self._done
+
+    def can_retrieve(self):
+        return len(self._store) > 0
+
+    @property
+    def size(self):
+        return len(self._store)
+
+    def finish(self):
+        self._done = True
+
+
+class RandomShufflingBuffer(ShufflingBufferBase):
+    """Uniform random retrieval from a bounded buffer.
+
+    :param shuffling_buffer_capacity: soft cap; ``can_add`` is False at/above it.
+    :param min_after_retrieve: retrieval floor before ``finish()`` — keeps the
+        buffer full enough to decorrelate.
+    :param extra_capacity: how far a single ``add_many`` may overshoot the cap.
+    :param seed: RNG seed for reproducible shuffling.
+    """
+
+    def __init__(self, shuffling_buffer_capacity, min_after_retrieve,
+                 extra_capacity=1000, seed=None):
+        if min_after_retrieve >= shuffling_buffer_capacity:
+            raise ValueError('min_after_retrieve ({}) must be < capacity ({})'.format(
+                min_after_retrieve, shuffling_buffer_capacity))
+        self._capacity = shuffling_buffer_capacity
+        self._min_after_retrieve = min_after_retrieve
+        self._extra_capacity = extra_capacity
+        self._store = []
+        self._done_adding = False
+        self._rng = np.random.default_rng(seed)
+
+    def add_many(self, items):
+        if self._done_adding:
+            raise RuntimeError('Cannot add after finish()')
+        if len(self._store) + len(items) > self._capacity + self._extra_capacity:
+            raise RuntimeError(
+                'add_many of {} items would exceed capacity+extra ({}+{}); current size {}. '
+                'Check can_add() before adding.'.format(
+                    len(items), self._capacity, self._extra_capacity, len(self._store)))
+        self._store.extend(items)
+
+    def retrieve(self):
+        if not self.can_retrieve():
+            raise RuntimeError('Buffer below decorrelation floor; add more or finish()')
+        index = int(self._rng.integers(0, len(self._store)))
+        # O(1) random pop: swap with last (parity: shuffling_buffer.py:158-167)
+        self._store[index], self._store[-1] = self._store[-1], self._store[index]
+        return self._store.pop()
+
+    def can_add(self):
+        return len(self._store) < self._capacity and not self._done_adding
+
+    def can_retrieve(self):
+        if self._done_adding:
+            return len(self._store) > 0
+        return len(self._store) > self._min_after_retrieve
+
+    @property
+    def size(self):
+        return len(self._store)
+
+    def finish(self):
+        self._done_adding = True

@@ -11,11 +11,20 @@ without TPU hardware.
 
 import os
 
-# Must be set before jax import (anywhere in the test process).
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Must be set before jax import (anywhere in the test process). Force CPU even
+# if the environment points at real TPU hardware — tests run on a virtual
+# 8-device CPU platform so multi-chip sharding is exercised without a pod.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 xla_flags = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in xla_flags:
     os.environ['XLA_FLAGS'] = (xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+# The env var alone is not enough when a TPU plugin (e.g. 'axon' tunnel) is
+# registered — pin the platform through the config as well, before any backend
+# is initialized.
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import numpy as np
 import pytest

@@ -1,0 +1,169 @@
+"""JAX loader tests: batch rechunking, shape policies, mesh sharding.
+
+Runs on the virtual 8-device CPU platform (see conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.jax_loader import (CropTo, JaxLoader, PadTo,
+                                      iter_numpy_batches, make_jax_loader)
+from petastorm_tpu.parallel import make_mesh
+
+
+POLICIES = {'varlen': PadTo((8,), fill_value=-1)}
+
+
+def _row_reader(url, **kw):
+    kw.setdefault('reader_pool_type', 'dummy')
+    kw.setdefault('shuffle_row_groups', False)
+    return make_reader(url, **kw)
+
+
+def test_numpy_batches_exact_size(synthetic_dataset):
+    with _row_reader(synthetic_dataset.url) as reader:
+        batches = list(iter_numpy_batches(reader, 8, shape_policies=POLICIES))
+    assert len(batches) == 50 // 8
+    for b in batches:
+        assert b['image_png'].shape == (8, 32, 16, 3)
+        assert b['matrix'].dtype == np.float32
+        assert b['varlen'].shape == (8, 8)
+
+
+def test_numpy_batches_pad_last(synthetic_dataset):
+    with _row_reader(synthetic_dataset.url) as reader:
+        batches = list(iter_numpy_batches(reader, 8, shape_policies=POLICIES,
+                                          last_batch='pad'))
+    assert len(batches) == -(-50 // 8)
+    assert all(b['id'].shape == (8,) for b in batches)
+
+
+def test_numpy_batches_partial_last(synthetic_dataset):
+    with _row_reader(synthetic_dataset.url) as reader:
+        batches = list(iter_numpy_batches(reader, 8, shape_policies=POLICIES,
+                                          last_batch='partial'))
+    assert batches[-1]['id'].shape == (50 % 8,)
+
+
+def test_numpy_batches_all_rows_once(synthetic_dataset):
+    with _row_reader(synthetic_dataset.url) as reader:
+        ids = np.concatenate([b['id'] for b in
+                              iter_numpy_batches(reader, 5, shape_policies=POLICIES)])
+    assert sorted(ids.tolist()) == list(range(50))
+
+
+def test_ragged_without_policy_raises(synthetic_dataset):
+    with _row_reader(synthetic_dataset.url, schema_fields=['id', 'varlen']) as reader:
+        with pytest.raises(ValueError, match='shape policy'):
+            list(iter_numpy_batches(reader, 8))
+
+
+def test_crop_policy(synthetic_dataset):
+    with _row_reader(synthetic_dataset.url, schema_fields=['id', 'image_png']) as reader:
+        batches = list(iter_numpy_batches(
+            reader, 4, shape_policies={'image_png': CropTo((16, 8, 3))}))
+    assert batches[0]['image_png'].shape == (4, 16, 8, 3)
+
+
+def test_dtype_sanitization(synthetic_dataset):
+    with _row_reader(synthetic_dataset.url,
+                     schema_fields=['id', 'matrix_compressed']) as reader:
+        b = next(iter(iter_numpy_batches(reader, 4)))
+    assert b['id'].dtype == np.int32          # int64 -> int32 (x64 off)
+    assert b['matrix_compressed'].dtype == np.float32  # float64 -> float32
+
+
+def test_string_fields_dropped_with_warning(synthetic_dataset):
+    with _row_reader(synthetic_dataset.url,
+                     schema_fields=['id', 'sensor_name']) as reader:
+        with pytest.warns(UserWarning, match='sensor_name'):
+            b = next(iter(iter_numpy_batches(reader, 4)))
+    assert 'sensor_name' not in b
+
+
+def test_batch_reader_rechunk(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                           shuffle_row_groups=False) as reader:
+        batches = list(iter_numpy_batches(reader, 32))
+    assert len(batches) == 3  # 100 rows -> 3 full batches of 32
+    assert batches[0]['list_col'].shape == (32, 2)
+
+
+def test_shuffling_queue(synthetic_dataset):
+    def read(seed):
+        with _row_reader(synthetic_dataset.url, schema_fields=['id']) as reader:
+            return np.concatenate([
+                b['id'] for b in iter_numpy_batches(
+                    reader, 10, shuffling_queue_capacity=30,
+                    min_after_dequeue=10, seed=seed, last_batch='partial')])
+
+    a, b, c = read(1), read(1), read(2)
+    assert sorted(a.tolist()) == list(range(50))
+    np.testing.assert_array_equal(a, b)      # seeded -> reproducible
+    assert a.tolist() != c.tolist()          # different seed -> different order
+    assert a.tolist() != sorted(a.tolist())  # actually shuffled
+
+
+# --- device staging -------------------------------------------------------
+
+def test_jax_loader_single_device(synthetic_dataset):
+    import jax
+
+    with _row_reader(synthetic_dataset.url, schema_fields=['id', 'matrix']) as reader:
+        with make_jax_loader(reader, 8) as loader:
+            batch = next(loader)
+            assert isinstance(batch.matrix, jax.Array)
+            assert batch.matrix.shape == (8, 4, 5)
+            assert batch.id.shape == (8,)
+
+
+def test_jax_loader_mesh_sharded(synthetic_dataset):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = make_mesh({'data': 8})
+    with _row_reader(synthetic_dataset.url, schema_fields=['id', 'matrix']) as reader:
+        with JaxLoader(reader, 16, mesh=mesh) as loader:
+            batch = next(loader)
+    assert batch.matrix.shape == (16, 4, 5)
+    assert batch.matrix.sharding == NamedSharding(mesh, PartitionSpec(('data',)))
+    # Each device holds 2 rows of the batch.
+    assert batch.matrix.addressable_shards[0].data.shape == (2, 4, 5)
+
+
+def test_jax_loader_full_epoch_on_mesh(synthetic_dataset):
+    mesh = make_mesh({'data': 8})
+    with _row_reader(synthetic_dataset.url, schema_fields=['id']) as reader:
+        with JaxLoader(reader, 16, mesh=mesh) as loader:
+            ids = np.concatenate([np.asarray(b.id) for b in loader])
+    assert len(ids) == 48  # 50 rows, last partial dropped
+    assert len(set(ids.tolist())) == 48
+
+
+def test_jax_loader_batch_not_divisible_raises(synthetic_dataset):
+    mesh = make_mesh({'data': 8})
+    # process_count=1 so any batch divides; instead check 'partial' rejection
+    with _row_reader(synthetic_dataset.url, schema_fields=['id']) as reader:
+        with pytest.raises(ValueError, match='partial'):
+            JaxLoader(reader, 16, mesh=mesh, last_batch='partial')
+        reader.stop()
+        reader.join()
+
+
+def test_jax_loader_sharded_compute(synthetic_dataset):
+    """The staged batch feeds a pjit-ted computation without resharding."""
+    import jax
+
+    mesh = make_mesh({'data': 8})
+    with _row_reader(synthetic_dataset.url, schema_fields=['matrix']) as reader:
+        with JaxLoader(reader, 16, mesh=mesh) as loader:
+            batch = next(loader)
+
+            @jax.jit
+            def mean_norm(x):
+                return (x - x.mean()) / (x.std() + 1e-6)
+
+            out = mean_norm(batch.matrix)
+    assert out.sharding == batch.matrix.sharding
+    np.testing.assert_allclose(np.asarray(out).mean(), 0.0, atol=1e-5)
