@@ -1,0 +1,4 @@
+"""Throughput benchmarking harness (parity: reference ``petastorm/benchmark/``)."""
+
+from petastorm_tpu.benchmark.throughput import (BenchmarkResult,  # noqa: F401
+                                                reader_throughput)

@@ -1,0 +1,49 @@
+"""``petastorm-tpu-throughput`` CLI (parity: reference ``petastorm/benchmark/cli.py``)."""
+
+import argparse
+import sys
+
+
+def _parse_args(argv):
+    parser = argparse.ArgumentParser(
+        description='Measure petastorm_tpu reader throughput on a dataset')
+    parser.add_argument('dataset_url', help='e.g. file:///tmp/ds or gs://bucket/ds')
+    parser.add_argument('--field-regex', '-f', nargs='+', default=None,
+                        help='Read only fields matching these regexes')
+    parser.add_argument('--warmup-cycles', '-w', type=int, default=200)
+    parser.add_argument('--measure-cycles', '-m', type=int, default=1000)
+    parser.add_argument('--pool-type', '-p', choices=['thread', 'process', 'dummy'],
+                        default='thread')
+    parser.add_argument('--loaders-count', '-l', type=int, default=3)
+    parser.add_argument('--read-method', '-d', choices=['python', 'jax'],
+                        default='python')
+    parser.add_argument('--shuffling-queue-size', '-q', type=int, default=500)
+    parser.add_argument('--min-after-dequeue', type=int, default=400)
+    parser.add_argument('--jax-batch-size', type=int, default=32)
+    parser.add_argument('--spawn-new-process', action='store_true',
+                        help='Measure in a fresh interpreter for clean memory stats')
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    from petastorm_tpu.benchmark.throughput import reader_throughput
+
+    result = reader_throughput(
+        args.dataset_url, field_regex=args.field_regex,
+        warmup_cycles_count=args.warmup_cycles,
+        measure_cycles_count=args.measure_cycles,
+        pool_type=args.pool_type, loaders_count=args.loaders_count,
+        read_method=args.read_method,
+        shuffling_queue_size=args.shuffling_queue_size,
+        min_after_dequeue=args.min_after_dequeue,
+        jax_batch_size=args.jax_batch_size,
+        spawn_new_process=args.spawn_new_process)
+    print('samples/sec: {:.2f}  time/sample: {:.6f}s  rss: {:.1f} MB  cpu: {:.1f}%'.format(
+        result.samples_per_second, result.time_mean, result.memory_rss_mb,
+        result.cpu_percent))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

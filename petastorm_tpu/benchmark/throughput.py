@@ -1,0 +1,101 @@
+"""Reader throughput harness.
+
+Parity: reference ``petastorm/benchmark/throughput.py`` — warmup + measure
+cycles, samples/sec, RSS and CPU%% via psutil (``:69-91``), python or JAX read
+paths (``:94-110``), optional spawn-in-fresh-process for clean memory stats
+(``:146-151``).
+"""
+
+import time
+from collections import namedtuple
+
+import psutil
+
+BenchmarkResult = namedtuple('BenchmarkResult',
+                             ['time_mean', 'samples_per_second', 'memory_rss_mb',
+                              'cpu_percent'])
+
+_READ_PATHS = ('python', 'jax')
+
+
+def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
+                      measure_cycles_count=1000, pool_type='thread',
+                      loaders_count=3, read_method='python',
+                      shuffling_queue_size=500, min_after_dequeue=400,
+                      spawn_new_process=False, reader_extra_args=None,
+                      jax_batch_size=32, shape_policies=None):
+    """Measure decoded-samples/sec of a reader configuration."""
+    if read_method not in _READ_PATHS:
+        raise ValueError('read_method must be one of {}'.format(_READ_PATHS))
+    if spawn_new_process:
+        # Clean-memory measurement in a fresh interpreter
+        # (parity: throughput.py:146-151).
+        from petastorm_tpu.workers.exec_in_new_process import exec_in_new_process
+        import json
+        import tempfile
+
+        out_path = tempfile.mktemp(suffix='.json')
+        process = exec_in_new_process(
+            _run_and_dump, out_path, dataset_url, field_regex, warmup_cycles_count,
+            measure_cycles_count, pool_type, loaders_count, read_method,
+            shuffling_queue_size, min_after_dequeue, reader_extra_args,
+            jax_batch_size, shape_policies)
+        process.wait()
+        with open(out_path) as f:
+            payload = json.load(f)
+        return BenchmarkResult(**payload)
+
+    return _measure(dataset_url, field_regex, warmup_cycles_count,
+                    measure_cycles_count, pool_type, loaders_count, read_method,
+                    shuffling_queue_size, min_after_dequeue, reader_extra_args,
+                    jax_batch_size, shape_policies)
+
+
+def _run_and_dump(out_path, *args):
+    import json
+    result = _measure(*args)
+    with open(out_path, 'w') as f:
+        json.dump(result._asdict(), f)
+
+
+def _measure(dataset_url, field_regex, warmup_cycles_count, measure_cycles_count,
+             pool_type, loaders_count, read_method, shuffling_queue_size,
+             min_after_dequeue, reader_extra_args, jax_batch_size, shape_policies):
+    from petastorm_tpu import make_reader
+
+    extra = dict(reader_extra_args or {})
+    extra.setdefault('num_epochs', None)
+    reader = make_reader(dataset_url, schema_fields=field_regex,
+                         reader_pool_type=pool_type, workers_count=loaders_count,
+                         **extra)
+    process = psutil.Process()
+    try:
+        if read_method == 'python':
+            iterator = iter(reader)
+            unit = 1
+        else:
+            from petastorm_tpu.jax_loader import JaxLoader
+            loader = JaxLoader(reader, jax_batch_size,
+                               shuffling_queue_capacity=shuffling_queue_size,
+                               min_after_dequeue=min_after_dequeue,
+                               shape_policies=shape_policies)
+            iterator = iter(loader)
+            unit = jax_batch_size
+
+        for _ in range(max(1, warmup_cycles_count // unit)):
+            next(iterator)
+        process.cpu_percent()  # reset the CPU window
+        start = time.perf_counter()
+        cycles = max(1, measure_cycles_count // unit)
+        for _ in range(cycles):
+            next(iterator)
+        elapsed = time.perf_counter() - start
+        cpu = process.cpu_percent()
+        rss_mb = process.memory_info().rss / (1024 * 1024)
+        samples = cycles * unit
+        return BenchmarkResult(time_mean=elapsed / samples,
+                               samples_per_second=samples / elapsed,
+                               memory_rss_mb=rss_mb, cpu_percent=cpu)
+    finally:
+        reader.stop()
+        reader.join()

@@ -1,0 +1,80 @@
+"""Metadata CLIs.
+
+Parity: reference ``petastorm/etl/petastorm_generate_metadata.py`` (regenerate
+schema/row-group metadata on an existing store, ``:47-111``) and
+``petastorm/etl/metadata_util.py`` (print schema / index contents).
+"""
+
+import argparse
+import json
+import sys
+
+
+def generate_metadata(dataset_url, unischema_class=None, storage_options=None):
+    """(Re)generate ``_common_metadata`` for an existing Parquet store.
+
+    If ``unischema_class`` ('module.path.SchemaObject') is given, that schema
+    is stored; otherwise the existing stored schema is reused (refreshing the
+    row-group counts), or inferred from the Arrow schema as a last resort.
+    """
+    from petastorm_tpu.etl.dataset_metadata import infer_or_load_unischema
+    from petastorm_tpu.etl.writer import finalize_dataset_metadata
+    from petastorm_tpu.storage import ParquetStore
+
+    store = ParquetStore(dataset_url, storage_options)
+    if unischema_class:
+        module_path, _, attr = unischema_class.rpartition('.')
+        module = __import__(module_path, fromlist=[attr])
+        schema = getattr(module, attr)
+    else:
+        schema = infer_or_load_unischema(store)
+    partition_fields = tuple(store.partition_names)
+    finalize_dataset_metadata(store, schema, metadata_collector=None,
+                              partition_fields=partition_fields)
+    return schema
+
+
+def print_metadata(dataset_url, show_index=False, storage_options=None):
+    from petastorm_tpu.etl.dataset_metadata import infer_or_load_unischema
+    from petastorm_tpu.storage import ROWGROUP_INDEX_KEY, ParquetStore
+
+    store = ParquetStore(dataset_url, storage_options)
+    schema = infer_or_load_unischema(store)
+    print(schema)
+    pieces = store.row_groups()
+    print('{} row-groups in {} files'.format(len(pieces), len(store.files)))
+    if show_index:
+        blob = store.common_metadata_value(ROWGROUP_INDEX_KEY)
+        if blob is None:
+            print('No row-group indexes stored')
+        else:
+            payload = json.loads(blob.decode('utf-8'))
+            for name, index in payload.items():
+                print('index {!r} on field {!r}: {} values'.format(
+                    name, index.get('field'), len(index.get('values', {}))))
+
+
+def generate_metadata_main(argv=None):
+    parser = argparse.ArgumentParser(
+        description='Regenerate petastorm_tpu metadata on an existing Parquet store')
+    parser.add_argument('dataset_url')
+    parser.add_argument('--unischema-class', default=None,
+                        help='Fully qualified schema object, e.g. mypkg.schema.MySchema')
+    args = parser.parse_args(argv if argv is not None else sys.argv[1:])
+    schema = generate_metadata(args.dataset_url, args.unischema_class)
+    print('Wrote metadata for schema {!r}'.format(schema.name))
+    return 0
+
+
+def metadata_util_main(argv=None):
+    parser = argparse.ArgumentParser(description='Inspect a petastorm_tpu dataset')
+    parser.add_argument('dataset_url')
+    parser.add_argument('--print-values', '--index', action='store_true',
+                        dest='show_index')
+    args = parser.parse_args(argv if argv is not None else sys.argv[1:])
+    print_metadata(args.dataset_url, show_index=args.show_index)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(generate_metadata_main())

@@ -1,0 +1,92 @@
+"""Row-group indexers: build value -> {row-group ordinal} maps.
+
+Parity: reference ``petastorm/etl/rowgroup_indexers.py`` —
+``SingleFieldIndexer`` (``:21-75``), ``FieldNotNullIndexer`` (``:78-124``).
+Index payloads are JSON (value-string keyed), not pickle.
+"""
+
+from petastorm_tpu.etl import RowGroupIndexerBase
+
+
+class SingleFieldIndexer(RowGroupIndexerBase):
+    """Maps every value of one field to the set of row-groups containing it."""
+
+    def __init__(self, index_name, index_field):
+        self._index_name = index_name
+        self._field_name = index_field
+        self._values = {}
+
+    @property
+    def index_name(self):
+        return self._index_name
+
+    @property
+    def column_names(self):
+        return [self._field_name]
+
+    @property
+    def indexed_values(self):
+        return sorted(self._values)
+
+    def get_row_group_indexes(self, value_key):
+        return sorted(self._values.get(str(value_key), ()))
+
+    def build_index(self, decoded_rows, piece_index):
+        for row in decoded_rows:
+            value = row.get(self._field_name)
+            if value is None:
+                continue
+            self._values.setdefault(str(value), set()).add(piece_index)
+
+    def __add__(self, other):
+        if other.index_name != self.index_name:
+            raise ValueError('Cannot merge indexers of different indexes')
+        for value, pieces in other._values.items():
+            self._values.setdefault(value, set()).update(pieces)
+        return self
+
+    def to_json_payload(self):
+        return {'type': 'single_field', 'field': self._field_name,
+                'values': {v: sorted(ids) for v, ids in self._values.items()}}
+
+
+class FieldNotNullIndexer(RowGroupIndexerBase):
+    """Indexes row-groups that contain at least one non-null value of a field."""
+
+    _KEY = 'not_null'
+
+    def __init__(self, index_name, index_field):
+        self._index_name = index_name
+        self._field_name = index_field
+        self._pieces = set()
+
+    @property
+    def index_name(self):
+        return self._index_name
+
+    @property
+    def column_names(self):
+        return [self._field_name]
+
+    @property
+    def indexed_values(self):
+        return [self._KEY]
+
+    def get_row_group_indexes(self, value_key=None):
+        return sorted(self._pieces)
+
+    def build_index(self, decoded_rows, piece_index):
+        for row in decoded_rows:
+            if row.get(self._field_name) is not None:
+                self._pieces.add(piece_index)
+                return
+
+    def __add__(self, other):
+        if other.index_name != self.index_name:
+            raise ValueError('Cannot merge indexers of different indexes')
+        self._pieces.update(other._pieces)
+        return self
+
+    def to_json_payload(self):
+        return {'type': 'field_not_null', 'field': self._field_name,
+                'values': {self._KEY: sorted(self._pieces)}}

@@ -283,16 +283,8 @@ class Reader(object):
 
         Parity: reference ``reader.py:446-483``.
         """
-        import json
-        blob = self._store.common_metadata_value(ROWGROUP_INDEX_KEY)
-        if blob is None:
-            raise ValueError('Dataset has no row-group index; run build_rowgroup_index first')
-        indexes = json.loads(blob.decode('utf-8'))
-        index_name = selector.get_index_name()
-        if index_name not in indexes:
-            raise ValueError('Index {!r} not found; available: {}'.format(
-                index_name, sorted(indexes)))
-        return selector.select_row_groups(indexes[index_name])
+        from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes
+        return selector.select_row_groups(get_row_group_indexes(self._store))
 
     # --- iteration --------------------------------------------------------
 

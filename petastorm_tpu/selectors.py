@@ -1,0 +1,65 @@
+"""Row-group selectors: choose row-groups via stored indexes.
+
+Parity: reference ``petastorm/selectors.py`` — ``RowGroupSelectorBase``,
+``SingleIndexSelector``, plus intersection/union combinators.
+"""
+
+
+class RowGroupSelectorBase(object):
+    def get_index_names(self):
+        raise NotImplementedError
+
+    def select_row_groups(self, indexes):
+        """``indexes``: full stored payload ``{index_name: {'values': {...}}}``;
+        returns a set of row-group ordinals."""
+        raise NotImplementedError
+
+
+class SingleIndexSelector(RowGroupSelectorBase):
+    """Union of row-groups holding any of ``values_list`` in one index."""
+
+    def __init__(self, index_name, values_list):
+        self._index_name = index_name
+        self._values = list(values_list)
+
+    def get_index_names(self):
+        return [self._index_name]
+
+    def select_row_groups(self, indexes):
+        if self._index_name not in indexes:
+            raise ValueError('Index {!r} not found; available: {}'.format(
+                self._index_name, sorted(indexes)))
+        value_map = indexes[self._index_name]['values']
+        selected = set()
+        for value in self._values:
+            selected.update(value_map.get(str(value), ()))
+        return selected
+
+
+class IntersectIndexSelector(RowGroupSelectorBase):
+    def __init__(self, selectors):
+        self._selectors = list(selectors)
+
+    def get_index_names(self):
+        return sorted({n for s in self._selectors for n in s.get_index_names()})
+
+    def select_row_groups(self, indexes):
+        result = None
+        for selector in self._selectors:
+            picked = selector.select_row_groups(indexes)
+            result = picked if result is None else (result & picked)
+        return result or set()
+
+
+class UnionIndexSelector(RowGroupSelectorBase):
+    def __init__(self, selectors):
+        self._selectors = list(selectors)
+
+    def get_index_names(self):
+        return sorted({n for s in self._selectors for n in s.get_index_names()})
+
+    def select_row_groups(self, indexes):
+        result = set()
+        for selector in self._selectors:
+            result |= selector.select_row_groups(indexes)
+        return result

@@ -1,0 +1,3 @@
+"""Testing utilities for downstream users (parity: reference ``petastorm/test_util/``)."""
+
+from petastorm_tpu.test_util.reader_mock import ReaderMock  # noqa: F401

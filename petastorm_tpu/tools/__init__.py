@@ -1,0 +1,1 @@
+"""Dataset maintenance tools (parity: reference ``petastorm/tools/``)."""

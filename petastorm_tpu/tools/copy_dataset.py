@@ -1,0 +1,71 @@
+"""Dataset copy tool: column subset / not-null filter / re-chunk copy.
+
+Parity: reference ``petastorm/tools/copy_dataset.py:34-90`` (which drives a
+Spark job; this is a pyarrow/JVM-free reimplementation using our own reader
+and writer).
+"""
+
+import argparse
+import sys
+
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.etl.writer import DatasetWriter
+from petastorm_tpu.etl.dataset_metadata import get_schema_from_dataset_url
+
+
+def copy_dataset(source_url, target_url, field_regex=None, not_null_fields=None,
+                 rows_per_row_group=None, row_group_size_mb=None,
+                 partition_fields=(), storage_options=None):
+    """Copy (a subset of) a materialized dataset to a new location."""
+    from petastorm_tpu.predicates import in_lambda
+
+    source_schema = get_schema_from_dataset_url(source_url, storage_options)
+    if field_regex:
+        schema = source_schema.create_schema_view(field_regex)
+    else:
+        schema = source_schema
+
+    predicate = None
+    if not_null_fields:
+        not_null_fields = list(not_null_fields)
+        predicate = in_lambda(not_null_fields,
+                              lambda values: all(values[f] is not None
+                                                 for f in not_null_fields))
+
+    rows_copied = 0
+    with make_reader(source_url, schema_fields=list(schema.fields),
+                     predicate=predicate, shuffle_row_groups=False,
+                     storage_options=storage_options) as reader:
+        with DatasetWriter(target_url, schema,
+                           rows_per_row_group=rows_per_row_group,
+                           row_group_size_mb=row_group_size_mb,
+                           partition_fields=partition_fields,
+                           storage_options=storage_options) as writer:
+            for row in reader:
+                writer.write(row._asdict())
+                rows_copied += 1
+    return rows_copied
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description='Copy a petastorm_tpu dataset')
+    parser.add_argument('source_url')
+    parser.add_argument('target_url')
+    parser.add_argument('--field-regex', nargs='+', default=None)
+    parser.add_argument('--not-null-fields', nargs='+', default=None)
+    parser.add_argument('--rows-per-row-group', type=int, default=None)
+    parser.add_argument('--row-group-size-mb', type=int, default=None)
+    parser.add_argument('--partition-fields', nargs='+', default=())
+    args = parser.parse_args(argv if argv is not None else sys.argv[1:])
+    count = copy_dataset(args.source_url, args.target_url,
+                         field_regex=args.field_regex,
+                         not_null_fields=args.not_null_fields,
+                         rows_per_row_group=args.rows_per_row_group,
+                         row_group_size_mb=args.row_group_size_mb,
+                         partition_fields=tuple(args.partition_fields))
+    print('Copied {} rows'.format(count))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

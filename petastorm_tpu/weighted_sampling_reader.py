@@ -1,0 +1,81 @@
+"""WeightedSamplingReader: probability-multiplexed reading from N readers.
+
+Parity: reference ``petastorm/weighted_sampling_reader.py`` — cumsum draw
+(``:90-92``), schema/batched/ngram compatibility validation (``:64-77``).
+
+TPU-first improvement: the draw RNG is seedable so every pod host mixes
+identically when given the same seed.
+"""
+
+import numpy as np
+
+
+class WeightedSamplingReader(object):
+    def __init__(self, readers, probabilities, seed=None):
+        if len(readers) != len(probabilities):
+            raise ValueError('readers and probabilities must have equal length')
+        if len(readers) < 1:
+            raise ValueError('Need at least one reader')
+        total = float(sum(probabilities))
+        if total <= 0:
+            raise ValueError('probabilities must sum to a positive value')
+        self._readers = list(readers)
+        self._cum = np.cumsum([p / total for p in probabilities])
+        self._rng = np.random.default_rng(seed)
+
+        first = readers[0]
+        for other in readers[1:]:
+            if list(first.transformed_schema.fields) != list(other.transformed_schema.fields):
+                raise ValueError('All mixed readers must share the same output schema')
+            if first.batched_output != other.batched_output:
+                raise ValueError('Cannot mix batched and per-row readers')
+            if (first.ngram is None) != (other.ngram is None):
+                raise ValueError('Cannot mix ngram and non-ngram readers')
+        self.last_row_consumed = False
+
+    @property
+    def batched_output(self):
+        return self._readers[0].batched_output
+
+    @property
+    def ngram(self):
+        return self._readers[0].ngram
+
+    @property
+    def transformed_schema(self):
+        return self._readers[0].transformed_schema
+
+    @property
+    def schema(self):
+        return self._readers[0].schema
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        draw = self._rng.random()
+        chosen = int(np.searchsorted(self._cum, draw, side='right'))
+        chosen = min(chosen, len(self._readers) - 1)
+        try:
+            return next(self._readers[chosen])
+        except StopIteration:
+            self.last_row_consumed = True
+            raise
+
+    next = __next__
+
+    def stop(self):
+        for reader in self._readers:
+            reader.stop()
+
+    def join(self):
+        for reader in self._readers:
+            reader.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        self.join()
+        return False

@@ -1,0 +1,39 @@
+"""petastorm_tpu package setup.
+
+Entry points mirror the reference's CLIs (``petastorm/setup.py`` entry_points:
+petastorm-generate-metadata.py / petastorm-copy-dataset.py /
+petastorm-throughput.py).
+"""
+
+from setuptools import find_packages, setup
+
+setup(
+    name='petastorm-tpu',
+    version='0.1.0',
+    description='TPU-native Parquet data access framework for JAX training',
+    packages=find_packages(exclude=('tests',)),
+    python_requires='>=3.10',
+    install_requires=[
+        'numpy',
+        'pyarrow>=10.0.0',
+        'fsspec',
+        'psutil',
+        'dill',
+    ],
+    extras_require={
+        'jax': ['jax', 'flax', 'optax'],
+        'process-pool': ['pyzmq'],
+        'images': ['opencv-python'],
+        'torch': ['torch'],
+        'tf': ['tensorflow'],
+        'test': ['pytest'],
+    },
+    entry_points={
+        'console_scripts': [
+            'petastorm-tpu-generate-metadata=petastorm_tpu.etl.metadata_cli:generate_metadata_main',
+            'petastorm-tpu-metadata=petastorm_tpu.etl.metadata_cli:metadata_util_main',
+            'petastorm-tpu-copy-dataset=petastorm_tpu.tools.copy_dataset:main',
+            'petastorm-tpu-throughput=petastorm_tpu.benchmark.cli:main',
+        ],
+    },
+)

@@ -133,3 +133,34 @@ def partitioned_synthetic_dataset(tmp_path_factory):
 
 def pytest_configure(config):
     config.addinivalue_line('markers', 'processpool: spawns real worker processes (slower)')
+
+
+TimeseriesSchema = Unischema('TimeseriesSchema', [
+    UnischemaField('timestamp', np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField('sensor', np.float32, (3,), NdarrayCodec(), False),
+    UnischemaField('label', np.int32, (), ScalarCodec(np.int32), False),
+])
+
+
+@pytest.fixture(scope='session')
+def timeseries_dataset(tmp_path_factory):
+    """Ordered timestamped rows (one gap at ts=25->35) for NGram tests."""
+    path = tmp_path_factory.mktemp('timeseries') / 'dataset'
+    url = 'file://' + str(path)
+    rng = np.random.default_rng(3)
+    rows = []
+    ts = 0
+    for i in range(40):
+        ts += 1 if i != 25 else 10  # a delta_threshold-violating gap
+        rows.append({'timestamp': ts,
+                     'sensor': rng.random(3, dtype=np.float32),
+                     'label': i % 4})
+    write_dataset(url, TimeseriesSchema, rows, rows_per_row_group=20)
+
+    class _Dataset:
+        pass
+
+    ds = _Dataset()
+    ds.url = url
+    ds.data = rows
+    return ds

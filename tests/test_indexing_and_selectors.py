@@ -1,0 +1,98 @@
+"""Row-group indexing + selector tests (parity: reference
+``tests/test_end_to_end.py:603-710`` + indexer unit tests)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.etl.rowgroup_indexers import (FieldNotNullIndexer,
+                                                 SingleFieldIndexer)
+from petastorm_tpu.etl.rowgroup_indexing import (build_rowgroup_index,
+                                                 get_row_group_indexes)
+from petastorm_tpu.selectors import (IntersectIndexSelector,
+                                     SingleIndexSelector, UnionIndexSelector)
+from tests.conftest import TestSchema, _row
+from petastorm_tpu.etl.writer import write_dataset
+
+
+@pytest.fixture(scope='module')
+def indexed_dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('indexed') / 'dataset'
+    url = 'file://' + str(path)
+    rng = np.random.default_rng(11)
+    rows = [_row(i, rng) for i in range(50)]
+    write_dataset(url, TestSchema, rows, rows_per_row_group=10)
+    build_rowgroup_index(url, [
+        SingleFieldIndexer('sensor_ix', 'sensor_name'),
+        SingleFieldIndexer('id2_ix', 'id2'),
+        FieldNotNullIndexer('nullable_ix', 'nullable_field'),
+    ])
+
+    class _Dataset:
+        pass
+
+    ds = _Dataset()
+    ds.url = url
+    ds.data = rows
+    return ds
+
+
+def test_index_payload_round_trip(indexed_dataset):
+    payload = get_row_group_indexes(indexed_dataset.url)
+    assert set(payload) == {'sensor_ix', 'id2_ix', 'nullable_ix'}
+    assert payload['sensor_ix']['field'] == 'sensor_name'
+    # sensor_0 appears in every row-group (every 3rd row of 10-row groups)
+    assert payload['sensor_ix']['values']['sensor_0'] == [0, 1, 2, 3, 4]
+
+
+def test_single_index_selector(indexed_dataset):
+    selector = SingleIndexSelector('sensor_ix', ['sensor_1'])
+    with make_reader(indexed_dataset.url, reader_pool_type='dummy',
+                     rowgroup_selector=selector, shuffle_row_groups=False) as reader:
+        rows = list(reader)
+    # Selected row-groups contain all sensor_1 rows (plus others in the same groups).
+    expected_ids = {r['id'] for r in indexed_dataset.data if r['sensor_name'] == 'sensor_1'}
+    got_ids = {r.id for r in rows}
+    assert expected_ids <= got_ids
+
+
+def test_selector_with_predicate_combined(indexed_dataset):
+    from petastorm_tpu.predicates import in_lambda
+    selector = SingleIndexSelector('sensor_ix', ['sensor_2'])
+    with make_reader(indexed_dataset.url, reader_pool_type='dummy',
+                     rowgroup_selector=selector,
+                     predicate=in_lambda(['sensor_name'],
+                                         lambda v: v['sensor_name'] == 'sensor_2')) as reader:
+        rows = list(reader)
+    expected = {r['id'] for r in indexed_dataset.data if r['sensor_name'] == 'sensor_2'}
+    assert {r.id for r in rows} == expected
+
+
+def test_intersect_and_union_selectors(indexed_dataset):
+    payload = get_row_group_indexes(indexed_dataset.url)
+    a = SingleIndexSelector('id2_ix', [0])
+    b = SingleIndexSelector('id2_ix', [1])
+    inter = IntersectIndexSelector([a, b]).select_row_groups(payload)
+    union = UnionIndexSelector([a, b]).select_row_groups(payload)
+    assert inter <= union
+    assert union == (a.select_row_groups(payload) | b.select_row_groups(payload))
+
+
+def test_not_null_indexer(indexed_dataset):
+    payload = get_row_group_indexes(indexed_dataset.url)
+    # Every 10-row group has some non-null nullable_field values
+    assert payload['nullable_ix']['values']['not_null'] == [0, 1, 2, 3, 4]
+
+
+def test_unknown_index_raises(indexed_dataset):
+    selector = SingleIndexSelector('nope_ix', ['x'])
+    with pytest.raises(ValueError, match='nope_ix'):
+        make_reader(indexed_dataset.url, reader_pool_type='dummy',
+                    rowgroup_selector=selector)
+
+
+def test_selector_without_index_raises(synthetic_dataset):
+    selector = SingleIndexSelector('sensor_ix', ['sensor_1'])
+    with pytest.raises(ValueError, match='no row-group index'):
+        make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                    rowgroup_selector=selector)

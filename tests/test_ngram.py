@@ -1,0 +1,102 @@
+"""NGram tests (parity: reference ``tests/test_ngram.py`` +
+``test_ngram_end_to_end.py``)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.ngram import NGram
+from tests.conftest import TimeseriesSchema
+
+
+def _fields(offsets_to_names):
+    return {off: [getattr(TimeseriesSchema, n) for n in names]
+            for off, names in offsets_to_names.items()}
+
+
+def test_length_and_field_names():
+    ngram = NGram(_fields({0: ['timestamp', 'sensor'], 1: ['sensor'], 2: ['label']}),
+                  delta_threshold=5, timestamp_field=TimeseriesSchema.timestamp)
+    assert ngram.length == 3
+    assert ngram.get_field_names_at_all_timesteps() == ['label', 'sensor', 'timestamp']
+    assert ngram.get_field_names_at_timestep(1) == ['sensor']
+
+
+def test_form_ngram_basic():
+    rows = [{'timestamp': t, 'value': t * 10} for t in [3, 1, 2, 5, 4]]
+    ngram = NGram({0: ['timestamp', 'value'], 1: ['value']},
+                  delta_threshold=1, timestamp_field='timestamp')
+    windows = ngram.form_ngram(rows, None)
+    # sorted ts 1..5, stride 1, gaps all == 1 -> 4 windows
+    assert len(windows) == 4
+    assert windows[0][0] == {'timestamp': 1, 'value': 10}
+    assert windows[0][1] == {'value': 20}
+
+
+def test_form_ngram_delta_threshold_gap():
+    rows = [{'timestamp': t} for t in [1, 2, 3, 10, 11, 12]]
+    ngram = NGram({0: ['timestamp'], 1: ['timestamp']},
+                  delta_threshold=2, timestamp_field='timestamp')
+    windows = ngram.form_ngram(rows, None)
+    starts = [w[0]['timestamp'] for w in windows]
+    assert starts == [1, 2, 10, 11]  # 3->10 gap excluded
+
+
+def test_form_ngram_no_overlap():
+    rows = [{'timestamp': t} for t in range(6)]
+    ngram = NGram({0: ['timestamp'], 1: ['timestamp']},
+                  delta_threshold=1, timestamp_field='timestamp',
+                  timestamp_overlap=False)
+    windows = ngram.form_ngram(rows, None)
+    assert [w[0]['timestamp'] for w in windows] == [0, 2, 4]
+
+
+def test_negative_and_sparse_offsets():
+    rows = [{'timestamp': t, 'v': t} for t in range(5)]
+    ngram = NGram({-1: ['v'], 1: ['v', 'timestamp']},
+                  delta_threshold=None, timestamp_field='timestamp')
+    assert ngram.length == 3
+    windows = ngram.form_ngram(rows, None)
+    assert len(windows) == 3
+    assert windows[0][-1] == {'v': 0}
+    assert windows[0][1] == {'v': 2, 'timestamp': 2}
+
+
+def test_invalid_constructions():
+    with pytest.raises(ValueError):
+        NGram({}, 1, 'ts')
+    with pytest.raises(ValueError):
+        NGram({'a': ['x']}, 1, 'ts')
+    with pytest.raises(ValueError):
+        NGram({0: 'not_a_list'}, 1, 'ts')
+
+
+@pytest.mark.parametrize('pool', ['dummy', 'thread'])
+def test_ngram_end_to_end(timeseries_dataset, pool):
+    fields = {0: [TimeseriesSchema.timestamp, TimeseriesSchema.sensor],
+              1: [TimeseriesSchema.timestamp, TimeseriesSchema.sensor,
+                  TimeseriesSchema.label]}
+    ngram = NGram(fields, delta_threshold=2,
+                  timestamp_field=TimeseriesSchema.timestamp)
+    with make_reader(timeseries_dataset.url, schema_fields=ngram,
+                     reader_pool_type=pool, shuffle_row_groups=False) as reader:
+        windows = list(reader)
+    # 40 rows in 2 row-groups of 20; windows never cross row-groups:
+    # rg1 rows 0..19 (no gap) -> 19 windows; rg2 rows 20..39 with the gap at
+    # i=25 (ts 26->36 within rg2) -> 19 - 1 = 18 windows.
+    assert len(windows) == 19 + 18
+    for window in windows:
+        assert set(window) == {0, 1}
+        assert window[1].timestamp - window[0].timestamp <= 2
+        assert window[0].sensor.shape == (3,)
+        assert hasattr(window[1], 'label') and not hasattr(window[0], 'label')
+
+
+def test_ngram_end_to_end_regex_fields(timeseries_dataset):
+    ngram = NGram({0: ['timestamp', 'sens.*'], 1: ['timestamp']},
+                  delta_threshold=2, timestamp_field='timestamp')
+    with make_reader(timeseries_dataset.url, schema_fields=ngram,
+                     reader_pool_type='dummy', shuffle_row_groups=False) as reader:
+        window = next(reader)
+    assert hasattr(window[0], 'sensor')
+    assert hasattr(window[1], 'timestamp')
