@@ -162,6 +162,17 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
             return True
         return name in shape_policies
 
+    schema = getattr(reader, 'transformed_schema', None)
+
+    def _declared_nullable(name):
+        # Row readers carry a deliberate Unischema: its nullable flag is
+        # authoritative (batch readers infer schemas where arrow marks nearly
+        # everything nullable, so probing is used there instead). A
+        # TransformSpec that fills nulls can redeclare the field with
+        # nullable=False via edit_fields to keep it.
+        return (not reader.batched_output and schema is not None
+                and name in schema.fields and schema.fields[name].nullable)
+
     def select_fields(sample):
         nonlocal field_names
         names = []
@@ -172,7 +183,7 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
                 probe = column[0] if (column.dtype.kind == 'O' and len(column)) else column
             else:
                 probe = value
-            if _is_tensor_like(probe, name):
+            if not _declared_nullable(name) and _is_tensor_like(probe, name):
                 names.append(name)
             else:
                 dropped.add(name)
