@@ -1,0 +1,72 @@
+"""ResNet-50 on an ImageNet-style store: the BASELINE.json north-star workload.
+
+Pod-sharded reading (``cur_shard=jax.process_index()``), mesh-sharded batches,
+pjit train step. On a v4-32 run one process per host; this script is the same
+code single-host.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..')))
+
+import argparse
+import time
+
+import jax
+import numpy as np
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.jax_loader import CropTo, JaxLoader
+from petastorm_tpu.models.resnet import ResNet50
+from petastorm_tpu.models.train import create_train_state, make_train_step
+from petastorm_tpu.parallel import make_mesh, process_shard
+
+
+def train(dataset_url, global_batch=256, steps=100, image_size=224,
+          model_parallel=1, log_every=10):
+    n_devices = len(jax.devices())
+    mesh = make_mesh({'data': n_devices // model_parallel, 'model': model_parallel})
+    cur_shard, shard_count = process_shard()
+
+    model = ResNet50(num_classes=1000)
+    state = create_train_state(jax.random.PRNGKey(0), model,
+                               (1, image_size, image_size, 3), mesh=mesh,
+                               learning_rate=0.1)
+    train_step = make_train_step(mesh=mesh)
+
+    crop = CropTo((image_size, image_size, 3))
+    step = 0
+    times = []
+    with make_reader(dataset_url, schema_fields=['image', 'label'],
+                     num_epochs=None, cur_shard=cur_shard,
+                     shard_count=shard_count, workers_count=10,
+                     shuffle_row_groups=True, seed=0) as reader:
+        with JaxLoader(reader, global_batch, mesh=mesh,
+                       shape_policies={'image': crop}) as loader:
+            for batch in loader:
+                start = time.perf_counter()
+                state, metrics = train_step(
+                    state, batch.image.astype('float32') / 255.0, batch.label)
+                jax.block_until_ready(metrics['loss'])
+                times.append(time.perf_counter() - start)
+                step += 1
+                if step % log_every == 0:
+                    rate = global_batch / np.mean(times[-log_every:])
+                    print('step {}: loss {:.4f} | {:.1f} img/s ({:.1f} img/s/chip)'.format(
+                        step, float(metrics["loss"]), rate, rate / n_devices))
+                if step >= steps:
+                    break
+    return state
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--dataset-url', default='file:///tmp/imagenet_dataset')
+    parser.add_argument('--global-batch', type=int, default=256)
+    parser.add_argument('--steps', type=int, default=100)
+    parser.add_argument('--image-size', type=int, default=224)
+    parser.add_argument('--model-parallel', type=int, default=1)
+    args = parser.parse_args()
+    train(args.dataset_url, args.global_batch, args.steps, args.image_size,
+          args.model_parallel)

@@ -1,0 +1,14 @@
+"""ImageNet-style schema (parity: reference ``examples/imagenet/schema.py`` —
+noun_id/text + variable-size png image)."""
+
+import numpy as np
+
+from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+ImagenetSchema = Unischema('ImagenetSchema', [
+    UnischemaField('noun_id', np.str_, (), ScalarCodec(np.str_), False),
+    UnischemaField('text', np.str_, (), ScalarCodec(np.str_), False),
+    UnischemaField('label', np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField('image', np.uint8, (None, None, 3), CompressedImageCodec('jpeg', 90), False),
+])

@@ -1,0 +1,12 @@
+"""MNIST-style schema (parity: reference ``examples/mnist/schema.py:21-25``)."""
+
+import numpy as np
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+MnistSchema = Unischema('MnistSchema', [
+    UnischemaField('idx', np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField('digit', np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField('image', np.uint8, (8, 8), NdarrayCodec(), False),
+])

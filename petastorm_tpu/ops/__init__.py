@@ -1,0 +1,5 @@
+"""On-device input-path ops (Pallas TPU kernels with XLA fallbacks)."""
+
+from petastorm_tpu.ops.image_ops import (normalize_images,  # noqa: F401
+                                         normalize_images_reference,
+                                         random_flip_and_normalize)

@@ -1,0 +1,83 @@
+"""Fused on-device image preprocessing.
+
+The last hop of the input pipeline — uint8 HBM batches -> normalized bf16 —
+runs on-device so the host hands over raw bytes (4x smaller transfers than
+shipping float32) and the cast/scale/shift fuses into one VMEM pass instead
+of materializing float intermediates in HBM.
+
+``normalize_images`` is a Pallas TPU kernel (VPU elementwise over (8,128)
+tiles); ``normalize_images_reference`` is the pure-XLA equivalent used as a
+fallback on CPU and as the correctness oracle in tests.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_IMAGENET_MEAN = (0.485, 0.456, 0.406)
+_IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def normalize_images_reference(images, mean=_IMAGENET_MEAN, std=_IMAGENET_STD,
+                               dtype=jnp.bfloat16):
+    """Pure-XLA: uint8 NHWC -> ((x/255) - mean)/std in ``dtype``."""
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    x = images.astype(jnp.float32) / 255.0
+    return ((x - mean) / std).astype(dtype)
+
+
+def _normalize_kernel(images_ref, scale_ref, shift_ref, out_ref):
+    # One grid step owns a (1, H, W, C) block resident in VMEM.
+    x = images_ref[...].astype(jnp.float32)
+    # scale/shift are (1, 1, 1, C): broadcast over the VPU lanes.
+    out_ref[...] = (x * scale_ref[...] + shift_ref[...]).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=('dtype', 'interpret'))
+def _normalize_pallas(images, scale, shift, dtype=jnp.bfloat16, interpret=False):
+    from jax.experimental import pallas as pl
+
+    n, h, w, c = images.shape
+    return pl.pallas_call(
+        _normalize_kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, 1, 1, c), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((1, 1, 1, c), lambda i: (0, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h, w, c), dtype),
+        interpret=interpret,
+    )(images, scale, shift)
+
+
+def normalize_images(images, mean=_IMAGENET_MEAN, std=_IMAGENET_STD,
+                     dtype=jnp.bfloat16):
+    """Fused uint8->normalized-``dtype`` conversion.
+
+    Uses the Pallas kernel on TPU; falls back to the XLA reference elsewhere
+    (CPU/interpret mode is only for tests — XLA fuses this fine on CPU).
+    """
+    if images.ndim != 4:
+        raise ValueError('Expected NHWC batch, got shape {}'.format(images.shape))
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    # Fold /255 into a single multiply-add: x*scale + shift.
+    scale = (1.0 / (255.0 * std)).reshape(1, 1, 1, -1)
+    shift = (-mean / std).reshape(1, 1, 1, -1)
+    if jax.default_backend() == 'tpu':
+        return _normalize_pallas(images, scale, shift, dtype=dtype)
+    return normalize_images_reference(images, mean, std, dtype)
+
+
+def random_flip_and_normalize(rng, images, mean=_IMAGENET_MEAN, std=_IMAGENET_STD,
+                              dtype=jnp.bfloat16):
+    """Per-sample random horizontal flip + fused normalization (train-time)."""
+    n = images.shape[0]
+    flips = jax.random.bernoulli(rng, 0.5, (n,))
+    flipped = jnp.where(flips[:, None, None, None],
+                        jnp.flip(images, axis=2), images)
+    return normalize_images(flipped, mean, std, dtype)

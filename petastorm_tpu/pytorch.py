@@ -1,0 +1,154 @@
+"""PyTorch adapter: ``DataLoader`` over a petastorm_tpu Reader.
+
+Parity: reference ``petastorm/pytorch.py`` — per-row dtype sanitization
+(bool->uint8, uint16->int32 etc., strings rejected, ``:36-66``), optional
+``RandomShufflingBuffer`` decorrelation, transposition of batched (Arrow)
+rows into per-row tuples for shuffling (``:166-175``), collation
+(``decimal_friendly_collate``, ``:69-91``), buffer drain + partial final batch
+(``:182-192``).
+"""
+
+import decimal
+import re
+
+import numpy as np
+
+from petastorm_tpu.shuffling_buffer import (NoopShufflingBuffer,
+                                            RandomShufflingBuffer)
+
+_TORCH_IMPORT_ERROR = None
+try:
+    import torch
+    from torch.utils.data.dataloader import default_collate
+except ImportError as e:  # pragma: no cover
+    torch = None
+    _TORCH_IMPORT_ERROR = e
+
+
+def _require_torch():
+    if torch is None:  # pragma: no cover
+        raise RuntimeError('petastorm_tpu.pytorch requires torch: {}'.format(
+            _TORCH_IMPORT_ERROR))
+
+
+def _sanitize_pytorch_types(row_as_dict):
+    """In-place dtype fixes for torch compatibility (parity: ``pytorch.py:36-66``)."""
+    for name, value in row_as_dict.items():
+        if isinstance(value, np.ndarray):
+            if value.dtype == np.uint16:
+                row_as_dict[name] = value.astype(np.int32)
+            elif value.dtype == np.uint32:
+                row_as_dict[name] = value.astype(np.int64)
+            elif value.dtype == np.bool_:
+                row_as_dict[name] = value.astype(np.uint8)
+            elif re.search('[SaUO]', value.dtype.str):
+                raise TypeError('Field {} has dtype {} which is not supported by torch'
+                                .format(name, value.dtype))
+        elif isinstance(value, np.bool_):
+            row_as_dict[name] = np.uint8(value)
+        elif isinstance(value, np.uint16):
+            row_as_dict[name] = np.int32(value)
+        elif isinstance(value, np.uint32):
+            row_as_dict[name] = np.int64(value)
+        elif isinstance(value, str):
+            raise TypeError('Field {} is a string; strings are not supported by torch. '
+                            'Use a TransformSpec to drop or encode it'.format(name))
+
+
+def decimal_friendly_collate(batch):
+    """Collate that leaves ``decimal.Decimal`` values as python lists.
+
+    Parity: reference ``pytorch.py:69-91``.
+    """
+    _require_torch()
+    if isinstance(batch[0], decimal.Decimal):
+        return batch
+    if hasattr(batch[0], '_fields'):  # namedtuple — must precede the tuple branch
+        return type(batch[0])(*(decimal_friendly_collate(samples)
+                                for samples in zip(*batch)))
+    if isinstance(batch[0], (tuple, list)) and not isinstance(batch[0], str):
+        transposed = zip(*batch)
+        return [decimal_friendly_collate(samples) for samples in transposed]
+    if isinstance(batch[0], dict):
+        return {key: decimal_friendly_collate([d[key] for d in batch])
+                for key in batch[0]}
+    return default_collate(batch)
+
+
+class DataLoader(object):
+    """Iterates torch batches off a Reader.
+
+    Parity: reference ``pytorch.py:94-215``.
+    """
+
+    def __init__(self, reader, batch_size=1, collate_fn=None,
+                 shuffling_queue_capacity=0, min_after_dequeue=None, seed=None):
+        _require_torch()
+        self.reader = reader
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or decimal_friendly_collate
+        self.shuffling_queue_capacity = shuffling_queue_capacity
+        self._min_after_dequeue = (min_after_dequeue
+                                   if min_after_dequeue is not None
+                                   else shuffling_queue_capacity * 4 // 5)
+        self._seed = seed
+        self._in_iter = False
+
+    def __iter__(self):
+        if self._in_iter:
+            raise RuntimeError('Only one iterator per DataLoader is supported')
+        self._in_iter = True
+        try:
+            yield from self._iter_impl()
+        finally:
+            self._in_iter = False
+
+    def _iter_impl(self):
+        if self.shuffling_queue_capacity > 0:
+            buffer = RandomShufflingBuffer(self.shuffling_queue_capacity,
+                                           self._min_after_dequeue,
+                                           extra_capacity=100000, seed=self._seed)
+        else:
+            buffer = NoopShufflingBuffer()
+
+        nt_type = self.reader.transformed_schema.namedtuple_type()
+
+        batch = []
+        for row in self.reader:
+            if self.reader.batched_output:
+                # Transpose row-group columns into rows (pytorch.py:166-175).
+                row_dict = row._asdict()
+                keys = list(row_dict)
+                columns = [row_dict[k] for k in keys]
+                rows = [dict(zip(keys, values)) for values in zip(*columns)]
+            else:
+                rows = [row._asdict()]
+            for row_dict in rows:
+                _sanitize_pytorch_types(row_dict)
+            buffer.add_many([nt_type(**r) for r in rows])
+            while buffer.can_retrieve():
+                batch.append(buffer.retrieve())
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+
+        buffer.finish()
+        while buffer.can_retrieve():
+            batch.append(buffer.retrieve())
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch:
+            yield self.collate_fn(batch)  # partial final batch (pytorch.py:191-192)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.reader.stop()
+        self.reader.join()
+        return False
+
+
+class BatchedDataLoader(DataLoader):
+    """Alias retained for reference-API familiarity (petastorm exposes both)."""

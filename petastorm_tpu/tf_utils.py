@@ -1,0 +1,115 @@
+"""TensorFlow adapter: ``make_petastorm_dataset`` (tf.data) over a Reader.
+
+Parity: reference ``petastorm/tf_utils.py`` — ``make_petastorm_dataset``
+(``Dataset.from_generator`` + namedtuple map + static shapes,
+``tf_utils.py:348-402``), dtype sanitization (Decimal->str, uint16->int32,
+uint32->int64, datetime->ns-epoch int64, ``:58-97``), np->tf dtype map
+(``:27-44``). The graph-mode ``tf_tensors`` queue-runner path (``:289-338``)
+is deliberately not reproduced: it is TF1 API surface; tf.data is the
+supported route on TF2.
+"""
+
+import datetime
+import decimal
+
+import numpy as np
+
+_TF_IMPORT_ERROR = None
+try:
+    import tensorflow as tf
+except ImportError as e:  # pragma: no cover
+    tf = None
+    _TF_IMPORT_ERROR = e
+
+
+def _require_tf():
+    if tf is None:  # pragma: no cover
+        raise RuntimeError('petastorm_tpu.tf_utils requires tensorflow: {}'.format(
+            _TF_IMPORT_ERROR))
+
+
+_NUMPY_TO_TF_DTYPE = None
+
+
+def _np_to_tf_dtype(np_dtype):
+    """Parity: reference ``tf_utils.py:27-44``."""
+    global _NUMPY_TO_TF_DTYPE
+    if _NUMPY_TO_TF_DTYPE is None:
+        _NUMPY_TO_TF_DTYPE = {
+            np.dtype('bool'): tf.bool,
+            np.dtype('int8'): tf.int8,
+            np.dtype('uint8'): tf.uint8,
+            np.dtype('int16'): tf.int16,
+            np.dtype('uint16'): tf.int32,   # promoted
+            np.dtype('int32'): tf.int32,
+            np.dtype('uint32'): tf.int64,   # promoted
+            np.dtype('int64'): tf.int64,
+            np.dtype('float16'): tf.float16,
+            np.dtype('float32'): tf.float32,
+            np.dtype('float64'): tf.float64,
+        }
+    np_dtype = np.dtype(np_dtype)
+    if np_dtype.kind in ('U', 'S', 'O'):
+        return tf.string
+    if np_dtype.kind == 'M':
+        return tf.int64
+    if np_dtype not in _NUMPY_TO_TF_DTYPE:
+        raise ValueError('Unsupported dtype for TF: {}'.format(np_dtype))
+    return _NUMPY_TO_TF_DTYPE[np_dtype]
+
+
+def _sanitize_field_tf_types(sample_dict):
+    """Value fixes before handing to TF (parity: ``tf_utils.py:58-97``)."""
+    out = {}
+    for name, value in sample_dict.items():
+        if value is None:
+            raise RuntimeError('Field {} is None; TF cannot represent null scalars. '
+                               'Filter nulls with a predicate or TransformSpec'.format(name))
+        if isinstance(value, decimal.Decimal):
+            value = str(value)
+        elif isinstance(value, np.ndarray) and value.dtype.kind == 'M':
+            value = value.astype('datetime64[ns]').astype(np.int64)
+        elif isinstance(value, (np.datetime64, datetime.date, datetime.datetime)):
+            value = np.datetime64(value, 'ns').astype(np.int64)
+        elif isinstance(value, np.ndarray) and value.dtype == np.uint16:
+            value = value.astype(np.int32)
+        elif isinstance(value, np.ndarray) and value.dtype == np.uint32:
+            value = value.astype(np.int64)
+        elif isinstance(value, np.uint16):
+            value = np.int32(value)
+        elif isinstance(value, np.uint32):
+            value = np.int64(value)
+        out[name] = value
+    return out
+
+
+def make_petastorm_dataset(reader):
+    """``tf.data.Dataset`` over a Reader (row or batch flavor).
+
+    Parity: reference ``tf_utils.py:348-402``. NGram readers are not supported
+    (``:402``). The dataset ends with the reader's epochs; construct the
+    Reader with ``num_epochs=None`` for an infinite dataset instead of
+    ``.repeat()`` (``:386-392``).
+    """
+    _require_tf()
+    if reader.ngram is not None:
+        raise NotImplementedError('make_petastorm_dataset does not support NGram readers')
+
+    schema = reader.transformed_schema
+    fields = list(schema.fields.values())
+    nt_type = schema.namedtuple_type()
+
+    output_types = tuple(_np_to_tf_dtype(f.numpy_dtype) for f in fields)
+    if reader.batched_output:
+        shapes = tuple(tf.TensorShape([None] + [d for d in f.shape]) for f in fields)
+    else:
+        shapes = tuple(tf.TensorShape(list(f.shape)) for f in fields)
+
+    def generator():
+        for sample in reader:
+            sanitized = _sanitize_field_tf_types(sample._asdict())
+            yield tuple(sanitized[f.name] for f in fields)
+
+    dataset = tf.data.Dataset.from_generator(generator, output_types=output_types,
+                                             output_shapes=shapes)
+    return dataset.map(lambda *args: nt_type(*args))

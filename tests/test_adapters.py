@@ -1,0 +1,140 @@
+"""PyTorch / TF adapter tests (parity: reference ``test_pytorch_dataloader.py``
++ ``test_tf_dataset.py``)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import TransformSpec, make_batch_reader, make_reader
+
+
+def _row_reader(url, **kw):
+    kw.setdefault('reader_pool_type', 'dummy')
+    kw.setdefault('shuffle_row_groups', False)
+    return make_reader(url, **kw)
+
+
+# --- torch ----------------------------------------------------------------
+
+def test_torch_dataloader_batches(synthetic_dataset):
+    import torch
+    from petastorm_tpu.pytorch import DataLoader
+
+    with DataLoader(_row_reader(synthetic_dataset.url,
+                                schema_fields=['id', 'matrix']),
+                    batch_size=10) as loader:
+        batches = list(loader)
+    assert len(batches) == 5
+    assert isinstance(batches[0].matrix, torch.Tensor)
+    assert batches[0].matrix.shape == (10, 4, 5)
+    all_ids = torch.cat([b.id for b in batches])
+    assert sorted(all_ids.tolist()) == list(range(50))
+
+
+def test_torch_dataloader_partial_final_batch(synthetic_dataset):
+    from petastorm_tpu.pytorch import DataLoader
+
+    with DataLoader(_row_reader(synthetic_dataset.url, schema_fields=['id']),
+                    batch_size=8) as loader:
+        batches = list(loader)
+    assert [len(b.id) for b in batches] == [8, 8, 8, 8, 8, 8, 2]
+
+
+def test_torch_dataloader_shuffling_seeded(synthetic_dataset):
+    from petastorm_tpu.pytorch import DataLoader
+
+    def read(seed):
+        with DataLoader(_row_reader(synthetic_dataset.url, schema_fields=['id']),
+                        batch_size=50, shuffling_queue_capacity=20, seed=seed) as loader:
+            return next(iter(loader)).id.tolist()
+
+    assert read(4) == read(4)
+    assert read(4) != list(range(50))
+
+
+def test_torch_dataloader_string_rejected(synthetic_dataset):
+    from petastorm_tpu.pytorch import DataLoader
+
+    with pytest.raises(TypeError, match='string'):
+        with DataLoader(_row_reader(synthetic_dataset.url,
+                                    schema_fields=['id', 'sensor_name']),
+                        batch_size=4) as loader:
+            next(iter(loader))
+
+
+def test_torch_dataloader_batched_reader(scalar_dataset):
+    import torch
+    from petastorm_tpu.pytorch import DataLoader
+
+    reader = make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                               shuffle_row_groups=False,
+                               transform_spec=TransformSpec(
+                                   selected_fields=['id', 'float_col', 'int_fixed']))
+    with DataLoader(reader, batch_size=25) as loader:
+        batches = list(loader)
+    assert len(batches) == 4
+    assert isinstance(batches[0].float_col, torch.Tensor)
+    all_ids = torch.cat([b.id for b in batches])
+    assert sorted(all_ids.tolist()) == list(range(100))
+
+
+def test_torch_sanitization_types():
+    from petastorm_tpu.pytorch import _sanitize_pytorch_types
+
+    row = {'a': np.uint16(3), 'b': np.bool_(True),
+           'c': np.arange(3, dtype=np.uint32), 'd': np.float32(1.5)}
+    _sanitize_pytorch_types(row)
+    assert row['a'].dtype == np.int32
+    assert row['b'].dtype == np.uint8
+    assert row['c'].dtype == np.int64
+    assert row['d'].dtype == np.float32
+
+
+# --- tf -------------------------------------------------------------------
+
+def test_tf_dataset_row_reader(synthetic_dataset):
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+    with _row_reader(synthetic_dataset.url,
+                     schema_fields=['id', 'image_png', 'sensor_name']) as reader:
+        dataset = make_petastorm_dataset(reader)
+        rows = list(dataset.take(50).as_numpy_iterator())
+    assert len(rows) == 50
+    assert rows[0].image_png.shape == (32, 16, 3)
+    assert isinstance(rows[0].sensor_name, bytes)
+    assert sorted(r.id for r in rows) == list(range(50))
+
+
+def test_tf_dataset_static_shapes(synthetic_dataset):
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+    with _row_reader(synthetic_dataset.url, schema_fields=['image_png', 'matrix']) as reader:
+        dataset = make_petastorm_dataset(reader)
+        spec = dataset.element_spec
+    assert spec.image_png.shape.as_list() == [32, 16, 3]
+    assert spec.matrix.shape.as_list() == [4, 5]
+
+
+def test_tf_dataset_batch_reader(scalar_dataset):
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                           shuffle_row_groups=False,
+                           transform_spec=TransformSpec(
+                               selected_fields=['id', 'float_col'])) as reader:
+        dataset = make_petastorm_dataset(reader)
+        batches = list(dataset.as_numpy_iterator())
+    ids = np.concatenate([b.id for b in batches])
+    assert sorted(ids.tolist()) == list(range(100))
+
+
+def test_tf_dataset_ngram_rejected(timeseries_dataset):
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+    from tests.conftest import TimeseriesSchema
+
+    ngram = NGram({0: [TimeseriesSchema.timestamp]}, delta_threshold=1,
+                  timestamp_field=TimeseriesSchema.timestamp)
+    with make_reader(timeseries_dataset.url, schema_fields=ngram,
+                     reader_pool_type='dummy') as reader:
+        with pytest.raises(NotImplementedError):
+            make_petastorm_dataset(reader)

@@ -1,0 +1,53 @@
+"""On-device op tests: Pallas kernel (interpret mode on CPU) vs XLA oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from petastorm_tpu.ops.image_ops import (_normalize_pallas,
+                                         normalize_images,
+                                         normalize_images_reference,
+                                         random_flip_and_normalize)
+
+
+def test_pallas_kernel_matches_reference_in_interpret_mode():
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.integers(0, 255, (4, 16, 128, 3), dtype=np.uint8))
+    mean = jnp.asarray((0.485, 0.456, 0.406), jnp.float32)
+    std = jnp.asarray((0.229, 0.224, 0.225), jnp.float32)
+    scale = (1.0 / (255.0 * std)).reshape(1, 1, 1, -1)
+    shift = (-mean / std).reshape(1, 1, 1, -1)
+    got = _normalize_pallas(images, scale, shift, dtype=jnp.float32, interpret=True)
+    want = normalize_images_reference(images, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_normalize_images_cpu_path():
+    rng = np.random.default_rng(1)
+    images = jnp.asarray(rng.integers(0, 255, (2, 8, 8, 3), dtype=np.uint8))
+    out = normalize_images(images, dtype=jnp.float32)
+    assert out.shape == images.shape
+    assert out.dtype == jnp.float32
+    # A mid-gray pixel normalizes near zero
+    gray = normalize_images(jnp.full((1, 4, 4, 3), 124, jnp.uint8), dtype=jnp.float32)
+    assert abs(float(gray.mean())) < 0.35
+
+
+def test_normalize_rejects_non_batch():
+    with pytest.raises(ValueError):
+        normalize_images(jnp.zeros((8, 8, 3), jnp.uint8))
+
+
+def test_random_flip_and_normalize():
+    import jax
+    rng = np.random.default_rng(2)
+    images = jnp.asarray(rng.integers(0, 255, (8, 4, 6, 3), dtype=np.uint8))
+    out = random_flip_and_normalize(jax.random.PRNGKey(0), images, dtype=jnp.float32)
+    assert out.shape == images.shape
+    ref = normalize_images_reference(images, dtype=jnp.float32)
+    flipped_ref = np.flip(np.asarray(ref), axis=2)
+    # Every sample equals either the normalized original or its mirror
+    for i in range(8):
+        sample = np.asarray(out[i])
+        assert (np.allclose(sample, np.asarray(ref)[i], atol=1e-5)
+                or np.allclose(sample, flipped_ref[i], atol=1e-5))
