@@ -44,12 +44,15 @@ def train(dataset_url, global_batch=256, steps=100, image_size=224,
                      shuffle_row_groups=True, seed=0) as reader:
         with JaxLoader(reader, global_batch, mesh=mesh,
                        shape_policies={'image': crop}) as loader:
+            # time whole iterations (fetch + step) so input stall shows up
+            prev = time.perf_counter()
             for batch in loader:
-                start = time.perf_counter()
                 state, metrics = train_step(
                     state, batch.image.astype('float32') / 255.0, batch.label)
                 jax.block_until_ready(metrics['loss'])
-                times.append(time.perf_counter() - start)
+                now = time.perf_counter()
+                times.append(now - prev)
+                prev = now
                 step += 1
                 if step % log_every == 0:
                     rate = global_batch / np.mean(times[-log_every:])

@@ -35,6 +35,23 @@ except ImportError:  # pragma: no cover
     _HAS_PIL = False
 
 
+def _native_image():
+    """The in-tree C++ codec (native/src/image_codec.cc), or None.
+
+    Preferred over cv2/PIL: decodes straight to RGB (no BGR detour) and
+    offers a GIL-free batch decode used by the workers. Disable with
+    PETASTORM_TPU_NO_NATIVE=1.
+    """
+    import os
+    if os.environ.get('PETASTORM_TPU_NO_NATIVE'):
+        return None
+    try:
+        from petastorm_tpu.native import image as native_image
+    except Exception:  # pragma: no cover - toolchain missing
+        return None
+    return native_image if native_image.available() else None
+
+
 _CODEC_REGISTRY = {}
 
 
@@ -272,6 +289,11 @@ class CompressedImageCodec(DataframeColumnCodec):
         if self._format == 'jpeg' and value.dtype != np.uint8:
             raise ValueError('jpeg only supports uint8 (field {!r} is {})'.format(
                 field.name, value.dtype))
+        native = _native_image()
+        if native is not None:
+            if self._format == 'jpeg':
+                return native.encode_jpeg(value, quality=self._quality)
+            return native.encode_png(value)
         if _HAS_CV2:
             import cv2
             if value.ndim == 3:
@@ -297,6 +319,9 @@ class CompressedImageCodec(DataframeColumnCodec):
         raise RuntimeError('CompressedImageCodec requires cv2 or PIL')
 
     def decode(self, field, encoded):
+        native = _native_image()
+        if native is not None:
+            return native.decode_image(bytes(encoded))
         if _HAS_CV2:
             import cv2
             raw = np.frombuffer(encoded, dtype=np.uint8)

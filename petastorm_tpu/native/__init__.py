@@ -1,0 +1,21 @@
+"""Native (C++) components of petastorm_tpu.
+
+The reference keeps all native horsepower in dependencies (Arrow/Parquet C++,
+libzmq, OpenCV — see SURVEY.md §2.9 / reference ``setup.py``). Here the hot
+host-side paths are first-class C++ sources in this package, built on demand
+with the system toolchain and loaded through ``ctypes``:
+
+- :mod:`petastorm_tpu.native.image` — JPEG/PNG codec on libjpeg/libpng with a
+  multithreaded batch decode (GIL released for the whole batch).
+- :mod:`petastorm_tpu.native.shm_ring` — POSIX shared-memory ring buffer used
+  as a zero-syscall results transport for the process pool (alternative to
+  the reference's ZeroMQ tcp://127.0.0.1 sockets, ``process_pool.py:52-74``).
+- :mod:`petastorm_tpu.native.parquet` — Parquet row-group reader linked
+  against pyarrow's bundled libparquet/libarrow, exporting record batches
+  zero-copy over the Arrow C Data Interface.
+
+Every module degrades gracefully: ``available()`` returns False when the
+toolchain or a library is missing and pure-Python/pyarrow paths take over.
+"""
+
+from petastorm_tpu.native.build import build_and_load, native_cache_dir  # noqa: F401

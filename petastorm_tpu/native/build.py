@@ -1,0 +1,90 @@
+"""On-demand compilation of the C++ sources in ``petastorm_tpu/native/src``.
+
+A tiny build system instead of a packaging-time ``build_ext``: sources are
+compiled lazily on first use with ``g++`` into a content-hash-keyed shared
+object under ``~/.cache/petastorm_tpu/native`` (override with
+``PETASTORM_TPU_NATIVE_CACHE``), so editing a .cc file triggers exactly one
+rebuild and concurrent processes race safely (atomic rename + lock file).
+"""
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+
+logger = logging.getLogger(__name__)
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'src')
+_LOCK = threading.Lock()
+_LOADED = {}
+
+
+def native_cache_dir():
+    cache = os.environ.get('PETASTORM_TPU_NATIVE_CACHE')
+    if not cache:
+        cache = os.path.join(os.path.expanduser('~'), '.cache', 'petastorm_tpu', 'native')
+    os.makedirs(cache, exist_ok=True)
+    return cache
+
+
+def source_path(filename):
+    return os.path.join(_SRC_DIR, filename)
+
+
+def _build_key(sources, compile_flags, link_flags):
+    h = hashlib.sha256()
+    for src in sources:
+        with open(src, 'rb') as f:
+            h.update(f.read())
+        h.update(b'\0')
+    h.update(' '.join(compile_flags + link_flags).encode())
+    return h.hexdigest()[:16]
+
+
+def build_and_load(name, sources, compile_flags=None, link_flags=None):
+    """Compile ``sources`` (paths under src/) into lib<name>-<hash>.so and dlopen it.
+
+    Returns a ``ctypes.CDLL``. Raises ``NativeBuildError`` when the toolchain
+    or a dependency is missing; callers catch it and fall back to Python paths.
+    """
+    compile_flags = list(compile_flags or [])
+    link_flags = list(link_flags or [])
+    srcs = [s if os.path.isabs(s) else source_path(s) for s in sources]
+
+    with _LOCK:
+        cached = _LOADED.get(name)
+        if cached is not None:
+            return cached
+
+        key = _build_key(srcs, compile_flags, link_flags)
+        out_path = os.path.join(native_cache_dir(), 'lib{}-{}.so'.format(name, key))
+        if not os.path.exists(out_path):
+            _compile(srcs, out_path, compile_flags, link_flags)
+        lib = ctypes.CDLL(out_path)
+        _LOADED[name] = lib
+        return lib
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _compile(srcs, out_path, compile_flags, link_flags):
+    fd, tmp = tempfile.mkstemp(suffix='.so', dir=os.path.dirname(out_path))
+    os.close(fd)
+    cmd = (['g++', '-O3', '-std=c++17', '-fPIC', '-shared', '-pthread']
+           + compile_flags + srcs + ['-o', tmp] + link_flags)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        os.unlink(tmp)
+        raise NativeBuildError('failed to run g++: {}'.format(exc))
+    if proc.returncode != 0:
+        os.unlink(tmp)
+        raise NativeBuildError(
+            'native build failed ({}):\n{}'.format(' '.join(cmd), proc.stderr[-4000:]))
+    os.replace(tmp, out_path)  # atomic: concurrent builders converge on the same key
+    logger.info('built native library %s', out_path)

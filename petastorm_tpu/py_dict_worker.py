@@ -10,7 +10,7 @@ queue reader that buffers a chunk and pops single rows (``:64-97``).
 
 import hashlib
 
-from petastorm_tpu.unischema import decode_row
+from petastorm_tpu.unischema import decode_rows
 from petastorm_tpu.workers.rowgroup_worker_base import (RowGroupWorkerBase,
                                                         compute_row_slice)
 
@@ -94,7 +94,8 @@ class PyDictWorker(RowGroupWorkerBase):
             decode_schema = (self.args['full_schema'].create_schema_view(
                 [n for n in field_names if n in self.args['full_schema'].fields])
                 if self.args['ngram'] is not None else schema)
-            return [decode_row(row, decode_schema) for row in encoded_rows]
+            return decode_rows(encoded_rows, decode_schema,
+                               num_threads=self.args.get('decode_threads'))
 
         return self.args['cache'].get(cache_key, load)
 
@@ -113,7 +114,8 @@ class PyDictWorker(RowGroupWorkerBase):
 
         predicate_schema = full_schema.create_schema_view(sorted(predicate_fields))
         encoded_pred_rows = self._read_columns(piece, sorted(predicate_fields))
-        decoded_pred_rows = [decode_row(row, predicate_schema) for row in encoded_pred_rows]
+        decoded_pred_rows = decode_rows(encoded_pred_rows, predicate_schema,
+                                        num_threads=self.args.get('decode_threads'))
         mask = [predicate.do_include(row) for row in decoded_pred_rows]
         if not any(mask):
             return []
@@ -121,11 +123,12 @@ class PyDictWorker(RowGroupWorkerBase):
         if other_fields:
             other_schema = schema.create_schema_view(other_fields)
             encoded_other = self._read_columns(piece, other_fields)
+            surviving = [(pred_row, other_row) for include, pred_row, other_row
+                         in zip(mask, decoded_pred_rows, encoded_other) if include]
+            decoded_other = decode_rows([other for _, other in surviving], other_schema,
+                                        num_threads=self.args.get('decode_threads'))
             result = []
-            for include, pred_row, other_row in zip(mask, decoded_pred_rows, encoded_other):
-                if not include:
-                    continue
-                decoded = decode_row(other_row, other_schema)
+            for (pred_row, _), decoded in zip(surviving, decoded_other):
                 decoded.update({k: v for k, v in pred_row.items() if k in schema.fields})
                 result.append(decoded)
             return result

@@ -84,6 +84,11 @@ class DataLoader(object):
     def __init__(self, reader, batch_size=1, collate_fn=None,
                  shuffling_queue_capacity=0, min_after_dequeue=None, seed=None):
         _require_torch()
+        if getattr(reader, 'ngram', None) is not None:
+            raise NotImplementedError(
+                'pytorch.DataLoader does not support NGram readers '
+                '(parity: reference pytorch.py has no ngram path either); '
+                'consume the reader directly for windowed rows')
         self.reader = reader
         self.batch_size = batch_size
         self.collate_fn = collate_fn or decimal_friendly_collate

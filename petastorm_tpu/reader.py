@@ -10,6 +10,7 @@ keyed on TPU pods by ``jax.process_index()/jax.process_count()``.
 
 import hashlib
 import logging
+import os
 import warnings
 
 from petastorm_tpu.arrow_worker import ArrowResultsQueueReader, ArrowWorker
@@ -220,6 +221,8 @@ class Reader(object):
             'transformed_schema': self._transformed_schema,
             'partition_names': store.partition_names,
             'dataset_path_hash': hashlib.md5(store.url.encode()).hexdigest()[:12],
+            # fair share of host cores for each worker's native decode threads
+            'decode_threads': max(1, (os.cpu_count() or 4) // max(1, self._pool_workers_count())),
         }
 
         items = []

@@ -337,6 +337,59 @@ def decode_row(row, schema):
     return decoded
 
 
+def decode_rows(rows, schema, num_threads=None):
+    """Decode a whole row-group's encoded rows.
+
+    Equivalent to ``[decode_row(r, schema) for r in rows]`` but image fields
+    are decoded together through the native C++ batch decoder
+    (``native/src/image_codec.cc``) with the GIL released — the hot-loop
+    upgrade over the reference's per-row ``cv2.imdecode`` dispatch
+    (reference ``py_dict_reader_worker.py:181`` -> ``utils.py:54-87``).
+
+    ``num_threads`` caps the C++ decode threads; pool workers pass their
+    fair share of the host cores so N concurrent workers don't oversubscribe.
+    """
+    from petastorm_tpu import codecs as _codecs
+    from petastorm_tpu.errors import DecodeFieldError
+
+    native = _codecs._native_image()
+    image_fields = []
+    if native is not None and len(rows) > 1:
+        image_fields = [name for name, field in schema.fields.items()
+                        if isinstance(field.resolved_codec(), _codecs.CompressedImageCodec)]
+    if not image_fields:
+        return [decode_row(row, schema) for row in rows]
+
+    rest_fields = [n for n in schema.fields if n not in image_fields]
+    rest_schema = schema.create_schema_view(rest_fields) if rest_fields else None
+    decoded = []
+    blob_slots = []  # (row_index, field_name)
+    blobs = []
+    for i, row in enumerate(rows):
+        # decode_row skips fields outside the view, so no need to pre-filter
+        d = decode_row(row, rest_schema) if rest_schema is not None else {}
+        for name in image_fields:
+            if name not in row:
+                continue
+            value = row[name]
+            if value is None:
+                d[name] = None
+            else:
+                blob_slots.append((i, name))
+                blobs.append(bytes(value))
+                d[name] = None  # filled below
+        decoded.append(d)
+    if blobs:
+        try:
+            images = native.decode_batch(blobs, num_threads=num_threads)
+        except Exception as e:
+            raise DecodeFieldError('Unable to batch-decode image fields {}: {}'.format(
+                image_fields, e)) from e
+        for (i, name), img in zip(blob_slots, images):
+            decoded[i][name] = img
+    return decoded
+
+
 def copy_schema(schema, name=None):
     """Deep-copy a schema (used by transform_schema edits)."""
     return Unischema(name or schema.name, [copy.copy(f) for f in schema.fields.values()])
