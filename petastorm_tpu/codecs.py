@@ -318,10 +318,30 @@ class CompressedImageCodec(DataframeColumnCodec):
             return buf.getvalue()
         raise RuntimeError('CompressedImageCodec requires cv2 or PIL')
 
+    @staticmethod
+    def conform_channels(arr, field):
+        """Match decoded channel layout to ``field.shape``.
+
+        cv2-path parity: 3-D fields were always decoded to exactly 3 channels
+        (``IMREAD_COLOR``); the native decoder returns file-native channels,
+        so gray/RGBA streams inside an (H, W, 3) field are coerced here.
+        """
+        want = field.shape
+        if len(want) == 3 and want[2] == 3:
+            if arr.ndim == 2:
+                return np.repeat(arr[:, :, None], 3, axis=2)
+            if arr.ndim == 3 and arr.shape[2] == 1:
+                return np.repeat(arr, 3, axis=2)
+            if arr.ndim == 3 and arr.shape[2] == 4:
+                return np.ascontiguousarray(arr[:, :, :3])
+        elif len(want) == 2 and arr.ndim == 3 and arr.shape[2] == 1:
+            return arr[:, :, 0]
+        return arr
+
     def decode(self, field, encoded):
         native = _native_image()
         if native is not None:
-            return native.decode_image(bytes(encoded))
+            return self.conform_channels(native.decode_image(bytes(encoded)), field)
         if _HAS_CV2:
             import cv2
             raw = np.frombuffer(encoded, dtype=np.uint8)

@@ -62,7 +62,13 @@ def build_and_load(name, sources, compile_flags=None, link_flags=None):
         key = _build_key(srcs, compile_flags, link_flags)
         out_path = os.path.join(native_cache_dir(), 'lib{}-{}.so'.format(name, key))
         if not os.path.exists(out_path):
-            _compile(srcs, out_path, compile_flags, link_flags)
+            # Cross-process lock: N spawned workers hitting a cold cache
+            # should compile once, not N times.
+            import fcntl
+            with open(out_path + '.lock', 'w') as lock_file:
+                fcntl.flock(lock_file, fcntl.LOCK_EX)
+                if not os.path.exists(out_path):
+                    _compile(srcs, out_path, compile_flags, link_flags)
         lib = ctypes.CDLL(out_path)
         _LOADED[name] = lib
         return lib

@@ -1,0 +1,195 @@
+"""ctypes bindings for the shared-memory ring transport (src/shm_ring.cc).
+
+One SPSC ring per direction per worker. Blocking calls release the GIL
+(ctypes CDLL), so a consumer waiting on a ring doesn't stall worker threads.
+"""
+
+import ctypes
+import logging
+
+from petastorm_tpu.native.build import NativeBuildError, build_and_load
+
+logger = logging.getLogger(__name__)
+
+RING_OK = 0
+RING_ERR_SYS = -1
+RING_ERR_ARGS = -2
+RING_ERR_TIMEOUT = -3
+RING_ERR_CLOSED = -4
+RING_ERR_TOO_BIG = -5
+RING_ERR_AGAIN = -6
+RING_ERR_CAPACITY = -7
+
+_lib = None
+_load_failed = False
+
+
+def _load():
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    try:
+        lib = build_and_load('pst_shm_ring', ['shm_ring.cc'], link_flags=['-lrt'])
+    except NativeBuildError as exc:
+        logger.warning('shm ring transport unavailable: %s', exc)
+        _load_failed = True
+        return None
+    lib.pst_ring_create.restype = ctypes.c_void_p
+    lib.pst_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.pst_ring_open.restype = ctypes.c_void_p
+    lib.pst_ring_open.argtypes = [ctypes.c_char_p]
+    lib.pst_ring_close.restype = None
+    lib.pst_ring_close.argtypes = [ctypes.c_void_p]
+    lib.pst_ring_unlink.restype = ctypes.c_int
+    lib.pst_ring_unlink.argtypes = [ctypes.c_char_p]
+    lib.pst_ring_write.restype = ctypes.c_int
+    lib.pst_ring_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64, ctypes.c_int]
+    lib.pst_ring_write_tagged.restype = ctypes.c_int
+    lib.pst_ring_write_tagged.argtypes = [ctypes.c_void_p, ctypes.c_uint8,
+                                          ctypes.c_char_p, ctypes.c_uint64,
+                                          ctypes.c_int]
+    lib.pst_ring_mark_closed.restype = None
+    lib.pst_ring_mark_closed.argtypes = [ctypes.c_void_p]
+    lib.pst_ring_peek.restype = ctypes.c_int
+    lib.pst_ring_peek.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+    lib.pst_ring_pop.restype = ctypes.c_int
+    lib.pst_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+    lib.pst_ring_wait.restype = ctypes.c_int
+    lib.pst_ring_wait.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+                                  ctypes.c_int]
+    lib.pst_ring_readable_bytes.restype = ctypes.c_uint64
+    lib.pst_ring_readable_bytes.argtypes = [ctypes.c_void_p]
+    lib.pst_ring_capacity.restype = ctypes.c_uint64
+    lib.pst_ring_capacity.argtypes = [ctypes.c_void_p]
+    lib.pst_ring_set_flags.restype = None
+    lib.pst_ring_set_flags.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.pst_ring_get_flags.restype = ctypes.c_uint32
+    lib.pst_ring_get_flags.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def available():
+    return _load() is not None
+
+
+class RingClosed(Exception):
+    """Producer closed (drained) or FINISHED flag aborted a blocked write."""
+
+
+class RingTimeout(Exception):
+    pass
+
+
+class ShmRing(object):
+    """One endpoint of a shared-memory SPSC ring."""
+
+    def __init__(self, handle, name, owner):
+        self._h = handle
+        self.name = name
+        self._owner = owner
+        self._closed = False
+
+    @classmethod
+    def create(cls, name, capacity):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError('shm ring native library unavailable')
+        h = lib.pst_ring_create(name.encode(), capacity)
+        if not h:
+            raise OSError('failed to create shm ring {!r}'.format(name))
+        return cls(h, name, owner=True)
+
+    @classmethod
+    def open(cls, name):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError('shm ring native library unavailable')
+        h = lib.pst_ring_open(name.encode())
+        if not h:
+            raise OSError('failed to open shm ring {!r}'.format(name))
+        return cls(h, name, owner=False)
+
+    def write(self, data, timeout_ms=-1):
+        if not isinstance(data, bytes):
+            data = bytes(data)
+        rc = _load().pst_ring_write(self._h, data, len(data), timeout_ms)
+        self._check_write_rc(rc, len(data))
+
+    def write_tagged(self, tag, payload, timeout_ms=-1):
+        """Write ``tag`` (one byte) + ``payload`` as a single message without
+        concatenating on the Python side."""
+        if not isinstance(payload, bytes):
+            payload = bytes(payload)
+        rc = _load().pst_ring_write_tagged(self._h, tag[0], payload,
+                                           len(payload), timeout_ms)
+        self._check_write_rc(rc, len(payload) + 1)
+
+    @staticmethod
+    def _check_write_rc(rc, nbytes):
+        if rc == RING_OK:
+            return
+        if rc == RING_ERR_CLOSED:
+            raise RingClosed()
+        if rc == RING_ERR_TIMEOUT:
+            raise RingTimeout()
+        if rc == RING_ERR_TOO_BIG:
+            raise ValueError(
+                'message of {} bytes exceeds ring capacity/2; raise '
+                'result_ring_bytes (ShmProcessPool) or shrink row-groups'.format(nbytes))
+        raise OSError('ring write failed (rc={})'.format(rc))
+
+    def read(self, timeout_ms=0):
+        """Next message as bytes; None when empty (timeout_ms=0 = non-blocking).
+
+        Raises RingClosed once the producer marked closed and the ring drained.
+        """
+        lib = _load()
+        length = ctypes.c_uint64()
+        rc = lib.pst_ring_wait(self._h, ctypes.byref(length), timeout_ms)
+        if rc == RING_ERR_AGAIN or rc == RING_ERR_TIMEOUT:
+            return None
+        if rc == RING_ERR_CLOSED:
+            raise RingClosed()
+        if rc != RING_OK:
+            raise OSError('ring peek failed (rc={})'.format(rc))
+        buf = bytearray(length.value)
+        view = (ctypes.c_char * length.value).from_buffer(buf)
+        rc = lib.pst_ring_pop(self._h, view, length.value)
+        del view
+        if rc != RING_OK:
+            raise OSError('ring pop failed (rc={})'.format(rc))
+        # memoryview: lets callers slice off framing bytes without copying
+        return memoryview(buf)
+
+    def mark_closed(self):
+        _load().pst_ring_mark_closed(self._h)
+
+    def set_flags(self, flags):
+        _load().pst_ring_set_flags(self._h, flags)
+
+    def get_flags(self):
+        return _load().pst_ring_get_flags(self._h)
+
+    @property
+    def readable_bytes(self):
+        return _load().pst_ring_readable_bytes(self._h)
+
+    @property
+    def capacity(self):
+        return _load().pst_ring_capacity(self._h)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        _load().pst_ring_close(self._h)
+        if self._owner:
+            _load().pst_ring_unlink(self.name.encode())
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

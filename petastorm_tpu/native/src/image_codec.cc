@@ -158,6 +158,26 @@ static int png_info_from_header(const uint8_t* data, size_t len, int* w,
   int color_type = data[25];
   int channels = png_channels_for_color_type(color_type);
   if (channels < 0) return PST_ERR_DECODE;
+  // Walk chunk headers up to IDAT looking for tRNS: decode expands it to a
+  // full alpha channel (png_set_tRNS_to_alpha), so the probe must account
+  // for the extra channel when sizing output buffers.
+  bool has_trns = false;
+  size_t off = 8;
+  while (off + 8 <= len) {
+    uint32_t chunk_len = (static_cast<uint32_t>(data[off]) << 24) |
+                         (data[off + 1] << 16) | (data[off + 2] << 8) |
+                         data[off + 3];
+    const uint8_t* type = data + off + 4;
+    if (memcmp(type, "IDAT", 4) == 0 || memcmp(type, "IEND", 4) == 0) break;
+    if (memcmp(type, "tRNS", 4) == 0) {
+      has_trns = true;
+      break;
+    }
+    off += 12ULL + chunk_len;  // len + type + data + crc
+  }
+  if (has_trns) {
+    channels = color_type == PNG_COLOR_TYPE_GRAY ? 2 : 4;
+  }
   *ch = channels;
   // sub-8-bit gray/palette is expanded to 8-bit on decode
   *bit_depth = depth == 16 ? 16 : 8;

@@ -1,0 +1,349 @@
+// POSIX shared-memory SPSC ring buffer: the process-pool transport.
+//
+// Replaces the reference's ZeroMQ tcp://127.0.0.1 sockets
+// (reference workers_pool/process_pool.py:52-74) with a zero-syscall
+// steady-state path: one producer process, one consumer process, variable
+// size length-prefixed messages in an mmap'd ring, C++11 atomics for the
+// head/tail handshake, adaptive spin-then-sleep waiting.
+//
+// Layout of the shm segment:
+//   [ PstRingHeader (one 4 KiB page) | data bytes (capacity) ]
+// head/tail are monotonically increasing byte offsets (mod capacity for
+// indexing). Messages are 8-byte-aligned: u32 length + payload. A length of
+// 0xFFFFFFFF is a wrap marker: skip to the start of the ring.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <new>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x70737452494e4731ULL;  // "pstRING1"
+constexpr uint32_t kWrapMarker = 0xFFFFFFFFu;
+constexpr size_t kHeaderSize = 4096;
+// Modest spin before napping: high spin counts starve peers on low-core
+// hosts (the transport is memcpy-bound, not latency-bound).
+constexpr int kSpinIters = 64;
+
+struct PstRingHeader {
+  uint64_t magic;
+  uint64_t capacity;
+  alignas(64) std::atomic<uint64_t> head;  // producer cursor
+  alignas(64) std::atomic<uint64_t> tail;  // consumer cursor
+  alignas(64) std::atomic<uint32_t> flags;     // control word, peer-settable
+  std::atomic<uint32_t> producer_closed;
+};
+
+struct PstRing {
+  PstRingHeader* hdr;
+  uint8_t* data;
+  size_t map_size;
+  bool owner;
+  char name[256];
+};
+
+inline uint64_t align8(uint64_t v) { return (v + 7) & ~7ULL; }
+
+void nap() {
+  struct timespec ts {0, 200000};  // 0.2 ms
+  nanosleep(&ts, nullptr);
+}
+
+// Remaining milliseconds budget helper; timeout_ms < 0 means forever.
+struct Deadline {
+  explicit Deadline(int timeout_ms) : forever(timeout_ms < 0) {
+    if (!forever) {
+      clock_gettime(CLOCK_MONOTONIC, &end);
+      end.tv_sec += timeout_ms / 1000;
+      end.tv_nsec += (timeout_ms % 1000) * 1000000L;
+      if (end.tv_nsec >= 1000000000L) {
+        end.tv_sec += 1;
+        end.tv_nsec -= 1000000000L;
+      }
+    }
+  }
+  bool expired() const {
+    if (forever) return false;
+    struct timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    if (now.tv_sec != end.tv_sec) return now.tv_sec > end.tv_sec;
+    return now.tv_nsec >= end.tv_nsec;
+  }
+  bool forever;
+  struct timespec end;
+};
+
+}  // namespace
+
+extern "C" {
+
+enum PstRingError {
+  PST_RING_OK = 0,
+  PST_RING_ERR_SYS = -1,       // errno-level failure
+  PST_RING_ERR_ARGS = -2,
+  PST_RING_ERR_TIMEOUT = -3,
+  PST_RING_ERR_CLOSED = -4,    // producer closed and ring drained
+  PST_RING_ERR_TOO_BIG = -5,   // message larger than capacity/2
+  PST_RING_ERR_AGAIN = -6,     // nothing available right now
+  PST_RING_ERR_CAPACITY = -7,  // caller buffer too small
+};
+
+PstRing* pst_ring_create(const char* name, uint64_t capacity) {
+  if (!name || capacity < 4096) return nullptr;
+  capacity = align8(capacity);
+  shm_unlink(name);  // stale segment from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t total = kHeaderSize + capacity;
+  if (ftruncate(fd, total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* hdr = new (mem) PstRingHeader();
+  hdr->capacity = capacity;
+  hdr->head.store(0, std::memory_order_relaxed);
+  hdr->tail.store(0, std::memory_order_relaxed);
+  hdr->flags.store(0, std::memory_order_relaxed);
+  hdr->producer_closed.store(0, std::memory_order_relaxed);
+  hdr->magic = kMagic;  // set last: openers validate
+  PstRing* ring = new PstRing();
+  ring->hdr = hdr;
+  ring->data = static_cast<uint8_t*>(mem) + kHeaderSize;
+  ring->map_size = total;
+  ring->owner = true;
+  strncpy(ring->name, name, sizeof(ring->name) - 1);
+  ring->name[sizeof(ring->name) - 1] = 0;
+  return ring;
+}
+
+PstRing* pst_ring_open(const char* name) {
+  if (!name) return nullptr;
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || static_cast<size_t>(st.st_size) <= kHeaderSize) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem =
+      mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* hdr = static_cast<PstRingHeader*>(mem);
+  if (hdr->magic != kMagic ||
+      kHeaderSize + hdr->capacity != static_cast<uint64_t>(st.st_size)) {
+    munmap(mem, st.st_size);
+    return nullptr;
+  }
+  PstRing* ring = new PstRing();
+  ring->hdr = hdr;
+  ring->data = static_cast<uint8_t*>(mem) + kHeaderSize;
+  ring->map_size = st.st_size;
+  ring->owner = false;
+  strncpy(ring->name, name, sizeof(ring->name) - 1);
+  ring->name[sizeof(ring->name) - 1] = 0;
+  return ring;
+}
+
+void pst_ring_close(PstRing* ring) {
+  if (!ring) return;
+  munmap(ring->hdr, ring->map_size);
+  delete ring;
+}
+
+int pst_ring_unlink(const char* name) {
+  return shm_unlink(name) == 0 ? PST_RING_OK : PST_RING_ERR_SYS;
+}
+
+// --------------------------------------------------------------- producer
+
+int pst_ring_write(PstRing* ring, const uint8_t* data, uint64_t len,
+                   int timeout_ms) {
+  if (!ring || (!data && len)) return PST_RING_ERR_ARGS;
+  PstRingHeader* h = ring->hdr;
+  const uint64_t cap = h->capacity;
+  const uint64_t need = align8(4 + len);
+  if (need > cap / 2) return PST_RING_ERR_TOO_BIG;
+
+  Deadline deadline(timeout_ms);
+  int spins = 0;
+  for (;;) {
+    uint64_t head = h->head.load(std::memory_order_relaxed);
+    uint64_t tail = h->tail.load(std::memory_order_acquire);
+    uint64_t idx = head % cap;
+    uint64_t contiguous = cap - idx;
+    // Reserve a wrap marker's worth when the message doesn't fit at the end.
+    uint64_t effective_need = contiguous >= need ? need : contiguous + need;
+    if (cap - (head - tail) >= effective_need) {
+      if (contiguous < need) {
+        if (contiguous >= 4) {
+          memcpy(ring->data + idx, &kWrapMarker, 4);
+        }
+        head += contiguous;
+        idx = 0;
+      }
+      uint32_t len32 = static_cast<uint32_t>(len);
+      memcpy(ring->data + idx, &len32, 4);
+      if (len) memcpy(ring->data + idx + 4, data, len);
+      h->head.store(head + need, std::memory_order_release);
+      return PST_RING_OK;
+    }
+    // Control flag set (FINISHED broadcast): abort instead of blocking on a
+    // full ring nobody will drain.
+    if (h->flags.load(std::memory_order_relaxed) != 0) {
+      return PST_RING_ERR_CLOSED;
+    }
+    if (++spins < kSpinIters) continue;
+    if (deadline.expired()) return PST_RING_ERR_TIMEOUT;
+    nap();
+  }
+}
+
+// Write with a 1-byte tag prefix without the caller having to concatenate
+// (saves a full payload copy on the Python side).
+int pst_ring_write_tagged(PstRing* ring, uint8_t tag, const uint8_t* data,
+                          uint64_t len, int timeout_ms) {
+  if (!ring || (!data && len)) return PST_RING_ERR_ARGS;
+  PstRingHeader* h = ring->hdr;
+  const uint64_t cap = h->capacity;
+  const uint64_t total = 1 + len;
+  const uint64_t need = align8(4 + total);
+  if (need > cap / 2) return PST_RING_ERR_TOO_BIG;
+
+  Deadline deadline(timeout_ms);
+  int spins = 0;
+  for (;;) {
+    uint64_t head = h->head.load(std::memory_order_relaxed);
+    uint64_t tail = h->tail.load(std::memory_order_acquire);
+    uint64_t idx = head % cap;
+    uint64_t contiguous = cap - idx;
+    uint64_t effective_need = contiguous >= need ? need : contiguous + need;
+    if (cap - (head - tail) >= effective_need) {
+      if (contiguous < need) {
+        if (contiguous >= 4) {
+          memcpy(ring->data + idx, &kWrapMarker, 4);
+        }
+        head += contiguous;
+        idx = 0;
+      }
+      uint32_t len32 = static_cast<uint32_t>(total);
+      memcpy(ring->data + idx, &len32, 4);
+      ring->data[idx + 4] = tag;
+      if (len) memcpy(ring->data + idx + 5, data, len);
+      h->head.store(head + need, std::memory_order_release);
+      return PST_RING_OK;
+    }
+    if (h->flags.load(std::memory_order_relaxed) != 0) {
+      return PST_RING_ERR_CLOSED;
+    }
+    if (++spins < kSpinIters) continue;
+    if (deadline.expired()) return PST_RING_ERR_TIMEOUT;
+    nap();
+  }
+}
+
+void pst_ring_mark_closed(PstRing* ring) {
+  if (ring) ring->hdr->producer_closed.store(1, std::memory_order_release);
+}
+
+// --------------------------------------------------------------- consumer
+
+// Length of the next message, or AGAIN/CLOSED. Advances past wrap markers.
+int pst_ring_peek(PstRing* ring, uint64_t* len_out) {
+  if (!ring || !len_out) return PST_RING_ERR_ARGS;
+  PstRingHeader* h = ring->hdr;
+  const uint64_t cap = h->capacity;
+  for (;;) {
+    uint64_t tail = h->tail.load(std::memory_order_relaxed);
+    uint64_t head = h->head.load(std::memory_order_acquire);
+    if (head == tail) {
+      if (h->producer_closed.load(std::memory_order_acquire)) {
+        // Re-check: producer may have written between head load and flag.
+        if (h->head.load(std::memory_order_acquire) == tail)
+          return PST_RING_ERR_CLOSED;
+        continue;
+      }
+      return PST_RING_ERR_AGAIN;
+    }
+    uint64_t idx = tail % cap;
+    uint64_t contiguous = cap - idx;
+    uint32_t len32;
+    if (contiguous < 4) {
+      // Too small even for a wrap marker: implicit wrap.
+      h->tail.store(tail + contiguous, std::memory_order_release);
+      continue;
+    }
+    memcpy(&len32, ring->data + idx, 4);
+    if (len32 == kWrapMarker) {
+      h->tail.store(tail + contiguous, std::memory_order_release);
+      continue;
+    }
+    *len_out = len32;
+    return PST_RING_OK;
+  }
+}
+
+// Copy the next message into `out` and advance. Call after peek.
+int pst_ring_pop(PstRing* ring, uint8_t* out, uint64_t out_capacity) {
+  if (!ring) return PST_RING_ERR_ARGS;
+  uint64_t len;
+  int rc = pst_ring_peek(ring, &len);
+  if (rc != PST_RING_OK) return rc;
+  if (len > out_capacity) return PST_RING_ERR_CAPACITY;
+  PstRingHeader* h = ring->hdr;
+  const uint64_t cap = h->capacity;
+  uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  uint64_t idx = tail % cap;
+  if (len) memcpy(out, ring->data + idx + 4, len);
+  h->tail.store(tail + align8(4 + len), std::memory_order_release);
+  return PST_RING_OK;
+}
+
+// Blocking peek with timeout; adaptive spin then 0.2 ms naps.
+int pst_ring_wait(PstRing* ring, uint64_t* len_out, int timeout_ms) {
+  Deadline deadline(timeout_ms);
+  int spins = 0;
+  for (;;) {
+    int rc = pst_ring_peek(ring, len_out);
+    if (rc != PST_RING_ERR_AGAIN) return rc;
+    if (++spins < kSpinIters) continue;
+    if (deadline.expired()) return PST_RING_ERR_TIMEOUT;
+    nap();
+  }
+}
+
+uint64_t pst_ring_capacity(PstRing* ring) {
+  return ring ? ring->hdr->capacity : 0;
+}
+
+uint64_t pst_ring_readable_bytes(PstRing* ring) {
+  if (!ring) return 0;
+  return ring->hdr->head.load(std::memory_order_acquire) -
+         ring->hdr->tail.load(std::memory_order_acquire);
+}
+
+// Control word: either side may set/read (e.g. FINISHED broadcast).
+void pst_ring_set_flags(PstRing* ring, uint32_t flags) {
+  if (ring) ring->hdr->flags.store(flags, std::memory_order_release);
+}
+
+uint32_t pst_ring_get_flags(PstRing* ring) {
+  return ring ? ring->hdr->flags.load(std::memory_order_acquire) : 0;
+}
+
+}  // extern "C"

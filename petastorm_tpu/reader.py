@@ -34,19 +34,35 @@ logger = logging.getLogger(__name__)
 _VENTILATE_EXTRA_ROWGROUPS = 2
 
 
-def _make_pool(reader_pool_type, workers_count, results_queue_size, arrow_payloads=False):
+def _make_pool(reader_pool_type, workers_count, results_queue_size, arrow_payloads=False,
+               shm_result_ring_bytes=None):
     if reader_pool_type == 'thread':
         return ThreadPool(workers_count, results_queue_size)
     if reader_pool_type == 'dummy':
         return DummyPool()
-    if reader_pool_type == 'process':
-        from petastorm_tpu.workers.process_pool import ProcessPool
+    if reader_pool_type in ('process', 'process-shm', 'process-zmq'):
         from petastorm_tpu.workers.serializers import (ArrowTableSerializer,
                                                        PickleSerializer)
         serializer = ArrowTableSerializer() if arrow_payloads else PickleSerializer()
+        # 'process' prefers the native shared-memory transport, falling back
+        # to zmq; the explicit suffixes pin one.
+        use_shm = False
+        if reader_pool_type in ('process', 'process-shm'):
+            from petastorm_tpu.workers.shm_process_pool import shm_transport_available
+            use_shm = shm_transport_available()
+            if not use_shm and reader_pool_type == 'process-shm':
+                raise RuntimeError('process-shm pool requested but the native shm '
+                                   'transport failed to build')
+        if use_shm:
+            from petastorm_tpu.workers.shm_process_pool import ShmProcessPool
+            extra = ({'result_ring_bytes': shm_result_ring_bytes}
+                     if shm_result_ring_bytes else {})
+            return ShmProcessPool(workers_count, results_queue_size,
+                                  serializer=serializer, **extra)
+        from petastorm_tpu.workers.process_pool import ProcessPool
         return ProcessPool(workers_count, results_queue_size, serializer=serializer)
-    raise ValueError('Unknown reader_pool_type {!r}; expected thread|process|dummy'.format(
-        reader_pool_type))
+    raise ValueError('Unknown reader_pool_type {!r}; expected '
+                     'thread|process|process-shm|process-zmq|dummy'.format(reader_pool_type))
 
 
 def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate,
@@ -76,7 +92,8 @@ def make_reader(dataset_url,
                 cache_row_size_estimate=None, cache_extra_settings=None,
                 hdfs_driver=None,
                 transform_spec=None,
-                storage_options=None):
+                storage_options=None,
+                shm_result_ring_bytes=None):
     """Reader for datasets materialized with petastorm_tpu codecs.
 
     Parity: reference ``petastorm/reader.py:50-174``. Rejects plain Parquet
@@ -100,7 +117,8 @@ def make_reader(dataset_url,
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, arrow_cache=False,
                         **(cache_extra_settings or {}))
-    pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
+    pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
+                      shm_result_ring_bytes=shm_result_ring_bytes)
     return Reader(store, stored_schema,
                   schema_fields=schema_fields, ngram=ngram,
                   worker_class=PyDictWorker,
@@ -126,7 +144,8 @@ def make_batch_reader(dataset_url,
                       cache_type='null', cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None, cache_extra_settings=None,
                       transform_spec=None,
-                      storage_options=None):
+                      storage_options=None,
+                      shm_result_ring_bytes=None):
     """Columnar batch reader for **any** Parquet store (no codecs needed).
 
     Parity: reference ``petastorm/reader.py:177-289``. Warns when pointed at a
@@ -149,7 +168,7 @@ def make_batch_reader(dataset_url,
                         cache_row_size_estimate, arrow_cache=True,
                         **(cache_extra_settings or {}))
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      arrow_payloads=True)
+                      arrow_payloads=True, shm_result_ring_bytes=shm_result_ring_bytes)
     return Reader(store, stored_schema,
                   schema_fields=schema_fields,
                   worker_class=ArrowWorker,

@@ -385,8 +385,9 @@ def decode_rows(rows, schema, num_threads=None):
         except Exception as e:
             raise DecodeFieldError('Unable to batch-decode image fields {}: {}'.format(
                 image_fields, e)) from e
+        conform = _codecs.CompressedImageCodec.conform_channels
         for (i, name), img in zip(blob_slots, images):
-            decoded[i][name] = img
+            decoded[i][name] = conform(img, schema.fields[name])
     return decoded
 
 

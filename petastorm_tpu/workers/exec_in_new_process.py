@@ -24,9 +24,18 @@ def exec_in_new_process(func, *args, **kwargs):
         # pickles of classes in e.g. test modules then import cleanly.
         dill.dump(list(sys.path), f)
         dill.dump((func, args, kwargs), f, recurse=False)
+    # The `-m` bootstrap must be able to import petastorm_tpu BEFORE the
+    # payload's sys.path record is applied, so propagate the parent's
+    # sys.path through PYTHONPATH (covers uninstalled/path-inserted uses).
+    env = dict(os.environ)
+    parent_paths = [p for p in sys.path if p]
+    existing = env.get('PYTHONPATH')
+    if existing:
+        parent_paths.append(existing)
+    env['PYTHONPATH'] = os.pathsep.join(parent_paths)
     process = subprocess.Popen(
         [sys.executable, '-m', 'petastorm_tpu.workers.exec_in_new_process', payload_path],
-        close_fds=True)
+        close_fds=True, env=env)
     return process
 
 

@@ -1,0 +1,304 @@
+"""Out-of-process worker pool over the native shared-memory ring transport.
+
+Same protocol and consumer semantics as :class:`ProcessPool` (the reference's
+ZeroMQ design, ``workers_pool/process_pool.py:52-74``) but the worker <->
+consumer channels are mmap'd SPSC rings (``native/src/shm_ring.cc``): no
+sockets, no syscalls on the steady-state path, single memcpy per message.
+
+Channel layout per worker i:
+  work ring  ``/pst_<pid>_<uid>_i_in``   parent -> worker, pickled (args, kwargs)
+  result ring ``/pst_<pid>_<uid>_i_out`` worker -> parent, 1-byte tag + payload
+    tag b'C': pickled control (started / item-processed / error)
+    tag b'D': serializer payload (row-group data), possibly final chunk
+    tag b'P': non-final chunk of a payload larger than half the ring
+              (chunks are contiguous per ring — SPSC ordering — so the
+              consumer reassembles per-ring; no message size limit)
+
+FINISHED broadcast = setting the control flag word on both rings; blocked ring
+writes abort with RingClosed so shutdown can't deadlock on a full ring
+(the reference needs an explicit drain loop for this, ``process_pool.py:287-304``).
+"""
+
+import logging
+import os
+import pickle
+import threading
+import time
+import uuid
+
+from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
+                                   VentilatedItemProcessedMessage)
+from petastorm_tpu.workers.exec_in_new_process import exec_in_new_process
+from petastorm_tpu.workers.process_pool import _start_orphan_watchdog, _WorkerError
+from petastorm_tpu.workers.serializers import PickleSerializer
+
+logger = logging.getLogger(__name__)
+
+_WORKER_STARTED = '__worker_started__'
+_FLAG_FINISHED = 1
+_TAG_CONTROL = b'C'
+_TAG_DATA = b'D'
+_TAG_PARTIAL = b'P'  # chunk of an oversized data payload; 'D' terminates it
+_DEFAULT_TIMEOUT_S = 60
+_STARTUP_TIMEOUT_S = 120
+_WORK_RING_BYTES = 1 << 20          # pickled work items are tiny
+_DEFAULT_RESULT_RING_BYTES = 64 << 20
+
+
+def shm_transport_available():
+    from petastorm_tpu.native import shm_ring
+    return shm_ring.available()
+
+
+class ShmProcessPool(object):
+    """Drop-in alternative to ProcessPool; rings instead of zmq sockets.
+
+    :param result_ring_bytes: per-worker results ring capacity. Decoded
+        row-groups must fit in half of this (ring message limit).
+    """
+
+    def __init__(self, workers_count, results_queue_size=50, serializer=None,
+                 result_ring_bytes=_DEFAULT_RESULT_RING_BYTES):
+        self._workers_count = workers_count
+        self._serializer = serializer or PickleSerializer()
+        self._result_ring_bytes = result_ring_bytes
+        del results_queue_size  # bounded by ring bytes, not message count
+
+        self._work_rings = []
+        self._result_rings = []
+        self._processes = []
+        self._ventilator = None
+        self._ventilated_unprocessed = 0
+        self._count_lock = threading.Lock()
+        self._stopped = False
+        self._next_worker = 0
+        self._poll_cursor = 0
+        self._partials = {}  # ring index -> accumulated 'P' chunks
+
+    @property
+    def workers_count(self):
+        return self._workers_count
+
+    def start(self, worker_class, worker_args=None, ventilator=None):
+        from petastorm_tpu.native.shm_ring import ShmRing
+
+        if self._processes:
+            raise RuntimeError('ShmProcessPool already started')
+        base = '/pst_{}_{}'.format(os.getpid(), uuid.uuid4().hex[:8])
+        for worker_id in range(self._workers_count):
+            self._work_rings.append(
+                ShmRing.create('{}_{}_in'.format(base, worker_id), _WORK_RING_BYTES))
+            self._result_rings.append(
+                ShmRing.create('{}_{}_out'.format(base, worker_id),
+                               self._result_ring_bytes))
+        for worker_id in range(self._workers_count):
+            process = exec_in_new_process(
+                _shm_worker_bootstrap, worker_class, worker_id, worker_args,
+                base, type(self._serializer), os.getpid())
+            self._processes.append(process)
+
+        started = 0
+        deadline = time.monotonic() + _STARTUP_TIMEOUT_S
+        while started < self._workers_count:
+            if time.monotonic() > deadline:
+                self.stop()
+                raise RuntimeError(
+                    'Timed out waiting for {} shm workers to start ({} started)'.format(
+                        self._workers_count, started))
+            message = self._poll_once(timeout_ms=1000)
+            if message is None:
+                self._check_workers_alive()
+                continue
+            tag, payload = message
+            if tag == _TAG_CONTROL:
+                control = pickle.loads(payload)
+                if control == _WORKER_STARTED:
+                    started += 1
+                elif isinstance(control, _WorkerError):
+                    self.stop()
+                    self.join()
+                    raise control.exception
+
+        self._ventilator = ventilator
+        if ventilator is not None:
+            ventilator._ventilate_fn = self.ventilate
+            ventilator.start()
+
+    def _check_workers_alive(self):
+        dead = [p.pid for p in self._processes if p.poll() is not None]
+        if dead:
+            self.stop()
+            raise RuntimeError('shm worker process(es) {} died during startup'.format(dead))
+
+    def ventilate(self, *args, **kwargs):
+        with self._count_lock:
+            self._ventilated_unprocessed += 1
+        # Round-robin dispatch (zmq PUSH does the same across peers).
+        ring = self._work_rings[self._next_worker % self._workers_count]
+        self._next_worker += 1
+        ring.write(pickle.dumps((args, kwargs)), timeout_ms=-1)
+
+    def _poll_once(self, timeout_ms):
+        """One sweep over all result rings; returns (tag, payload) or None.
+
+        Reassembles chunked payloads: 'P' chunks accumulate per ring until
+        the terminating 'D' arrives (chunks never interleave within one
+        ring — it's SPSC).
+        """
+        from petastorm_tpu.native.shm_ring import RingClosed
+
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while True:
+            for _ in range(self._workers_count):
+                ring_index = self._poll_cursor % self._workers_count
+                ring = self._result_rings[ring_index]
+                self._poll_cursor += 1
+                try:
+                    message = ring.read(timeout_ms=0)
+                except RingClosed:
+                    continue
+                if message is None:
+                    continue
+                tag, payload = message[:1], message[1:]
+                if tag == _TAG_PARTIAL:
+                    self._partials.setdefault(ring_index, []).append(payload)
+                    continue
+                pending = self._partials.pop(ring_index, None)
+                if pending is not None and tag == _TAG_DATA:
+                    pending.append(payload)
+                    payload = memoryview(b''.join(pending))
+                return tag, payload
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.001)
+
+    def get_results(self, timeout=_DEFAULT_TIMEOUT_S):
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        while True:
+            message = self._poll_once(timeout_ms=50)
+            if message is not None:
+                tag, payload = message
+                if tag == _TAG_DATA:
+                    return self._serializer.deserialize(payload)
+                control = pickle.loads(payload)
+                if control == _WORKER_STARTED:
+                    continue
+                if isinstance(control, VentilatedItemProcessedMessage):
+                    with self._count_lock:
+                        self._ventilated_unprocessed -= 1
+                    if self._ventilator is not None:
+                        self._ventilator.processed_item()
+                    continue
+                if isinstance(control, _WorkerError):
+                    self.stop()
+                    self.join()
+                    logger.error('Worker traceback:\n%s', control.traceback_str)
+                    raise control.exception
+                raise RuntimeError('Unexpected control message: {!r}'.format(control))
+            if self._all_done():
+                raise EmptyResultError()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutWaitingForResultError()
+
+    def _all_done(self):
+        # `completed` must be observed FIRST (see thread_pool._all_done).
+        ventilator_done = self._ventilator is None or self._ventilator.completed()
+        if not ventilator_done:
+            return False
+        with self._count_lock:
+            return self._ventilated_unprocessed == 0
+
+    def stop(self):
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        self._stopped = True
+        # FINISHED: flags on both rings; aborts any blocked worker write.
+        for ring in self._work_rings + self._result_rings:
+            ring.set_flags(_FLAG_FINISHED)
+
+    def join(self):
+        if not self._stopped:
+            self.stop()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(p.poll() is not None for p in self._processes):
+                break
+            time.sleep(0.05)
+        for process in self._processes:
+            if process.poll() is None:  # pragma: no cover - hung worker
+                process.kill()
+        for ring in self._work_rings + self._result_rings:
+            ring.close()
+        self._processes = []
+        self._work_rings = []
+        self._result_rings = []
+        self._partials = {}
+
+    @property
+    def diagnostics(self):
+        with self._count_lock:
+            return {'ventilated_unprocessed': self._ventilated_unprocessed,
+                    'workers_count': self._workers_count,
+                    'transport': 'shm_ring'}
+
+    @property
+    def results_qsize(self):
+        return sum(1 for ring in self._result_rings if ring.readable_bytes)
+
+
+def _shm_worker_bootstrap(worker_class, worker_id, worker_args, base,
+                          serializer_type, parent_pid):
+    """Entry point of a spawned shm worker process."""
+    import traceback
+
+    from petastorm_tpu.native.shm_ring import RingClosed, ShmRing
+
+    serializer = serializer_type()
+    work_ring = ShmRing.open('{}_{}_in'.format(base, worker_id))
+    result_ring = ShmRing.open('{}_{}_out'.format(base, worker_id))
+
+    _start_orphan_watchdog(parent_pid)
+
+    def send_control(obj):
+        result_ring.write_tagged(_TAG_CONTROL, pickle.dumps(obj), timeout_ms=-1)
+
+    # Payloads bigger than the ring allows are streamed in chunks; keep a
+    # safety margin under capacity/2 for framing.
+    chunk_limit = max(4096, result_ring.capacity // 2 - 4096)
+
+    def publish(data):
+        payload = serializer.serialize(data)
+        view = memoryview(payload)
+        while len(view) > chunk_limit:
+            result_ring.write_tagged(_TAG_PARTIAL, view[:chunk_limit], timeout_ms=-1)
+            view = view[chunk_limit:]
+        result_ring.write_tagged(_TAG_DATA, view, timeout_ms=-1)
+
+    worker = worker_class(worker_id, publish, worker_args)
+    try:
+        worker.initialize()
+    except Exception as e:  # noqa: BLE001
+        send_control(_WorkerError(e, traceback.format_exc()))
+        return
+
+    send_control(_WORKER_STARTED)
+    try:
+        while not (work_ring.get_flags() & _FLAG_FINISHED):
+            try:
+                item = work_ring.read(timeout_ms=100)
+            except RingClosed:
+                break
+            if item is None:
+                continue
+            args, kwargs = pickle.loads(item)
+            try:
+                worker.process(*args, **kwargs)
+                send_control(VentilatedItemProcessedMessage())
+            except Exception as e:  # noqa: BLE001
+                send_control(_WorkerError(e, traceback.format_exc()))
+    except RingClosed:
+        pass
+    finally:
+        worker.shutdown()
+        work_ring.close()
+        result_ring.close()

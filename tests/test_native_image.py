@@ -71,6 +71,34 @@ def test_corrupt_stream_raises():
         nimg.decode_image(good[:20])
 
 
+def test_png_trns_transparency_decodes():
+    # PIL writes palette/RGB PNGs with a tRNS chunk; decode expands to alpha,
+    # and the header probe must size the buffer for the extra channel.
+    PIL = pytest.importorskip('PIL.Image')
+    import io
+    rgb = np.zeros((10, 12, 3), np.uint8)
+    rgb[:, :, 0] = 200
+    img = PIL.fromarray(rgb).convert('P')
+    buf = io.BytesIO()
+    img.save(buf, format='PNG', transparency=0)
+    out = nimg.decode_image(buf.getvalue())
+    assert out.shape[:2] == (10, 12)
+    assert out.shape[2] == 4  # alpha expanded from tRNS
+
+
+def test_codec_conforms_channels_to_field_shape():
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.unischema import UnischemaField
+    codec = CompressedImageCodec('png')
+    field3 = UnischemaField('im', np.uint8, (None, None, 3), codec, False)
+    gray = np.full((6, 7), 9, np.uint8)
+    out = codec.decode(field3, nimg.encode_png(gray))
+    assert out.shape == (6, 7, 3)
+    rgba = np.zeros((6, 7, 4), np.uint8)
+    out = codec.decode(field3, nimg.encode_png(rgba))
+    assert out.shape == (6, 7, 3)
+
+
 def test_matches_cv2():
     cv2 = pytest.importorskip('cv2')
     rng = np.random.default_rng(7)

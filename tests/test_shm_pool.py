@@ -1,0 +1,177 @@
+"""Shared-memory ring transport + ShmProcessPool end-to-end tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.native import shm_ring
+from petastorm_tpu.workers import EmptyResultError, WorkerBase
+from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+
+pytestmark = [pytest.mark.processpool,
+              pytest.mark.skipif(not shm_ring.available(),
+                                 reason='native toolchain unavailable')]
+
+
+# --- ring unit tests -----------------------------------------------------
+
+def _ring_pair(name, capacity=1 << 16):
+    producer_side = shm_ring.ShmRing.create(name, capacity)
+    consumer_side = shm_ring.ShmRing.open(name)
+    return producer_side, consumer_side
+
+
+def test_ring_fifo_order():
+    a, b = _ring_pair('/pst_t_fifo_{}'.format(os.getpid()))
+    for i in range(100):
+        b.write(bytes([i]) * (i + 1))
+    for i in range(100):
+        assert a.read() == bytes([i]) * (i + 1)
+    assert a.read() is None
+    a.close(); b.close()
+
+
+def test_ring_wraparound_many_messages():
+    a, b = _ring_pair('/pst_t_wrap_{}'.format(os.getpid()), capacity=8192)
+    rng = np.random.default_rng(0)
+    pending = []
+    for i in range(2000):
+        msg = bytes(rng.integers(0, 255, int(rng.integers(0, 1500))).astype(np.uint8))
+        b.write(msg, timeout_ms=1000)
+        pending.append(msg)
+        while len(pending) > 2:  # keep the ring partially full across wraps
+            assert a.read(timeout_ms=100) == pending.pop(0)
+    while pending:
+        assert a.read(timeout_ms=100) == pending.pop(0)
+    a.close(); b.close()
+
+
+def test_ring_too_big_message():
+    a, b = _ring_pair('/pst_t_big_{}'.format(os.getpid()), capacity=8192)
+    with pytest.raises(ValueError, match='exceeds ring capacity'):
+        b.write(b'x' * 8000)
+    a.close(); b.close()
+
+
+def test_ring_closed_after_drain():
+    a, b = _ring_pair('/pst_t_closed_{}'.format(os.getpid()))
+    b.write(b'last')
+    b.mark_closed()
+    assert a.read() == b'last'
+    with pytest.raises(shm_ring.RingClosed):
+        a.read(timeout_ms=100)
+    a.close(); b.close()
+
+
+def test_ring_flag_aborts_blocked_write():
+    a, b = _ring_pair('/pst_t_flag_{}'.format(os.getpid()), capacity=8192)
+    # fill the ring so the next write would block, then set FINISHED
+    while True:
+        try:
+            b.write(b'y' * 3000, timeout_ms=50)
+        except shm_ring.RingTimeout:
+            break
+    a.set_flags(1)
+    with pytest.raises(shm_ring.RingClosed):
+        b.write(b'y' * 3000, timeout_ms=5000)
+    a.close(); b.close()
+
+
+# --- pool tests ----------------------------------------------------------
+
+class BigBlobWorker(WorkerBase):
+    """Publishes payloads far larger than the (tiny) result ring."""
+
+    def process(self, value):
+        self.publish_func([bytes([value % 256]) * (3 << 20), value])
+
+class EchoWorker(WorkerBase):
+    def process(self, value):
+        self.publish_func([value * 2])
+
+
+class FailingWorker(WorkerBase):
+    def process(self, value):
+        raise ValueError('boom {}'.format(value))
+
+
+def _make_pool(workers=2, **kwargs):
+    from petastorm_tpu.workers.shm_process_pool import ShmProcessPool
+    return ShmProcessPool(workers, **kwargs)
+
+
+def test_shm_pool_basic():
+    pool = _make_pool(2)
+    ventilator = ConcurrentVentilator(None, [{'value': i} for i in range(20)],
+                                      iterations=1)
+    pool.start(EchoWorker, None, ventilator)
+    results = []
+    with pytest.raises(EmptyResultError):
+        while True:
+            results.extend(pool.get_results())
+    pool.stop()
+    pool.join()
+    assert sorted(results) == [i * 2 for i in range(20)]
+
+
+def test_shm_pool_multiple_epochs():
+    pool = _make_pool(2)
+    ventilator = ConcurrentVentilator(None, [{'value': i} for i in range(5)],
+                                      iterations=3)
+    pool.start(EchoWorker, None, ventilator)
+    results = []
+    with pytest.raises(EmptyResultError):
+        while True:
+            results.extend(pool.get_results())
+    pool.stop()
+    pool.join()
+    assert sorted(results) == sorted([i * 2 for i in range(5)] * 3)
+
+
+def test_shm_pool_chunked_oversized_payloads():
+    # 1 MiB ring, 3 MiB payloads: must stream in chunks, not error
+    pool = _make_pool(2, result_ring_bytes=1 << 20)
+    ventilator = ConcurrentVentilator(None, [{'value': i} for i in range(6)],
+                                      iterations=1)
+    pool.start(BigBlobWorker, None, ventilator)
+    got = []
+    with pytest.raises(EmptyResultError):
+        while True:
+            blob, value = pool.get_results()
+            assert blob == bytes([value % 256]) * (3 << 20)
+            got.append(value)
+    pool.stop()
+    pool.join()
+    assert sorted(got) == list(range(6))
+
+
+def test_shm_pool_exception_propagates():
+    pool = _make_pool(2)
+    ventilator = ConcurrentVentilator(None, [{'value': i} for i in range(4)],
+                                      iterations=1)
+    pool.start(FailingWorker, None, ventilator)
+    with pytest.raises(ValueError, match='boom'):
+        while True:
+            pool.get_results()
+
+
+def test_make_reader_shm_pool(synthetic_dataset):
+    from petastorm_tpu import make_reader
+    with make_reader(synthetic_dataset.url, reader_pool_type='process-shm',
+                     workers_count=2) as reader:
+        assert reader.diagnostics.get('transport') == 'shm_ring'
+        seen = {row.id: row for row in reader}
+    assert len(seen) == len(synthetic_dataset.data)
+    expected = synthetic_dataset.data[7]
+    np.testing.assert_array_equal(seen[expected['id']].image_png, expected['image_png'])
+
+
+def test_make_batch_reader_shm_pool(scalar_dataset):
+    from petastorm_tpu import make_batch_reader
+    total = 0
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='process-shm',
+                           workers_count=2) as reader:
+        for batch in reader:
+            total += len(batch.id)
+    assert total == scalar_dataset.table.num_rows
