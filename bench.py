@@ -66,8 +66,25 @@ def _measure_reader(url, workers):
     return _MEASURE_SAMPLES / elapsed
 
 
+def _jax_backend_responsive(timeout_s=180):
+    """Probe JAX backend init in a subprocess — a wedged TPU tunnel hangs
+    rather than erroring, and must not take the whole benchmark down."""
+    import subprocess
+    try:
+        proc = subprocess.run(
+            [sys.executable, '-c',
+             'import jax; jax.devices(); print("ok")'],
+            timeout=timeout_s, capture_output=True)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def _measure_jax_staging(url, workers):
     """Batches staged to the default JAX device (TPU when present)."""
+    if not _jax_backend_responsive():
+        print('jax backend unresponsive; skipping staging metric', file=sys.stderr)
+        return None
     try:
         import jax
 

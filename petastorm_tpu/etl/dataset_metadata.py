@@ -29,9 +29,18 @@ class PetastormMetadataGenerationError(PetastormTpuError):
 
 
 def get_schema(store):
-    """Load the Unischema stored in ``_common_metadata``; raise if absent."""
+    """Load the Unischema stored in ``_common_metadata``; raise if absent.
+
+    Falls back to metadata written by the reference petastorm library
+    (pickled ``dataset-toolkit.unischema.v1``) via the restricted legacy
+    decoder, so reference-materialized datasets read without conversion.
+    """
     blob = store.common_metadata_value(UNISCHEMA_KEY)
     if blob is None:
+        from petastorm_tpu.etl.legacy import LEGACY_UNISCHEMA_KEY, load_legacy_unischema
+        legacy_blob = store.common_metadata_value(LEGACY_UNISCHEMA_KEY)
+        if legacy_blob is not None:
+            return load_legacy_unischema(legacy_blob)
         if not store.fs.exists(store.path):
             raise IOError('Dataset path does not exist: {}'.format(store.url))
         raise PetastormMetadataError(

@@ -1,0 +1,466 @@
+"""Interop with datasets materialized by the reference petastorm library.
+
+The reference stores its schema as a **pickle** of ``petastorm.unischema.
+Unischema`` under the ``_common_metadata`` key ``dataset-toolkit.unischema.v1``
+(reference ``petastorm/etl/dataset_metadata.py:34-35,189-192``), a
+``{file -> num_row_groups}`` JSON under
+``dataset-toolkit.num_row_groups_per_file.v1`` (``:195-228``) and a pickled
+``{index_name -> SingleFieldIndexer}`` dict under
+``dataset-toolkit.rowgroups_index.v1`` (``petastorm/etl/rowgroup_indexing.py:33``).
+
+This module lets petastorm_tpu
+
+* **read** such stores: a *restricted* unpickler (``pickle.Unpickler`` with a
+  ``find_class`` whitelist — unlike the reference's bare ``pickle.loads``,
+  ``etl/legacy.py:47``, a malicious ``_common_metadata`` cannot execute code)
+  maps the reference's class names onto lightweight stubs and converts them to
+  petastorm_tpu ``Unischema``/codec/indexer objects;
+* **write** reference-readable metadata: ``export_legacy_metadata`` builds an
+  equivalent object graph under shim modules named ``petastorm.unischema`` /
+  ``petastorm.codecs`` / ``pyspark.sql.types`` so the resulting pickle
+  round-trips in a real petastorm+pyspark environment.
+
+Legacy package renames (``av.*.dataset_toolkit`` — reference
+``etl/legacy.py:31-32``) are honored by module-name normalization instead of
+byte-level stream rewriting.
+"""
+
+import decimal
+import io
+import json
+import logging
+import pickle
+import sys
+import threading
+import types
+from collections import OrderedDict, defaultdict
+
+import numpy as np
+
+from petastorm_tpu import codecs as tpu_codecs
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+logger = logging.getLogger(__name__)
+
+LEGACY_UNISCHEMA_KEY = b'dataset-toolkit.unischema.v1'
+LEGACY_NUM_ROW_GROUPS_KEY = b'dataset-toolkit.num_row_groups_per_file.v1'
+LEGACY_ROWGROUP_INDEX_KEY = b'dataset-toolkit.rowgroups_index.v1'
+
+#: Renamed ancestors of the reference package (reference ``etl/legacy.py:31``).
+_LEGACY_PACKAGE_PREFIXES = (
+    'av.experimental.deepdrive.dataset_toolkit.',
+    'av.ml.dataset_toolkit.',
+    'dataset_toolkit.',
+)
+
+
+class LegacyMetadataError(PetastormTpuError):
+    """Legacy petastorm metadata exists but cannot be decoded."""
+
+
+# ---------------------------------------------------------------------------
+# Read side: restricted unpickling into stubs, then conversion
+# ---------------------------------------------------------------------------
+
+class _Stub(object):
+    """Absorbs pickle NEWOBJ/BUILD into a plain ``__dict__``."""
+
+    def __setstate__(self, state):
+        if isinstance(state, dict):
+            self.__dict__.update(state)
+        elif state is not None:
+            self.__dict__['_state'] = state
+
+
+class _StubUnischema(_Stub):
+    pass
+
+
+class _StubCompressedImageCodec(_Stub):
+    pass
+
+
+class _StubNdarrayCodec(_Stub):
+    pass
+
+
+class _StubCompressedNdarrayCodec(_Stub):
+    pass
+
+
+class _StubScalarCodec(_Stub):
+    pass
+
+
+class _StubSingleFieldIndexer(_Stub):
+    pass
+
+
+class _StubFieldNotNullIndexer(_Stub):
+    pass
+
+
+class _StubSparkType(_Stub):
+    """Stand-in for any ``pyspark.sql.types.*`` instance; records the name."""
+
+    spark_name = None
+
+
+def _make_spark_stub(name):
+    return type('_Stub' + name, (_StubSparkType,), {'spark_name': name})
+
+
+_SPARK_TYPE_NAMES = (
+    'ByteType', 'ShortType', 'IntegerType', 'LongType', 'FloatType',
+    'DoubleType', 'BooleanType', 'StringType', 'BinaryType', 'DecimalType',
+    'TimestampType', 'DateType', 'NullType',
+)
+_SPARK_STUBS = {name: _make_spark_stub(name) for name in _SPARK_TYPE_NAMES}
+
+_SPARK_NAME_TO_NUMPY = {
+    'ByteType': np.int8,
+    'ShortType': np.int16,
+    'IntegerType': np.int32,
+    'LongType': np.int64,
+    'FloatType': np.float32,
+    'DoubleType': np.float64,
+    'BooleanType': np.bool_,
+    'StringType': np.str_,
+    'BinaryType': np.bytes_,
+    'TimestampType': 'datetime64[ns]',
+    'DateType': 'datetime64[D]',
+}
+
+# numpy globals that legitimately appear in reference unischema pickles:
+# scalar type objects (``numpy.uint8``...), ``numpy.dtype`` for explicit
+# dtypes, and the ndarray/scalar reconstructors for pickled defaults.
+_NUMPY_SCALAR_NAMES = frozenset(
+    t.__name__ for t in np.sctypeDict.values()) | frozenset(
+    ('str_', 'bytes_', 'unicode_', 'string_', 'bool_', 'object_'))
+_ALLOWED_NUMPY = _NUMPY_SCALAR_NAMES | {'dtype', 'ndarray'}
+
+_PETASTORM_CLASS_MAP = {
+    ('petastorm.unischema', 'Unischema'): _StubUnischema,
+    ('petastorm.unischema', 'UnischemaField'): None,  # special: namedtuple
+    ('petastorm.codecs', 'CompressedImageCodec'): _StubCompressedImageCodec,
+    ('petastorm.codecs', 'NdarrayCodec'): _StubNdarrayCodec,
+    ('petastorm.codecs', 'CompressedNdarrayCodec'): _StubCompressedNdarrayCodec,
+    ('petastorm.codecs', 'ScalarCodec'): _StubScalarCodec,
+    ('petastorm.etl.rowgroup_indexers', 'SingleFieldIndexer'): _StubSingleFieldIndexer,
+    ('petastorm.etl.rowgroup_indexers', 'FieldNotNullIndexer'): _StubFieldNotNullIndexer,
+}
+
+
+class _StubUnischemaField(tuple):
+    """Mimics the reference's namedtuple pickling protocol
+    (``__getnewargs__`` -> NEWOBJ with the 5 field values)."""
+
+    def __new__(cls, name, numpy_dtype, shape, codec=None, nullable=False):
+        return tuple.__new__(cls, (name, numpy_dtype, shape, codec, nullable))
+
+    name = property(lambda self: self[0])
+    numpy_dtype = property(lambda self: self[1])
+    shape = property(lambda self: self[2])
+    codec = property(lambda self: self[3])
+    nullable = property(lambda self: self[4])
+
+
+def _normalize_module(module):
+    for prefix in _LEGACY_PACKAGE_PREFIXES:
+        if module.startswith(prefix):
+            return 'petastorm.' + module[len(prefix):]
+    # 'sequence' was renamed to 'ngram' before the package rename settled.
+    if module == 'petastorm.sequence':
+        return 'petastorm.ngram'
+    return module
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """find_class whitelist mapping reference globals to local equivalents."""
+
+    def find_class(self, module, name):
+        module = _normalize_module(module)
+        if module.startswith('petastorm.'):
+            key = (module, name)
+            if key == ('petastorm.unischema', 'UnischemaField'):
+                return _StubUnischemaField
+            if key in _PETASTORM_CLASS_MAP and _PETASTORM_CLASS_MAP[key] is not None:
+                return _PETASTORM_CLASS_MAP[key]
+            raise LegacyMetadataError(
+                'Unsupported petastorm class in legacy metadata: {}.{}'.format(module, name))
+        if module == 'pyspark.sql.types' and name in _SPARK_STUBS:
+            return _SPARK_STUBS[name]
+        if module in ('numpy', 'numpy.core.numerictypes') and name in _ALLOWED_NUMPY:
+            return getattr(np, name)
+        if module == 'numpy' and name == '_reconstruct':
+            return np.core.multiarray._reconstruct
+        if module == 'numpy.core.multiarray' and name in ('_reconstruct', 'scalar'):
+            return getattr(np.core.multiarray, name)
+        if module == 'decimal' and name == 'Decimal':
+            return decimal.Decimal
+        if module == 'collections' and name in ('OrderedDict', 'defaultdict'):
+            return {'OrderedDict': OrderedDict, 'defaultdict': defaultdict}[name]
+        if module in ('builtins', '__builtin__') and name in (
+                'set', 'frozenset', 'list', 'dict', 'tuple', 'object',
+                'bytearray', 'complex', 'int', 'float', 'bool', 'str', 'bytes'):
+            return getattr(__import__('builtins'), name)
+        if module == 'copy_reg' or module == 'copyreg':
+            if name == '_reconstructor':
+                import copyreg
+                return copyreg._reconstructor
+        raise LegacyMetadataError(
+            'Refusing to unpickle disallowed global {}.{} from legacy '
+            'petastorm metadata'.format(module, name))
+
+
+def _restricted_loads(blob):
+    try:
+        return _RestrictedUnpickler(io.BytesIO(blob)).load()
+    except LegacyMetadataError:
+        raise
+    except Exception as e:
+        raise LegacyMetadataError('Cannot decode legacy petastorm metadata: {}'.format(e))
+
+
+def _convert_codec(stub, numpy_dtype):
+    if stub is None:
+        return None
+    if isinstance(stub, _StubCompressedImageCodec):
+        fmt = getattr(stub, '_image_codec', '.png').lstrip('.')
+        quality = getattr(stub, '_quality', 80)
+        return tpu_codecs.CompressedImageCodec(fmt, quality=quality)
+    if isinstance(stub, _StubNdarrayCodec):
+        return tpu_codecs.NdarrayCodec()
+    if isinstance(stub, _StubCompressedNdarrayCodec):
+        return tpu_codecs.CompressedNdarrayCodec()
+    if isinstance(stub, _StubScalarCodec):
+        spark = getattr(stub, '_spark_type', None)
+        if isinstance(spark, _StubSparkType) and spark.spark_name in _SPARK_NAME_TO_NUMPY:
+            return tpu_codecs.ScalarCodec(np.dtype(_SPARK_NAME_TO_NUMPY[spark.spark_name]))
+        if isinstance(spark, _StubSparkType) and spark.spark_name == 'DecimalType':
+            return tpu_codecs.ScalarCodec(np.str_)
+        return tpu_codecs.ScalarCodec(numpy_dtype)
+    raise LegacyMetadataError('Unknown legacy codec stub {!r}'.format(stub))
+
+
+def _convert_field(stub):
+    if not isinstance(stub, _StubUnischemaField):
+        raise LegacyMetadataError('Expected UnischemaField, got {!r}'.format(stub))
+    if stub.numpy_dtype is decimal.Decimal:
+        # The reference yields decimal.Decimal objects for DecimalType fields
+        # (``tf_utils.py:68-71`` stringifies them). We map them to strings —
+        # the only fixed-width representation a TPU pipeline can stage.
+        numpy_dtype = np.dtype(np.str_)
+    else:
+        numpy_dtype = np.dtype(stub.numpy_dtype)
+    shape = tuple(stub.shape) if stub.shape is not None else ()
+    return UnischemaField(stub.name, numpy_dtype, shape,
+                          _convert_codec(stub.codec, numpy_dtype),
+                          bool(stub.nullable))
+
+
+def load_legacy_unischema(blob):
+    """Decode a ``dataset-toolkit.unischema.v1`` pickle into our Unischema."""
+    stub = _restricted_loads(blob)
+    if not isinstance(stub, _StubUnischema):
+        raise LegacyMetadataError('Legacy unischema blob did not contain a Unischema')
+    state = stub.__dict__
+    name = state.get('_name', 'LegacySchema')
+    fields_dict = state.get('_fields', {})
+    fields = [_convert_field(f) for f in fields_dict.values()]
+    logger.info('Loaded legacy petastorm unischema %r with %d fields', name, len(fields))
+    return Unischema(name, fields)
+
+
+def _convert_indexer(name, stub):
+    """To our JSON index payload format (``rowgroup_indexers.to_json_payload``)."""
+    field = getattr(stub, '_column_name', name)
+    data = getattr(stub, '_index_data', {})
+    if isinstance(stub, _StubSingleFieldIndexer):
+        return {'type': 'single_field', 'field': field,
+                'values': {str(v): sorted(int(p) for p in pieces)
+                           for v, pieces in data.items()}}
+    if isinstance(stub, _StubFieldNotNullIndexer):
+        # Reference stores a flat set of piece indexes (rowgroup_indexers.py:86).
+        pieces = {int(x) for x in data} if not isinstance(data, dict) else \
+            {int(x) for p in data.values() for x in p}
+        return {'type': 'field_not_null', 'field': field,
+                'values': {'not_null': sorted(pieces)}}
+    raise LegacyMetadataError('Unknown legacy indexer {!r}'.format(stub))
+
+
+def load_legacy_row_group_indexes(blob):
+    """Decode ``dataset-toolkit.rowgroups_index.v1`` into our JSON payload dict."""
+    raw = _restricted_loads(blob)
+    if not isinstance(raw, dict):
+        raise LegacyMetadataError('Legacy rowgroup index blob is not a dict')
+    return {name: _convert_indexer(name, stub) for name, stub in raw.items()}
+
+
+# ---------------------------------------------------------------------------
+# Write side: emit a pickle the reference library can load
+# ---------------------------------------------------------------------------
+
+_export_modules_lock = threading.Lock()
+
+
+def _shim_module(name):
+    mod = types.ModuleType(name)
+    mod.__dict__['__petastorm_tpu_shim__'] = True
+    return mod
+
+
+def _build_export_modules():
+    """Create ``petastorm.unischema``/``petastorm.codecs``/``pyspark.sql.types``
+    shim modules whose classes pickle under the reference's global names."""
+    uni = _shim_module('petastorm.unischema')
+    cod = _shim_module('petastorm.codecs')
+    spark = _shim_module('pyspark.sql.types')
+
+    import collections
+    field_cls = collections.namedtuple(
+        'UnischemaField', ['name', 'numpy_dtype', 'shape', 'codec', 'nullable'])
+    field_cls.__module__ = 'petastorm.unischema'
+    field_cls.__qualname__ = 'UnischemaField'
+    uni.UnischemaField = field_cls
+
+    class Unischema(object):
+        pass
+    Unischema.__module__ = 'petastorm.unischema'
+    Unischema.__qualname__ = 'Unischema'
+    uni.Unischema = Unischema
+
+    codec_classes = {}
+    for cname in ('CompressedImageCodec', 'NdarrayCodec',
+                  'CompressedNdarrayCodec', 'ScalarCodec'):
+        cls = type(cname, (object,), {'__module__': 'petastorm.codecs'})
+        codec_classes[cname] = cls
+        setattr(cod, cname, cls)
+
+    spark_classes = {}
+    for sname in _SPARK_TYPE_NAMES:
+        cls = type(sname, (object,), {'__module__': 'pyspark.sql.types'})
+        spark_classes[sname] = cls
+        setattr(spark, sname, cls)
+
+    # Parent packages must resolve too: pickle's save_global verifies classes
+    # via ``__import__('petastorm.unischema')``, which imports 'petastorm'
+    # first. Shim packages need a __path__ to count as packages.
+    pst = _shim_module('petastorm')
+    pst.__path__ = []
+    pst.unischema = uni
+    pst.codecs = cod
+    pysp = _shim_module('pyspark')
+    pysp.__path__ = []
+    sql = _shim_module('pyspark.sql')
+    sql.__path__ = []
+    sql.types = spark
+    pysp.sql = sql
+
+    return {
+        'modules': {'petastorm': pst, 'petastorm.unischema': uni,
+                    'petastorm.codecs': cod, 'pyspark': pysp,
+                    'pyspark.sql': sql, 'pyspark.sql.types': spark},
+        'field_cls': field_cls, 'unischema_cls': Unischema,
+        'codec_classes': codec_classes, 'spark_classes': spark_classes,
+    }
+
+
+_NUMPY_TO_SPARK_NAME = {
+    'int8': 'ByteType', 'uint8': 'ShortType', 'int16': 'ShortType',
+    'uint16': 'IntegerType', 'int32': 'IntegerType', 'uint32': 'LongType',
+    'int64': 'LongType', 'float32': 'FloatType', 'float64': 'DoubleType',
+    'bool': 'BooleanType',
+}
+
+
+def _export_spark_type(shims, numpy_dtype):
+    dt = np.dtype(numpy_dtype)
+    if dt.kind in 'SU' or dt == np.object_:
+        name = 'StringType'
+    elif dt.kind == 'M':
+        name = 'TimestampType'
+    else:
+        name = _NUMPY_TO_SPARK_NAME.get(dt.name, 'StringType')
+    return shims['spark_classes'][name]()
+
+
+def _export_codec(shims, codec, numpy_dtype):
+    cc = shims['codec_classes']
+    if isinstance(codec, tpu_codecs.CompressedImageCodec):
+        out = cc['CompressedImageCodec']()
+        out._image_codec = '.' + codec.image_codec
+        out._quality = codec.quality
+        return out
+    if isinstance(codec, tpu_codecs.CompressedNdarrayCodec):
+        return cc['CompressedNdarrayCodec']()
+    if isinstance(codec, tpu_codecs.NdarrayCodec):
+        return cc['NdarrayCodec']()
+    if isinstance(codec, tpu_codecs.ScalarCodec) or codec is None:
+        out = cc['ScalarCodec']()
+        out._spark_type = _export_spark_type(shims, numpy_dtype)
+        return out
+    raise LegacyMetadataError(
+        'Codec {!r} has no legacy petastorm equivalent'.format(codec))
+
+
+def _export_field(shims, field):
+    dt = field.numpy_dtype
+    numpy_dtype = dt.type if isinstance(dt, np.dtype) else np.dtype(dt).type
+    codec = field.codec if field.codec is not None else field.resolved_codec()
+    return shims['field_cls'](field.name, numpy_dtype, tuple(field.shape),
+                              _export_codec(shims, codec, dt), bool(field.nullable))
+
+
+def dumps_legacy_unischema(schema):
+    """Pickle bytes loadable by reference petastorm's ``get_schema``."""
+    shims = _build_export_modules()
+    uni = shims['unischema_cls'].__new__(shims['unischema_cls'])
+    fields = [(f.name, _export_field(shims, f)) for f in schema.fields.values()]
+    uni.__dict__['_name'] = schema.name
+    uni.__dict__['_fields'] = OrderedDict(sorted(fields))
+    for fname, f in fields:
+        if fname not in uni.__dict__:
+            uni.__dict__[fname] = f
+
+    # Temporarily install the shim modules: pickle's save_global verifies a
+    # class by importing its __module__ and comparing attributes. If a real
+    # pyspark/petastorm is already imported (e.g. make_converter on a Spark
+    # DataFrame ran first), shadow it for the duration of the dump and restore
+    # it after — pickling only reads sys.modules, never the shadowed package.
+    with _export_modules_lock:
+        saved = {}
+        try:
+            for name, mod in shims['modules'].items():
+                if name in sys.modules:
+                    saved[name] = sys.modules[name]
+                sys.modules[name] = mod
+            return pickle.dumps(uni, protocol=2)
+        finally:
+            for name in shims['modules']:
+                if name in saved:
+                    sys.modules[name] = saved[name]
+                else:
+                    del sys.modules[name]
+
+
+def export_legacy_metadata(store_or_url, schema=None, storage_options=None):
+    """Write reference-petastorm-readable metadata keys into
+    ``_common_metadata`` (unischema pickle + num-row-groups JSON) so a user of
+    the reference library can read a petastorm_tpu-materialized store."""
+    from petastorm_tpu.storage import NUM_ROW_GROUPS_KEY, ParquetStore
+
+    store = store_or_url if isinstance(store_or_url, ParquetStore) \
+        else ParquetStore(store_or_url, storage_options)
+    if schema is None:
+        from petastorm_tpu.etl.dataset_metadata import get_schema
+        schema = get_schema(store)
+
+    updates = {LEGACY_UNISCHEMA_KEY: dumps_legacy_unischema(schema)}
+    counts_blob = store.common_metadata_value(NUM_ROW_GROUPS_KEY)
+    if counts_blob is None:
+        counts_blob = json.dumps(store.num_row_groups_per_file()).encode('utf-8')
+    updates[LEGACY_NUM_ROW_GROUPS_KEY] = counts_blob
+    store.write_common_metadata(store.read_arrow_schema(), updates)
+    logger.info('Wrote legacy petastorm metadata for %s', store.url)

@@ -93,6 +93,11 @@ def get_row_group_indexes(dataset_url_or_store, storage_options=None):
              else ParquetStore(dataset_url_or_store, storage_options))
     blob = store.common_metadata_value(ROWGROUP_INDEX_KEY)
     if blob is None:
+        from petastorm_tpu.etl.legacy import (LEGACY_ROWGROUP_INDEX_KEY,
+                                              load_legacy_row_group_indexes)
+        legacy_blob = store.common_metadata_value(LEGACY_ROWGROUP_INDEX_KEY)
+        if legacy_blob is not None:
+            return load_legacy_row_group_indexes(legacy_blob)
         raise ValueError('Dataset {} has no row-group index; run '
                          'build_rowgroup_index first'.format(store.url))
     return json.loads(blob.decode('utf-8'))

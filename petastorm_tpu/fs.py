@@ -75,12 +75,7 @@ class FilesystemResolver(object):
     def filesystem_factory(self):
         """A picklable zero-arg callable recreating the filesystem on a remote
         worker process (parity: ``fs_utils.py:174-180``)."""
-        scheme, options = self._scheme, dict(self._storage_options)
-
-        def factory():
-            return fsspec.filesystem(scheme, **options)
-
-        return factory
+        return _FilesystemFactory(self._scheme, dict(self._storage_options))
 
     def __getstate__(self):
         # Parity with the reference's explicit no-pickling rule
@@ -88,7 +83,86 @@ class FilesystemResolver(object):
         raise RuntimeError('FilesystemResolver cannot be pickled; use filesystem_factory()')
 
 
-def get_filesystem_and_path(url_or_path, storage_options=None):
-    """One-shot helper: ``url -> (fsspec_fs, path)``."""
+class _FilesystemFactory(object):
+    """Module-level (stdlib-picklable) zero-arg filesystem constructor."""
+
+    def __init__(self, scheme, options):
+        self._scheme = scheme
+        self._options = options
+
+    def __call__(self):
+        return fsspec.filesystem(self._scheme, **self._options)
+
+
+class RetryingFilesystemWrapper(object):
+    """Retries transient IO failures on every filesystem call.
+
+    Parity: the reference wraps every public HDFS filesystem method with a
+    ``namenode_failover`` decorator retrying up to 2 failovers on
+    ``ArrowIOError`` (``hdfs/namenode.py:146-238``). Here the same contract is
+    filesystem-agnostic: any fsspec filesystem (GCS is the TPU-VM common
+    case) gets bounded retry with optional backoff. Connection-level HA
+    (namenode election, GCS endpoint choice) belongs to the fsspec driver;
+    this wrapper owns the *retry policy*.
+    """
+
+    #: Methods wrapped with retry; anything else delegates straight through.
+    RETRY_METHODS = frozenset((
+        'open', 'ls', 'exists', 'isdir', 'isfile', 'info', 'glob', 'walk',
+        'find', 'du', 'rm', 'mkdir', 'makedirs', 'put', 'get', 'mv', 'copy',
+        'cat_file', 'pipe_file', 'created', 'modified', 'size',
+    ))
+
+    def __init__(self, fs, retries=2, retry_exceptions=(IOError, OSError),
+                 backoff_s=0.1, on_retry=None):
+        """:param retries: extra attempts after the first failure (2 matches
+            the reference's ``MAX_NAMENODES=2`` failover budget).
+        :param on_retry: optional ``f(method_name, attempt, exception)`` hook
+            (used by tests to count failovers, and handy for metrics)."""
+        self._fs = fs
+        self._retries = int(retries)
+        self._retry_exceptions = tuple(retry_exceptions)
+        self._backoff_s = backoff_s
+        self._on_retry = on_retry
+
+    @property
+    def wrapped(self):
+        return self._fs
+
+    def __getattr__(self, name):
+        attr = getattr(self._fs, name)
+        if name not in self.RETRY_METHODS or not callable(attr):
+            return attr
+
+        def call_with_retry(*args, **kwargs):
+            import time
+            last = None
+            for attempt in range(self._retries + 1):
+                try:
+                    return attr(*args, **kwargs)
+                except self._retry_exceptions as e:
+                    last = e
+                    if attempt == self._retries:
+                        break
+                    if self._on_retry is not None:
+                        self._on_retry(name, attempt, e)
+                    logger.warning('Filesystem %s() failed (%s); retry %d/%d',
+                                   name, e, attempt + 1, self._retries)
+                    if self._backoff_s:
+                        time.sleep(self._backoff_s * (2 ** attempt))
+            raise last
+
+        return call_with_retry
+
+
+def get_filesystem_and_path(url_or_path, storage_options=None, retries=None):
+    """One-shot helper: ``url -> (fsspec_fs, path)``.
+
+    ``retries`` (int) wraps the filesystem in
+    :class:`RetryingFilesystemWrapper`.
+    """
     resolver = FilesystemResolver(url_or_path, storage_options)
-    return resolver.filesystem(), resolver.get_dataset_path()
+    fs = resolver.filesystem()
+    if retries is not None:
+        fs = RetryingFilesystemWrapper(fs, retries=retries)
+    return fs, resolver.get_dataset_path()

@@ -79,20 +79,29 @@ class in_reduce(PredicateBase):
 
 
 class in_lambda(PredicateBase):
-    """Arbitrary user lambda over a declared set of fields."""
+    """Arbitrary user lambda over a declared list of fields.
+
+    The function receives one **positional argument per declared field, in
+    declaration order** (parity: reference ``predicates.py:74-101`` —
+    ``in_lambda(['id'], lambda id: id < 5)``), with ``state_arg`` appended
+    when given.
+    """
 
     def __init__(self, fields, func, state_arg=None):
-        self._fields = set(fields)
+        if not isinstance(fields, (list, tuple)):
+            raise ValueError('in_lambda fields must be a list')
+        self._ordered_fields = list(fields)
         self._func = func
         self._state = state_arg
 
     def get_fields(self):
-        return self._fields
+        return set(self._ordered_fields)
 
     def do_include(self, values):
+        args = [values[f] for f in self._ordered_fields]
         if self._state is not None:
-            return self._func(values, self._state)
-        return self._func(values)
+            args.append(self._state)
+        return self._func(*args)
 
 
 def _stable_hash_fraction(value, num_buckets):

@@ -225,6 +225,11 @@ class ParquetStore(object):
         ``_common_metadata`` (parity: ``etl/dataset_metadata.py:246-273``)."""
         blob = self.common_metadata_value(NUM_ROW_GROUPS_KEY)
         if blob is None:
+            # Reference-petastorm stores keep the same JSON under a legacy key
+            # (reference etl/dataset_metadata.py:34).
+            from petastorm_tpu.etl.legacy import LEGACY_NUM_ROW_GROUPS_KEY
+            blob = self.common_metadata_value(LEGACY_NUM_ROW_GROUPS_KEY)
+        if blob is None:
             return None
         counts = json.loads(blob.decode('utf-8'))
         pieces = []

@@ -28,9 +28,10 @@ def copy_dataset(source_url, target_url, field_regex=None, not_null_fields=None,
     predicate = None
     if not_null_fields:
         not_null_fields = list(not_null_fields)
+        # in_lambda passes one positional value per field (reference
+        # predicates.py:97-101).
         predicate = in_lambda(not_null_fields,
-                              lambda values: all(values[f] is not None
-                                                 for f in not_null_fields))
+                              lambda *values: all(v is not None for v in values))
 
     rows_copied = 0
     with make_reader(source_url, schema_fields=list(schema.fields),

@@ -57,7 +57,7 @@ def test_schema_fields_regex(synthetic_dataset):
 @pytest.mark.parametrize('reader_factory', READER_FACTORIES)
 def test_predicate(synthetic_dataset, reader_factory):
     with reader_factory(synthetic_dataset.url,
-                        predicate=in_lambda(['id'], lambda v: v['id'] % 2 == 0)) as reader:
+                        predicate=in_lambda(['id'], lambda id: id % 2 == 0)) as reader:
         ids = {row.id for row in reader}
     assert ids == {r['id'] for r in synthetic_dataset.data if r['id'] % 2 == 0}
 
@@ -218,7 +218,7 @@ def test_batch_reader_schema_fields(scalar_dataset):
 
 def test_batch_reader_predicate(scalar_dataset):
     with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
-                           predicate=in_lambda(['id'], lambda v: v['id'] < 10)) as reader:
+                           predicate=in_lambda(['id'], lambda id: id < 10)) as reader:
         ids = np.concatenate([b.id for b in reader])
     assert sorted(ids.tolist()) == list(range(10))
 

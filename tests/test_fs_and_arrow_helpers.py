@@ -1,0 +1,151 @@
+"""Filesystem retry wrapper + BatchingTableQueue tests.
+
+Parity: reference ``petastorm/hdfs/tests/test_hdfs_namenode.py`` (failover
+counting with MockHdfs, ``:250-451``) and
+``petastorm/pyarrow_helpers/tests/test_batching_table_queue.py``.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.arrow_helpers import BatchingTableQueue
+from petastorm_tpu.fs import (FilesystemResolver, RetryingFilesystemWrapper,
+                              get_filesystem_and_path, normalize_dataset_url)
+
+
+class FlakyFs(object):
+    """Mock filesystem failing the first N calls of each method
+    (parity: MockHdfs simulating ArrowIOError failovers)."""
+
+    def __init__(self, failures):
+        self.failures = failures
+        self.calls = {}
+
+    def _maybe_fail(self, name):
+        count = self.calls.get(name, 0)
+        self.calls[name] = count + 1
+        if count < self.failures:
+            raise IOError('transient failure #{} in {}'.format(count, name))
+
+    def exists(self, path):
+        self._maybe_fail('exists')
+        return True
+
+    def ls(self, path):
+        self._maybe_fail('ls')
+        return ['a', 'b']
+
+    def not_retryable_marker(self):
+        return 'passthrough'
+
+
+def test_retry_succeeds_within_budget():
+    events = []
+    fs = RetryingFilesystemWrapper(FlakyFs(failures=2), retries=2,
+                                   backoff_s=0,
+                                   on_retry=lambda m, a, e: events.append((m, a)))
+    assert fs.exists('/x') is True
+    assert fs.wrapped.calls['exists'] == 3
+    assert events == [('exists', 0), ('exists', 1)]
+
+
+def test_retry_exhausted_raises_last_error():
+    fs = RetryingFilesystemWrapper(FlakyFs(failures=5), retries=2, backoff_s=0)
+    with pytest.raises(IOError):
+        fs.ls('/x')
+    assert fs.wrapped.calls['ls'] == 3  # initial + 2 retries
+
+
+def test_non_retry_methods_delegate():
+    fs = RetryingFilesystemWrapper(FlakyFs(failures=0), retries=1, backoff_s=0)
+    assert fs.not_retryable_marker() == 'passthrough'
+
+
+def test_non_matching_exceptions_propagate_immediately():
+    class Broken(object):
+        def __init__(self):
+            self.calls = 0
+
+        def exists(self, path):
+            self.calls += 1
+            raise ValueError('not transient')
+
+    broken = Broken()
+    fs = RetryingFilesystemWrapper(broken, retries=3, backoff_s=0)
+    with pytest.raises(ValueError):
+        fs.exists('/x')
+    assert broken.calls == 1
+
+
+def test_get_filesystem_and_path_retries_opt_in(tmp_path):
+    fs, path = get_filesystem_and_path('file://' + str(tmp_path), retries=1)
+    assert isinstance(fs, RetryingFilesystemWrapper)
+    assert fs.exists(path)
+
+
+def test_resolver_not_picklable():
+    import pickle
+    resolver = FilesystemResolver('file:///tmp/x')
+    with pytest.raises(RuntimeError):
+        pickle.dumps(resolver)
+    factory = resolver.filesystem_factory()
+    assert pickle.loads(pickle.dumps(factory))().exists('/')
+
+
+def test_normalize_url_rejects_relative():
+    with pytest.raises(ValueError):
+        normalize_dataset_url('relative/path')
+
+
+# --- BatchingTableQueue ----------------------------------------------------
+
+def _table(start, n):
+    return pa.table({'id': pa.array(np.arange(start, start + n), pa.int64()),
+                     'x': pa.array(np.arange(start, start + n) * 0.5, pa.float64())})
+
+
+def test_batching_exact_rechunk():
+    q = BatchingTableQueue(4)
+    assert q.empty()
+    q.put(_table(0, 10))
+    assert not q.empty() and len(q) == 10
+    a = q.get()
+    b = q.get()
+    assert a.num_rows == 4 and b.num_rows == 4
+    assert a.column('id').to_pylist() == [0, 1, 2, 3]
+    assert b.column('id').to_pylist() == [4, 5, 6, 7]
+    assert q.empty() and len(q) == 2  # remainder retained
+
+
+def test_batching_across_puts():
+    q = BatchingTableQueue(5)
+    q.put(_table(0, 2))
+    q.put(_table(2, 2))
+    assert q.empty()
+    q.put(_table(4, 3))
+    got = q.get()
+    assert got.column('id').to_pylist() == [0, 1, 2, 3, 4]
+    assert len(q) == 2
+
+
+def test_batching_underflow_raises():
+    q = BatchingTableQueue(3)
+    q.put(_table(0, 2))
+    with pytest.raises(IndexError):
+        q.get()
+
+
+def test_batching_record_batch_input_and_schema_mismatch():
+    q = BatchingTableQueue(2)
+    q.put(_table(0, 3).to_batches()[0])
+    assert q.get().num_rows == 2
+    with pytest.raises(ValueError):
+        q.put(pa.table({'other': pa.array([1])}))
+
+
+def test_batching_batch_one():
+    q = BatchingTableQueue(1)
+    q.put(_table(0, 3))
+    out = [q.get().column('id').to_pylist() for _ in range(3)]
+    assert out == [[0], [1], [2]]

@@ -62,7 +62,7 @@ def test_selector_with_predicate_combined(indexed_dataset):
     with make_reader(indexed_dataset.url, reader_pool_type='dummy',
                      rowgroup_selector=selector,
                      predicate=in_lambda(['sensor_name'],
-                                         lambda v: v['sensor_name'] == 'sensor_2')) as reader:
+                                         lambda sensor_name: sensor_name == 'sensor_2')) as reader:
         rows = list(reader)
     expected = {r['id'] for r in indexed_dataset.data if r['sensor_name'] == 'sensor_2'}
     assert {r.id for r in rows} == expected

@@ -1,0 +1,242 @@
+"""Interop with reference-petastorm-materialized datasets.
+
+Strategy: we fabricate stores whose ``_common_metadata`` carries ONLY the
+reference's metadata keys (``dataset-toolkit.*``), with pickles built under
+shim modules bearing the reference's class names — no reference code is
+imported or copied. Parity: reference ``petastorm/tests/
+test_reading_legacy_datasets.py`` pins old-format decoding the same way.
+"""
+
+import pickle
+import sys
+import types
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec,
+                                  NdarrayCodec, ScalarCodec)
+from petastorm_tpu.etl.dataset_metadata import get_schema
+from petastorm_tpu.etl.legacy import (LEGACY_NUM_ROW_GROUPS_KEY,
+                                      LEGACY_ROWGROUP_INDEX_KEY,
+                                      LEGACY_UNISCHEMA_KEY,
+                                      LegacyMetadataError,
+                                      dumps_legacy_unischema,
+                                      export_legacy_metadata,
+                                      load_legacy_row_group_indexes,
+                                      load_legacy_unischema)
+from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.storage import (NUM_ROW_GROUPS_KEY, ROWGROUP_INDEX_KEY,
+                                   UNISCHEMA_KEY, ParquetStore)
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+SCHEMA = Unischema('LegacySchema', [
+    UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField('image', np.uint8, (8, 6, 3), CompressedImageCodec('png'), False),
+    UnischemaField('matrix', np.float32, (3, 4), NdarrayCodec(), False),
+    UnischemaField('packed', np.int16, (2, 2), CompressedNdarrayCodec(), False),
+    UnischemaField('name', np.str_, (), ScalarCodec(np.str_), True),
+])
+
+
+def _write_store(tmpdir, rows=12):
+    rng = np.random.default_rng(7)
+    url = 'file://' + str(tmpdir)
+
+    def gen():
+        for i in range(rows):
+            yield {'id': i,
+                   'image': rng.integers(0, 255, (8, 6, 3), dtype=np.uint8),
+                   'matrix': rng.standard_normal((3, 4)).astype(np.float32),
+                   'packed': rng.integers(-5, 5, (2, 2)).astype(np.int16),
+                   'name': 'row{}'.format(i)}
+
+    write_dataset(url, SCHEMA, gen(), rows_per_row_group=4)
+    return url
+
+
+def _strip_to_legacy_metadata(url, extra=()):
+    """Replace our metadata keys with reference-style ``dataset-toolkit.*``
+    keys, leaving a store indistinguishable from a reference-materialized one."""
+    store = ParquetStore(url)
+    md = dict(store.read_common_metadata())
+    legacy = {k: v for k, v in md.items() if not k.startswith(b'petastorm_tpu.')}
+    legacy[LEGACY_UNISCHEMA_KEY] = dumps_legacy_unischema(get_schema(store))
+    legacy[LEGACY_NUM_ROW_GROUPS_KEY] = md[NUM_ROW_GROUPS_KEY]
+    legacy.update(extra)
+    schema = store.read_arrow_schema().with_metadata(legacy)
+    with store.fs.open(store.path + '/_common_metadata', 'wb') as f:
+        pq.write_metadata(schema, f)
+    return url
+
+
+def test_legacy_unischema_roundtrip():
+    blob = dumps_legacy_unischema(SCHEMA)
+    loaded = load_legacy_unischema(blob)
+    assert loaded.name == 'LegacySchema'
+    assert set(loaded.fields) == set(SCHEMA.fields)
+    for name, field in SCHEMA.fields.items():
+        got = loaded.fields[name]
+        assert got == field  # equality ignores codec
+        assert type(got.codec) is type(field.codec)
+    img = loaded.fields['image']
+    assert img.codec.image_codec == 'png'
+    assert img.numpy_dtype == np.uint8 and img.shape == (8, 6, 3)
+
+
+def test_read_reference_materialized_store(tmp_path):
+    url = _strip_to_legacy_metadata(_write_store(tmp_path))
+    store = ParquetStore(url)
+    assert store.common_metadata_value(UNISCHEMA_KEY) is None  # really legacy
+
+    with make_reader(url, reader_pool_type='dummy', shuffle_row_groups=False) as reader:
+        rows = list(reader)
+    assert len(rows) == 12
+    assert sorted(r.id for r in rows) == list(range(12))
+    assert rows[0].image.shape == (8, 6, 3) and rows[0].image.dtype == np.uint8
+    assert rows[0].matrix.shape == (3, 4) and rows[0].matrix.dtype == np.float32
+    assert rows[0].packed.dtype == np.int16
+
+
+def _legacy_shim_modules(package='petastorm'):
+    """Register reference-named indexer/schema classes for pickling fixtures."""
+    created = {}
+
+    def module(name):
+        if name in sys.modules:
+            return sys.modules[name], False
+        mod = types.ModuleType(name)
+        sys.modules[name] = mod
+        created[name] = mod
+        return mod, True
+
+    pkg, _ = module(package)
+    pkg.__path__ = []
+    etl, _ = module(package + '.etl')
+    etl.__path__ = []
+    pkg.etl = etl
+    idx_name = package + '.etl.rowgroup_indexers'
+    mod, _ = module(idx_name)
+    etl.rowgroup_indexers = mod
+
+    SingleFieldIndexer = type('SingleFieldIndexer', (object,),
+                              {'__module__': idx_name})
+    FieldNotNullIndexer = type('FieldNotNullIndexer', (object,),
+                               {'__module__': idx_name})
+    mod.SingleFieldIndexer = SingleFieldIndexer
+    mod.FieldNotNullIndexer = FieldNotNullIndexer
+    return created, SingleFieldIndexer, FieldNotNullIndexer
+
+
+def test_legacy_rowgroup_index_decodes():
+    created, SingleFieldIndexer, FieldNotNullIndexer = _legacy_shim_modules()
+    try:
+        single = SingleFieldIndexer()
+        single.__dict__.update(_index_name='by_name', _column_name='name',
+                               _index_data={'row1': {0, 2}, 'row2': {1}})
+        notnull = FieldNotNullIndexer()
+        notnull.__dict__.update(_index_name='name_set', _column_name='name',
+                                _index_data={0, 1})
+        blob = pickle.dumps({'by_name': single, 'name_set': notnull}, protocol=2)
+    finally:
+        for name in created:
+            del sys.modules[name]
+
+    payload = load_legacy_row_group_indexes(blob)
+    assert payload['by_name'] == {'type': 'single_field', 'field': 'name',
+                                  'values': {'row1': [0, 2], 'row2': [1]}}
+    assert payload['name_set']['values'] == {'not_null': [0, 1]}
+
+
+def test_legacy_rowgroup_index_via_store(tmp_path):
+    created, SingleFieldIndexer, _ = _legacy_shim_modules()
+    try:
+        single = SingleFieldIndexer()
+        single.__dict__.update(_index_name='by_name', _column_name='name',
+                               _index_data={'row0': {0}})
+        blob = pickle.dumps({'by_name': single}, protocol=2)
+    finally:
+        for name in created:
+            del sys.modules[name]
+
+    url = _strip_to_legacy_metadata(_write_store(tmp_path),
+                                    extra={LEGACY_ROWGROUP_INDEX_KEY: blob})
+    indexes = get_row_group_indexes(url)
+    assert indexes['by_name']['values'] == {'row0': [0]}
+
+
+def test_legacy_package_rename_normalized(tmp_path):
+    """Pickles from the pre-rename ``av.ml.dataset_toolkit`` era still load."""
+    blob = dumps_legacy_unischema(SCHEMA)
+    old = blob.replace(b'petastorm.unischema', b'av.ml.dataset_toolkit.unischema') \
+              .replace(b'petastorm.codecs', b'av.ml.dataset_toolkit.codecs')
+    assert b'av.ml.dataset_toolkit' in old
+    loaded = load_legacy_unischema(old)
+    assert set(loaded.fields) == set(SCHEMA.fields)
+
+
+def test_restricted_unpickler_rejects_arbitrary_globals():
+    import os
+
+    class Evil(object):
+        def __reduce__(self):
+            return (os.system, ('true',))
+
+    with pytest.raises(LegacyMetadataError):
+        load_legacy_unischema(pickle.dumps(Evil(), protocol=2))
+
+
+def test_export_shadows_already_imported_modules():
+    """Export works (shadow+restore) even when 'pyspark'/'petastorm' are
+    already in sys.modules — e.g. after converting a Spark DataFrame."""
+    fake = types.ModuleType('pyspark')
+    fake.__path__ = []
+    sys.modules['pyspark'] = fake
+    try:
+        blob = dumps_legacy_unischema(SCHEMA)
+        assert sys.modules['pyspark'] is fake  # restored
+        assert 'petastorm' not in sys.modules
+        loaded = load_legacy_unischema(blob)
+        assert set(loaded.fields) == set(SCHEMA.fields)
+    finally:
+        del sys.modules['pyspark']
+
+
+def test_generate_metadata_migrates_legacy_store(tmp_path):
+    """The generate-metadata CLI upgrades a reference store to native keys."""
+    from petastorm_tpu.etl.metadata_cli import generate_metadata
+
+    url = _strip_to_legacy_metadata(_write_store(tmp_path))
+    generate_metadata(url)
+    store = ParquetStore(url)
+    assert store.common_metadata_value(UNISCHEMA_KEY) is not None
+    schema = get_schema(store)
+    assert set(schema.fields) == set(SCHEMA.fields)
+    assert type(schema.fields['image'].codec) is CompressedImageCodec
+
+
+def test_export_legacy_metadata(tmp_path):
+    url = _write_store(tmp_path)
+    export_legacy_metadata(url, get_schema(ParquetStore(url)))
+
+    store = ParquetStore(url)
+    blob = store.common_metadata_value(LEGACY_UNISCHEMA_KEY)
+    assert blob is not None
+    # Our own keys survive alongside.
+    assert store.common_metadata_value(UNISCHEMA_KEY) is not None
+    # The emitted pickle references the reference's global names...
+    assert b'petastorm.unischema' in blob and b'UnischemaField' in blob
+    assert b'petastorm_tpu' not in blob
+    # ...and decodes back through the restricted reader.
+    loaded = load_legacy_unischema(blob)
+    assert set(loaded.fields) == set(SCHEMA.fields)
+    # Row-group counts mirror ours, relative paths.
+    import json
+    counts = json.loads(store.common_metadata_value(LEGACY_NUM_ROW_GROUPS_KEY))
+    assert counts == store.num_row_groups_per_file()
+    # The reader still works after the metadata rewrite.
+    with make_reader(url, reader_pool_type='dummy', shuffle_row_groups=False) as reader:
+        assert len(list(reader)) == 12

@@ -27,12 +27,12 @@ def test_mixing_ratio(synthetic_dataset):
 
 def _even_pred():
     from petastorm_tpu.predicates import in_lambda
-    return in_lambda(['id'], lambda v: v['id'] % 2 == 0)
+    return in_lambda(['id'], lambda id: id % 2 == 0)
 
 
 def _odd_pred():
     from petastorm_tpu.predicates import in_lambda
-    return in_lambda(['id'], lambda v: v['id'] % 2 == 1)
+    return in_lambda(['id'], lambda id: id % 2 == 1)
 
 
 def test_seeded_mixing_reproducible(synthetic_dataset):
