@@ -17,6 +17,7 @@ import hashlib
 import numpy as np
 import pyarrow as pa
 
+from petastorm_tpu.checkpoint import chunk_key
 from petastorm_tpu.workers.rowgroup_worker_base import (RowGroupWorkerBase,
                                                         compute_row_slice)
 
@@ -42,7 +43,11 @@ class ArrowWorker(RowGroupWorkerBase):
             table = self._apply_transform(table, transform_spec)
 
         if table.num_rows:
-            self.publish_func(table)
+            # Ventilation key rides in the schema metadata (survives the Arrow
+            # IPC serializer) for checkpoint/resume consumption tracking.
+            md = dict(table.schema.metadata or {})
+            md[b'pst.key'] = chunk_key(piece_index, shuffle_row_drop_partition).encode()
+            self.publish_func(table.replace_schema_metadata(md))
 
     def _apply_transform(self, table, transform_spec):
         """Pandas-based batch transform (parity: ``arrow_reader_worker.py:163-178``)."""
@@ -119,6 +124,12 @@ class ArrowResultsQueueReader(object):
     Parity: reference ``arrow_reader_worker.py:39-79``.
     """
 
+    def __init__(self):
+        self._tracker = None
+
+    def set_tracker(self, tracker):
+        self._tracker = tracker
+
     @property
     def batched_output(self):
         return True
@@ -127,7 +138,18 @@ class ArrowResultsQueueReader(object):
         if ngram is not None:
             raise NotImplementedError('NGram is not supported with batch (Arrow) readers '
                                       '(parity: arrow_reader_worker.py:97-98)')
-        table = pool.get_results()
+        while True:
+            table = pool.get_results()
+            key = (table.schema.metadata or {}).get(b'pst.key')
+            key = key.decode() if key is not None else None
+            if self._tracker is not None and key is not None:
+                skip = self._tracker.on_chunk(key, table.num_rows)
+                if skip:
+                    table = table.slice(skip)
+                if table.num_rows == 0:
+                    continue
+                self._tracker.rows_yielded(key, table.num_rows)
+            break
         columns = {}
         for name in schema.fields:
             if name not in table.column_names:

@@ -1,0 +1,134 @@
+"""Mid-epoch checkpoint/resume for readers.
+
+The reference has **no** reader-state checkpointing (SURVEY §5.4: closest
+analogs are ``Reader.reset()`` and disk caches). On TPU pods that gap is
+expensive: preemption is routine and restarting an epoch re-reads terabytes.
+This module adds exactly-once-per-epoch resume at row granularity:
+
+* every chunk a worker publishes is tagged with its ventilation key
+  ``"piece:drop_partition"`` (see ``py_dict_worker``/``arrow_worker``);
+* the consumer-side :class:`ConsumptionTracker` counts, per key, completed
+  instances (a full pass over that row-group's rows) and the partial row
+  position of the open instance;
+* ``Reader.state_dict()`` serializes those counters (JSON-safe);
+* a new Reader built with ``resume_state=`` skips, consumer-side, the
+  already-consumed instances/rows: completed keys are dropped on their next
+  arrival, a partially-consumed key drops its first ``partial`` rows.
+
+Semantics:
+
+* **Finite ``num_epochs``** — construct the resumed Reader with the *same*
+  ``num_epochs``; skips are absolute, so the total delivered across sessions
+  is exactly ``num_epochs`` passes.
+* **Infinite ``num_epochs=None``** (the TPU training loop case) — skips are
+  relative to the least-consumed key, preserving per-sample balance without
+  discarding unbounded amounts of decode work.
+* Rows held in downstream prefetch/shuffle buffers at checkpoint time count
+  as consumed: resume never replays a delivered row (no duplicated training
+  steps); un-trained in-flight rows return next epoch.
+
+Determinism requirements: same dataset, same reader configuration. Worker
+interleaving may reorder rows — the guarantee is multiset-exactness, not
+order.
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+STATE_VERSION = 1
+
+
+def chunk_key(piece_index, shuffle_row_drop_partition):
+    drop_idx = shuffle_row_drop_partition[0] if shuffle_row_drop_partition else 0
+    return '{}:{}'.format(piece_index, drop_idx)
+
+
+class ConsumptionTracker(object):
+    """Counts per-key consumption; computes resume-time skips.
+
+    Driven from the consumer thread only (inside ``Reader.__next__``) — no
+    locking needed.
+    """
+
+    def __init__(self, resume_state=None, num_epochs=1):
+        self._done = {}      # key -> instances fully consumed (incl. prior sessions)
+        self._partial = {}   # key -> rows consumed of the open instance
+        self._totals = {}    # key -> rows per instance (observed)
+        self._skip_instances = {}
+        self._skip_rows = {}
+        if resume_state:
+            self._load(resume_state, num_epochs)
+
+    def _load(self, state, num_epochs):
+        if state.get('version') != STATE_VERSION:
+            raise ValueError('Unsupported reader state version {!r}'.format(
+                state.get('version')))
+        keys = state.get('keys', {})
+        if not keys:
+            return
+        if num_epochs is None:
+            # Balance-preserving: only skip what a key is ahead of the
+            # least-consumed key (absolute skips would discard unbounded
+            # decode work in a long-running infinite loop).
+            base = min(entry['done'] for entry in keys.values())
+        else:
+            base = 0
+        for key, entry in keys.items():
+            done = int(entry['done'])
+            partial = int(entry.get('partial', 0))
+            self._done[key] = done
+            self._partial[key] = 0   # session-local position restarts
+            if entry.get('total') is not None:
+                self._totals[key] = int(entry['total'])
+            skip = done - base
+            if num_epochs is not None:
+                skip = min(skip, num_epochs)
+            if skip > 0:
+                self._skip_instances[key] = skip
+            if partial > 0:
+                self._skip_rows[key] = partial
+
+    # -- consumption events (called by results-queue readers) --------------
+
+    def on_chunk(self, key, total_rows):
+        """A new instance of ``key`` arrived with ``total_rows`` rows.
+        Returns how many leading rows the consumer must drop.
+
+        Skipped instances/rows re-deliver consumption that prior sessions
+        already counted in ``done``/``partial`` — they must NOT be counted
+        again, or a resume-of-a-resume would over-skip.
+        """
+        self._totals[key] = total_rows
+        if self._skip_instances.get(key, 0) > 0:
+            self._skip_instances[key] -= 1
+            return total_rows
+        skip = self._skip_rows.pop(key, 0)
+        if skip >= total_rows:
+            # The prior session consumed at least this whole instance (totals
+            # may have shrunk, e.g. config drift); be lenient and drop it all.
+            return total_rows
+        if skip:
+            self._partial[key] = skip
+        return skip
+
+    def rows_yielded(self, key, n):
+        partial = self._partial.get(key, 0) + n
+        total = self._totals.get(key)
+        if total is not None and partial >= total:
+            self._done[key] = self._done.get(key, 0) + 1
+            partial = 0
+        self._partial[key] = partial
+
+    # -- persistence -------------------------------------------------------
+
+    def state_dict(self):
+        keys = {}
+        for key in set(self._done) | set(self._partial) | set(self._totals):
+            partial = self._partial.get(key, 0)
+            # A still-pending partial skip is prior-session consumption not
+            # yet re-observed; carry it forward so the next resume honors it.
+            keys[key] = {'done': self._done.get(key, 0),
+                         'partial': partial or self._skip_rows.get(key, 0),
+                         'total': self._totals.get(key)}
+        return {'version': STATE_VERSION, 'keys': keys}

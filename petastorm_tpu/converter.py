@@ -92,8 +92,8 @@ def _to_arrow_table(df):
     except ImportError:  # pragma: no cover
         pass
     # pyspark DataFrame (optional dependency)
-    if hasattr(df, 'toPandas') and hasattr(df, 'sql_ctx') or \
-            type(df).__module__.startswith('pyspark.'):
+    if hasattr(df, 'toPandas') and (hasattr(df, 'sql_ctx') or
+                                    type(df).__module__.startswith('pyspark.')):
         return pa.Table.from_pandas(df.toPandas(), preserve_index=False)
     raise TypeError('make_converter expects a pandas DataFrame, pyarrow Table '
                     'or pyspark DataFrame; got {!r}'.format(type(df)))

@@ -107,23 +107,33 @@ class RetryingFilesystemWrapper(object):
     """
 
     #: Methods wrapped with retry; anything else delegates straight through.
+    #: Only idempotent operations: reads, listings, and whole-object
+    #: overwrites (put/get/copy/pipe_file re-write the same bytes). Mutations
+    #: whose success is not repeatable (rm, mv, mkdir, makedirs) are NOT
+    #: retried by default — a server-side success with a lost response would
+    #: turn the retry into FileNotFoundError/FileExistsError and report a
+    #: spurious hard failure; opt in via ``extra_retry_methods`` if the
+    #: backend's semantics make them safe.
     RETRY_METHODS = frozenset((
         'open', 'ls', 'exists', 'isdir', 'isfile', 'info', 'glob', 'walk',
-        'find', 'du', 'rm', 'mkdir', 'makedirs', 'put', 'get', 'mv', 'copy',
-        'cat_file', 'pipe_file', 'created', 'modified', 'size',
+        'find', 'du', 'put', 'get', 'copy', 'cat_file', 'pipe_file',
+        'created', 'modified', 'size',
     ))
 
     def __init__(self, fs, retries=2, retry_exceptions=(IOError, OSError),
-                 backoff_s=0.1, on_retry=None):
+                 backoff_s=0.1, on_retry=None, extra_retry_methods=()):
         """:param retries: extra attempts after the first failure (2 matches
             the reference's ``MAX_NAMENODES=2`` failover budget).
         :param on_retry: optional ``f(method_name, attempt, exception)`` hook
-            (used by tests to count failovers, and handy for metrics)."""
+            (used by tests to count failovers, and handy for metrics).
+        :param extra_retry_methods: additional method names to retry (e.g.
+            ``('rm',)`` when idempotent deletes are acceptable)."""
         self._fs = fs
         self._retries = int(retries)
         self._retry_exceptions = tuple(retry_exceptions)
         self._backoff_s = backoff_s
         self._on_retry = on_retry
+        self._retry_methods = self.RETRY_METHODS | frozenset(extra_retry_methods)
 
     @property
     def wrapped(self):
@@ -131,7 +141,7 @@ class RetryingFilesystemWrapper(object):
 
     def __getattr__(self, name):
         attr = getattr(self._fs, name)
-        if name not in self.RETRY_METHODS or not callable(attr):
+        if name not in self._retry_methods or not callable(attr):
             return attr
 
         def call_with_retry(*args, **kwargs):

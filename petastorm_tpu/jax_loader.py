@@ -409,6 +409,17 @@ class JaxLoader(object):
             self._namedtuple_cache[names] = nt
         return nt(**{k: item[k] for k in names})
 
+    def state_dict(self):
+        """Mid-epoch resume state (see ``Reader.state_dict``).
+
+        Capture at a batch boundary and rebuild via
+        ``make_reader(..., resume_state=state)`` + a new JaxLoader. Rows
+        sitting in the prefetch/shuffle buffers count as consumed: resume
+        never replays a delivered batch; buffered-but-undelivered rows return
+        next epoch instead of being duplicated.
+        """
+        return self._reader.state_dict()
+
     def stop(self):
         self._stop.set()
         self._exhausted = True

@@ -2,3 +2,5 @@
 
 from petastorm_tpu.parallel.mesh import (batch_sharding, make_mesh,  # noqa: F401
                                          process_shard)
+from petastorm_tpu.parallel.pod_guard import (PodAbortError,  # noqa: F401
+                                              PodSafeIterator, global_all)

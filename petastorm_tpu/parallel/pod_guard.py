@@ -1,0 +1,123 @@
+"""Pod-safe input iteration: fail/finish together or not at all.
+
+SURVEY §7 "hard parts": *one host's reader exception must not hang the other
+hosts mid-collective*. The reference has nothing here — its failure model is
+single-host (worker exceptions re-raise in the consumer, SURVEY §5.3); on a
+TPU pod that model deadlocks: if host 3's input pipeline dies while hosts
+0-2 enter the next step's collectives, the pod wedges until job timeout.
+
+The fix is a periodic consensus: hosts contribute "I have a batch" to a
+cross-process all-gather, and iteration ends on ALL hosts at the first
+checked step where ANY host cannot proceed (exception or end-of-data).
+Uneven shard tails get the same treatment, which also makes
+``last_batch='drop'`` safe across hosts with unequal row counts.
+
+Cost model: the consensus IS a blocking host-side collective (it must be —
+the decision changes host control flow, so it cannot be folded into the
+device step asynchronously). At ``consensus_interval=1`` every batch pays a
+DCN round-trip gated on the slowest host's fetch; raise the interval to
+amortize (checks every k-th step), trading up to k-1 steps of detection
+latency. A host's own failure still surfaces locally at the step it happens
+— the interval only delays when *peers* find out.
+"""
+
+import logging
+
+import numpy as np
+
+from petastorm_tpu.errors import PetastormTpuError
+
+logger = logging.getLogger(__name__)
+
+
+class PodAbortError(PetastormTpuError):
+    """Raised on every host when any host's input pipeline failed."""
+
+
+def global_all(local_ok, mesh=None):
+    """True iff every process reports ``local_ok`` — one bool all-reduce.
+
+    The consensus group is all JAX processes (a pod trains with all of them);
+    ``mesh`` is accepted for symmetry with the loader APIs but the reduction
+    always spans ``jax.process_count()``. Single-process is a no-op.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return bool(local_ok)
+    from jax.experimental import multihost_utils
+    flags = multihost_utils.process_allgather(np.array([bool(local_ok)]))
+    return bool(np.all(flags))
+
+
+class PodSafeIterator(object):
+    """Wraps a batch iterator with per-step pod consensus.
+
+    :param iterator: local host's batch source (e.g. a ``JaxLoader``).
+    :param mesh: the training ``Mesh`` (its devices define the consensus
+        group). ``None`` degrades to single-host behavior.
+    :param on_abort: ``'raise'`` (default) raises :class:`PodAbortError` on
+        every healthy host when a peer failed; ``'stop'`` ends iteration
+        quietly (treat a peer failure like end-of-data).
+    :param consensus_interval: check peer health every k-th step (k=1, the
+        default, checks every step; see the module docstring's cost model).
+        A locally-failing host always joins one final consensus round — and
+        round counts stay aligned, because that round is exactly the peers'
+        next scheduled one. **k>1 is only safe when the training step itself
+        has no cross-host collectives** (e.g. host-local eval or fully
+        replicated inference): with collectives in the step, peers run up to
+        k-1 steps the failed host can no longer participate in, and those
+        device collectives deadlock before the next scheduled check — the
+        very failure mode this wrapper exists to prevent. Keep k=1 for
+        pjit/shard_map training loops.
+    """
+
+    def __init__(self, iterator, mesh=None, on_abort='raise',
+                 consensus_interval=1):
+        if on_abort not in ('raise', 'stop'):
+            raise ValueError("on_abort must be 'raise' or 'stop'")
+        if consensus_interval < 1:
+            raise ValueError('consensus_interval must be >= 1')
+        self._it = iter(iterator)
+        self._mesh = mesh
+        self._on_abort = on_abort
+        self._interval = int(consensus_interval)
+        self._step = 0
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        batch, local_ok, local_exc = None, True, None
+        try:
+            batch = next(self._it)
+        except StopIteration:
+            local_ok = False
+        except Exception as e:  # noqa: BLE001 - any input failure joins consensus
+            local_ok = False
+            local_exc = e
+            logger.exception('Input pipeline failed on this host; '
+                             'propagating abort to the pod')
+        self._step += 1
+        if local_ok and self._step % self._interval:
+            return batch  # off-cycle healthy step: skip the collective
+        peers_ok = global_all(local_ok, self._mesh)
+        if local_ok and peers_ok:
+            return batch
+        # The consensus round informs peers; this host's own state decides
+        # its exit, so a degenerate consensus can never yield a None batch.
+        self._done = True
+        if local_exc is not None:
+            raise local_exc          # this host's own failure
+        if not local_ok:
+            raise StopIteration      # this host's clean end-of-data
+        # A peer stopped (cleanly or not) while we still had a batch —
+        # end here too, before the next collective can deadlock.
+        if self._on_abort == 'raise':
+            raise PodAbortError(
+                'A peer host ended input mid-epoch (failure or uneven '
+                'shard); aborting consistently on this host')
+        raise StopIteration

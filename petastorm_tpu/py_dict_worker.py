@@ -10,6 +10,7 @@ queue reader that buffers a chunk and pops single rows (``:64-97``).
 
 import hashlib
 
+from petastorm_tpu.checkpoint import chunk_key
 from petastorm_tpu.unischema import decode_rows
 from petastorm_tpu.workers.rowgroup_worker_base import (RowGroupWorkerBase,
                                                         compute_row_slice)
@@ -54,7 +55,12 @@ class PyDictWorker(RowGroupWorkerBase):
                          for offset, r in window.items()} for window in rows]
 
         if rows:
-            self.publish_func(rows)
+            # Envelope tags the chunk with its ventilation key so the consumer
+            # can track per-row-group consumption for checkpoint/resume
+            # (petastorm_tpu.checkpoint).
+            self.publish_func({'__pst_chunk__': 1,
+                               'key': chunk_key(piece_index, shuffle_row_drop_partition),
+                               'rows': rows})
 
     def _apply_transform(self, row, transform_spec):
         out = transform_spec.func(row)
@@ -144,6 +150,10 @@ class PyDictResultsQueueReader(object):
     def __init__(self):
         from collections import deque
         self._buffer = deque()
+        self._tracker = None
+
+    def set_tracker(self, tracker):
+        self._tracker = tracker
 
     @property
     def batched_output(self):
@@ -151,9 +161,18 @@ class PyDictResultsQueueReader(object):
 
     def read_next(self, pool, schema, ngram):
         while not self._buffer:
-            rows = pool.get_results()
-            self._buffer.extend(rows)
-        row = self._buffer.popleft()
+            chunk = pool.get_results()
+            if isinstance(chunk, dict) and chunk.get('__pst_chunk__'):
+                key, rows = chunk['key'], chunk['rows']
+            else:  # untagged payload (e.g. a custom worker)
+                key, rows = None, chunk
+            skip = 0
+            if self._tracker is not None and key is not None:
+                skip = self._tracker.on_chunk(key, len(rows))
+            self._buffer.extend((key, row) for row in rows[skip:])
+        key, row = self._buffer.popleft()
+        if self._tracker is not None and key is not None:
+            self._tracker.rows_yielded(key, 1)
         if ngram is not None:
             return {offset: ngram.get_schema_at_timestep(schema, offset).make_namedtuple(**fields)
                     for offset, fields in row.items()}

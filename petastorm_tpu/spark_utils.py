@@ -1,0 +1,47 @@
+"""Spark helpers (optional — pyspark is not part of the TPU-VM stack).
+
+Parity: reference ``petastorm/spark_utils.py:23-52`` (``dataset_as_rdd``:
+decoded dataset rows as an RDD of namedtuples). Import of pyspark is deferred
+so the module is importable everywhere; calling without pyspark raises a
+clear error.
+"""
+
+
+def dataset_as_rdd(dataset_url, spark_session, schema_fields=None,
+                   storage_options=None):
+    """An RDD of decoded namedtuple rows from a materialized dataset.
+
+    Each Spark partition opens its own single-threaded reader over one shard
+    of the row-groups — decode happens on the executors, like the reference's
+    per-executor piece reads.
+    """
+    try:
+        import pyspark  # noqa: F401
+    except ImportError:
+        raise ImportError('dataset_as_rdd requires pyspark; install it or use '
+                          'make_reader directly')
+
+    from petastorm_tpu.etl.dataset_metadata import get_schema_from_dataset_url
+    from petastorm_tpu.storage import ParquetStore
+
+    schema = get_schema_from_dataset_url(dataset_url, storage_options)
+    n_pieces = len(ParquetStore(dataset_url, storage_options).row_groups())
+    n_partitions = min(max(1, n_pieces), 64)
+
+    field_names = None
+    if schema_fields is not None:
+        field_names = [f if isinstance(f, str) else f.name for f in schema_fields]
+
+    def read_shard(shard):
+        from petastorm_tpu.reader import make_reader
+        with make_reader(dataset_url, schema_fields=field_names,
+                         reader_pool_type='dummy', shuffle_row_groups=False,
+                         cur_shard=shard, shard_count=n_partitions,
+                         storage_options=storage_options) as reader:
+            for row in reader:
+                yield row
+
+    sc = spark_session.sparkContext
+    _ = schema  # schema load validates the store before the job is launched
+    return sc.parallelize(range(n_partitions), n_partitions).flatMap(
+        lambda shard: read_shard(shard))

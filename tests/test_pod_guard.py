@@ -1,0 +1,81 @@
+"""Pod-safe iteration consensus tests (single-process semantics + mocked
+multi-process consensus — real pods can't be simulated here, so the
+process-count-dependent branch is exercised by patching global_all's inputs).
+"""
+
+import pytest
+
+from petastorm_tpu.parallel import PodAbortError, PodSafeIterator, global_all
+from petastorm_tpu.parallel import pod_guard
+
+
+def test_global_all_single_process():
+    assert global_all(True) is True
+    assert global_all(False) is False
+
+
+def test_pod_safe_passthrough():
+    it = PodSafeIterator(iter([1, 2, 3]))
+    assert list(it) == [1, 2, 3]
+
+
+def test_pod_safe_local_exception_propagates():
+    def gen():
+        yield 1
+        raise RuntimeError('decode failed')
+
+    it = PodSafeIterator(gen())
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match='decode failed'):
+        next(it)
+    with pytest.raises(StopIteration):
+        next(it)  # done latches
+
+
+def test_peer_failure_aborts_this_host(monkeypatch):
+    """Simulate a healthy host whose peer reports failure: consensus False
+    while the local iterator still has data."""
+    calls = []
+
+    def fake_global_all(local_ok, mesh=None):
+        calls.append(local_ok)
+        return len(calls) < 2  # second step: a peer went down
+
+    monkeypatch.setattr(pod_guard, 'global_all', fake_global_all)
+    it = PodSafeIterator(iter([10, 20, 30]))
+    assert next(it) == 10
+    with pytest.raises(PodAbortError, match='peer host'):
+        next(it)
+
+
+def test_peer_failure_stop_mode(monkeypatch):
+    monkeypatch.setattr(pod_guard, 'global_all',
+                        lambda ok, mesh=None: False)
+    it = PodSafeIterator(iter([10, 20]), on_abort='stop')
+    assert list(it) == []
+
+
+def test_invalid_on_abort():
+    with pytest.raises(ValueError):
+        PodSafeIterator(iter([]), on_abort='explode')
+
+
+def test_consensus_interval_amortizes_collectives(monkeypatch):
+    calls = []
+
+    def counting(ok, mesh=None):
+        calls.append(ok)
+        return True
+
+    monkeypatch.setattr(pod_guard, 'global_all', counting)
+    it = PodSafeIterator(iter(range(10)), consensus_interval=4)
+    assert list(it) == list(range(10))
+    # Steps 4 and 8 are scheduled checks; the end-of-data step always checks.
+    assert calls == [True, True, False]
+
+
+def test_exhausted_host_stops_even_if_consensus_degenerates(monkeypatch):
+    """local end-of-data must terminate regardless of the consensus value."""
+    monkeypatch.setattr(pod_guard, 'global_all', lambda ok, mesh=None: True)
+    it = PodSafeIterator(iter([1]))
+    assert list(it) == [1]  # must not loop or yield a None batch

@@ -1,0 +1,19 @@
+"""spark_utils gating: importable without pyspark, clear error when called."""
+
+import pytest
+
+
+def test_module_imports_without_pyspark():
+    import petastorm_tpu.spark_utils  # noqa: F401
+
+
+def test_dataset_as_rdd_requires_pyspark(synthetic_dataset):
+    pytest.importorskip('pytest')  # always true; keep parallel structure
+    try:
+        import pyspark  # noqa: F401
+        pytest.skip('pyspark installed; gating not exercised')
+    except ImportError:
+        pass
+    from petastorm_tpu.spark_utils import dataset_as_rdd
+    with pytest.raises(ImportError, match='pyspark'):
+        dataset_as_rdd(synthetic_dataset.url, spark_session=None)
