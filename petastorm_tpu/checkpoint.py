@@ -47,11 +47,17 @@ def chunk_key(piece_index, shuffle_row_drop_partition):
 class ConsumptionTracker(object):
     """Counts per-key consumption; computes resume-time skips.
 
-    Driven from the consumer thread only (inside ``Reader.__next__``) — no
-    locking needed.
+    Thread-safe: the consuming side may be a background thread (JaxLoader's
+    staging loop drives ``Reader.__next__``) while ``state_dict()`` is called
+    from the training thread mid-iteration, so every mutation and the
+    snapshot hold a lock — otherwise a checkpoint could capture ``done``
+    incremented but ``partial`` not yet reset and silently drop rows on
+    resume.
     """
 
     def __init__(self, resume_state=None, num_epochs=1):
+        import threading
+        self._lock = threading.Lock()
         self._done = {}      # key -> instances fully consumed (incl. prior sessions)
         self._partial = {}   # key -> rows consumed of the open instance
         self._totals = {}    # key -> rows per instance (observed)
@@ -99,36 +105,39 @@ class ConsumptionTracker(object):
         already counted in ``done``/``partial`` — they must NOT be counted
         again, or a resume-of-a-resume would over-skip.
         """
-        self._totals[key] = total_rows
-        if self._skip_instances.get(key, 0) > 0:
-            self._skip_instances[key] -= 1
-            return total_rows
-        skip = self._skip_rows.pop(key, 0)
-        if skip >= total_rows:
-            # The prior session consumed at least this whole instance (totals
-            # may have shrunk, e.g. config drift); be lenient and drop it all.
-            return total_rows
-        if skip:
-            self._partial[key] = skip
-        return skip
+        with self._lock:
+            self._totals[key] = total_rows
+            if self._skip_instances.get(key, 0) > 0:
+                self._skip_instances[key] -= 1
+                return total_rows
+            skip = self._skip_rows.pop(key, 0)
+            if skip >= total_rows:
+                # The prior session consumed at least this whole instance
+                # (totals may have shrunk, e.g. config drift); drop it all.
+                return total_rows
+            if skip:
+                self._partial[key] = skip
+            return skip
 
     def rows_yielded(self, key, n):
-        partial = self._partial.get(key, 0) + n
-        total = self._totals.get(key)
-        if total is not None and partial >= total:
-            self._done[key] = self._done.get(key, 0) + 1
-            partial = 0
-        self._partial[key] = partial
+        with self._lock:
+            partial = self._partial.get(key, 0) + n
+            total = self._totals.get(key)
+            if total is not None and partial >= total:
+                self._done[key] = self._done.get(key, 0) + 1
+                partial = 0
+            self._partial[key] = partial
 
     # -- persistence -------------------------------------------------------
 
     def state_dict(self):
-        keys = {}
-        for key in set(self._done) | set(self._partial) | set(self._totals):
-            partial = self._partial.get(key, 0)
-            # A still-pending partial skip is prior-session consumption not
-            # yet re-observed; carry it forward so the next resume honors it.
-            keys[key] = {'done': self._done.get(key, 0),
-                         'partial': partial or self._skip_rows.get(key, 0),
-                         'total': self._totals.get(key)}
-        return {'version': STATE_VERSION, 'keys': keys}
+        with self._lock:
+            keys = {}
+            for key in set(self._done) | set(self._partial) | set(self._totals):
+                partial = self._partial.get(key, 0)
+                # A still-pending partial skip is prior-session consumption
+                # not yet re-observed; carry it forward for the next resume.
+                keys[key] = {'done': self._done.get(key, 0),
+                             'partial': partial or self._skip_rows.get(key, 0),
+                             'total': self._totals.get(key)}
+            return {'version': STATE_VERSION, 'keys': keys}

@@ -273,10 +273,13 @@ class Reader(object):
         if resume_state is not None:
             stored_fp = resume_state.get('config')
             if stored_fp is not None and stored_fp != self._config_fingerprint:
+                diff_keys = sorted(
+                    k for k in set(stored_fp) | set(self._config_fingerprint)
+                    if stored_fp.get(k) != self._config_fingerprint.get(k))
                 warnings.warn(
                     'resume_state was captured under a different reader '
-                    'configuration ({} != {}); resume positions may be '
-                    'meaningless'.format(stored_fp, self._config_fingerprint))
+                    'configuration (differing: {}); resume positions may be '
+                    'meaningless'.format(diff_keys))
         self._tracker = ConsumptionTracker(resume_state, num_epochs=num_epochs)
         if hasattr(results_queue_reader, 'set_tracker'):
             results_queue_reader.set_tracker(self._tracker)

@@ -1,0 +1,19 @@
+"""Small shared utilities.
+
+Parity: reference ``petastorm/utils.py:30-47`` (``run_in_subprocess``). The
+reference's other utils live elsewhere here: ``decode_row`` ->
+``unischema.decode_rows``, ``add_to_dataset_metadata`` ->
+``storage.ParquetStore.write_common_metadata``.
+"""
+
+
+def run_in_subprocess(func, *args, **kwargs):
+    """Run ``func(*args, **kwargs)`` in a one-shot subprocess and return its
+    result — isolates memory leaks / library state from the calling process
+    (the reference uses it so pyarrow allocations don't accumulate in tests
+    and benchmarks).
+    """
+    from multiprocessing import Pool
+
+    with Pool(1) as pool:
+        return pool.apply(func, args, kwargs)

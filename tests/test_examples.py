@@ -36,3 +36,25 @@ def test_imagenet_generate_and_one_step(tmp_path):
     # Tiny config: 8-device mesh, 1 step, 32x32 crop
     state = train(url, global_batch=16, steps=1, image_size=32, log_every=1)
     assert state.step == 1
+
+
+def test_external_dataset_example(tmp_path, monkeypatch, capsys):
+    from examples.hello_world import external_dataset
+
+    monkeypatch.setenv('PETASTORM_TPU_CONVERTER_CACHE_DIR', str(tmp_path / 'cc'))
+    path = str(tmp_path / 'ext')
+    external_dataset.generate_external_dataset(path, rows=40)
+    external_dataset.python_hello_world('file://' + path)
+    external_dataset.converter_hello_world()
+    out = capsys.readouterr().out
+    assert 'read 40 rows' in out
+    assert 'jax batches' in out
+
+
+def test_run_in_subprocess():
+    import os
+
+    from petastorm_tpu.utils import run_in_subprocess
+
+    pid = run_in_subprocess(os.getpid)
+    assert pid != os.getpid()

@@ -8,7 +8,6 @@ def test_module_imports_without_pyspark():
 
 
 def test_dataset_as_rdd_requires_pyspark(synthetic_dataset):
-    pytest.importorskip('pytest')  # always true; keep parallel structure
     try:
         import pyspark  # noqa: F401
         pytest.skip('pyspark installed; gating not exercised')
