@@ -84,7 +84,7 @@ def _measure_jax_staging(url, workers):
     """Batches staged to the default JAX device (TPU when present)."""
     if not _jax_backend_responsive():
         print('jax backend unresponsive; skipping staging metric', file=sys.stderr)
-        return None
+        return None, None
     try:
         import jax
 
@@ -99,6 +99,7 @@ def _measure_jax_staging(url, workers):
                            shape_policies={'array_4d': PadTo((4, 128, 30, 3))}) as loader:
                 first = next(loader)          # warmup + compile-free staging
                 jax.block_until_ready(first.image1)
+                loader.reset_stats()          # stall metric = steady state only
                 start = time.perf_counter()
                 got = 0
                 for b in loader:
@@ -107,10 +108,11 @@ def _measure_jax_staging(url, workers):
                     if got >= n_batches:
                         break
                 elapsed = time.perf_counter() - start
-        return batch * got / elapsed
+                stall = loader.stats.get('input_stall_frac')
+        return batch * got / elapsed, stall
     except Exception as e:  # noqa: BLE001 - staging is a secondary metric
         print('jax staging measurement failed: {}'.format(e), file=sys.stderr)
-        return None
+        return None, None
 
 
 def main():
@@ -120,7 +122,7 @@ def main():
 
     url = _ensure_dataset()
     reader_rate = _measure_reader(url, workers)
-    staging_rate = _measure_jax_staging(url, workers)
+    staging_rate, stall_frac = _measure_jax_staging(url, workers)
 
     result = {
         'metric': 'hello_world_samples_per_sec',
@@ -130,6 +132,8 @@ def main():
     }
     if staging_rate is not None:
         result['jax_staged_samples_per_sec'] = round(staging_rate, 2)
+    if stall_frac is not None:
+        result['input_stall_frac'] = stall_frac
     print(json.dumps(result))
 
 

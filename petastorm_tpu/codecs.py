@@ -248,8 +248,9 @@ def _fast_npy_decode(encoded):
     arr = np.frombuffer(encoded, dtype=dtype, count=count, offset=data_start)
     arr = arr.reshape(shape, order='F' if fortran else 'C')
     # np.frombuffer views are read-only; training transforms expect writable
-    # rows, matching np.load-from-BytesIO behavior.
-    return arr.copy() if not arr.flags.writeable else arr
+    # rows, matching np.load-from-BytesIO behavior. order='K' keeps the
+    # stored F/C layout so the fast path is indistinguishable from np.load.
+    return arr.copy(order='K') if not arr.flags.writeable else arr
 
 
 @register_codec

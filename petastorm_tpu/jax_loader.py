@@ -21,6 +21,7 @@ SURVEY.md §7.6:
 import logging
 import queue
 import threading
+import time
 import warnings
 from collections import namedtuple
 
@@ -347,6 +348,10 @@ class JaxLoader(object):
         self._thread = threading.Thread(target=self._stage_loop, daemon=True)
         self._thread.start()
         self._namedtuple_cache = {}
+        # input-stall accounting (BASELINE.json targets <5% input stall)
+        self._batches_delivered = 0
+        self._wait_s = 0.0
+        self._first_get_t = None
 
     # -- staging thread --------------------------------------------------
 
@@ -395,7 +400,11 @@ class JaxLoader(object):
     def __next__(self):
         if self._exhausted:
             raise StopIteration
+        t0 = time.perf_counter()
+        if self._first_get_t is None:
+            self._first_get_t = t0
         item = self._queue.get()
+        self._wait_s += time.perf_counter() - t0
         if item is _END:
             self._exhausted = True
             raise StopIteration
@@ -407,7 +416,30 @@ class JaxLoader(object):
         if nt is None:
             nt = namedtuple('JaxBatch', names)
             self._namedtuple_cache[names] = nt
+        self._batches_delivered += 1
         return nt(**{k: item[k] for k in names})
+
+    def reset_stats(self):
+        """Zero the stall counters — call after warmup so ``stats`` reflects
+        the steady-state window, not reader-pool spin-up."""
+        self._batches_delivered = 0
+        self._wait_s = 0.0
+        self._first_get_t = None
+
+    @property
+    def stats(self):
+        """Input-pipeline health: delivered batches, seconds spent blocked
+        waiting for the staging thread, and the stall fraction (blocked time /
+        wall time since the first fetch). A training loop with
+        ``input_stall_frac`` above ~0.05 is input-bound (BASELINE.json's
+        <5% target) — raise ``workers_count``/``prefetch`` or speed up decode.
+        """
+        elapsed = (time.perf_counter() - self._first_get_t
+                   if self._first_get_t is not None else 0.0)
+        return {'batches': self._batches_delivered,
+                'wait_s': round(self._wait_s, 4),
+                'input_stall_frac': round(self._wait_s / elapsed, 4) if elapsed else 0.0,
+                'reader_diagnostics': self._reader.diagnostics}
 
     def state_dict(self):
         """Mid-epoch resume state (see ``Reader.state_dict``).

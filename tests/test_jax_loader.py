@@ -167,3 +167,19 @@ def test_jax_loader_sharded_compute(synthetic_dataset):
             out = mean_norm(batch.matrix)
     assert out.sharding == batch.matrix.sharding
     np.testing.assert_allclose(np.asarray(out).mean(), 0.0, atol=1e-5)
+
+
+def test_loader_stats_stall_metric(synthetic_dataset):
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    with make_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                     reader_pool_type='thread', workers_count=2) as reader:
+        with JaxLoader(reader, 10, last_batch='drop') as loader:
+            for _ in loader:
+                pass
+            stats = loader.stats
+    assert stats['batches'] > 0
+    assert stats['wait_s'] >= 0
+    assert 0.0 <= stats['input_stall_frac'] <= 1.0
+    assert 'reader_diagnostics' in stats

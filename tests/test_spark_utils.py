@@ -16,3 +16,34 @@ def test_dataset_as_rdd_requires_pyspark(synthetic_dataset):
     from petastorm_tpu.spark_utils import dataset_as_rdd
     with pytest.raises(ImportError, match='pyspark'):
         dataset_as_rdd(synthetic_dataset.url, spark_session=None)
+
+
+def test_spark_session_cli_args():
+    import argparse
+
+    from petastorm_tpu.tools.spark_session_cli import (
+        add_configure_spark_arguments, configure_spark)
+
+    parser = add_configure_spark_arguments(argparse.ArgumentParser())
+    args = parser.parse_args(['--master', 'local[2]',
+                              '--spark-session-config', 'a.b=1', 'c.d=x'])
+
+    class FakeBuilder(object):
+        def __init__(self):
+            self.calls = []
+
+        def master(self, m):
+            self.calls.append(('master', m))
+            return self
+
+        def config(self, k, v):
+            self.calls.append(('config', k, v))
+            return self
+
+    b = configure_spark(FakeBuilder(), args)
+    assert ('master', 'local[2]') in b.calls
+    assert ('config', 'a.b', '1') in b.calls and ('config', 'c.d', 'x') in b.calls
+
+    bad = parser.parse_args(['--spark-session-config', 'noequals'])
+    with pytest.raises(ValueError):
+        configure_spark(FakeBuilder(), bad)

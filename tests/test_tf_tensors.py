@@ -1,0 +1,94 @@
+"""Graph-mode ``tf_tensors`` feed tests (parity: reference
+``petastorm/tests/test_tf_utils.py`` graph-mode paths, 357 LoC)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip('tensorflow')
+
+from petastorm_tpu import make_batch_reader, make_reader  # noqa: E402
+from petastorm_tpu.tf_utils import tf_tensors  # noqa: E402
+
+
+def test_eager_mode_rejected(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy') as reader:
+        with pytest.raises(RuntimeError, match='make_petastorm_dataset'):
+            tf_tensors(reader)
+
+
+def test_graph_mode_reads_all_rows(synthetic_dataset):
+    expected = {r['id'] for r in synthetic_dataset.data}
+    with tf.Graph().as_default():
+        with make_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                         reader_pool_type='dummy',
+                         shuffle_row_groups=False) as reader:
+            sample = tf_tensors(reader)
+            assert sample.matrix.shape.as_list() == list(
+                reader.transformed_schema.fields['matrix'].shape)
+            seen = set()
+            with tf.compat.v1.Session() as sess:
+                for _ in range(len(expected)):
+                    row = sess.run(sample)
+                    seen.add(int(row.id))
+    assert seen == expected
+
+
+def test_graph_mode_shuffling_queue(synthetic_dataset):
+    with tf.Graph().as_default():
+        with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                         reader_pool_type='thread', workers_count=2,
+                         num_epochs=None, seed=0) as reader:
+            sample = tf_tensors(reader, shuffling_queue_capacity=30,
+                                min_after_dequeue=10)
+            with tf.compat.v1.Session() as sess:
+                coord = tf.train.Coordinator()
+                threads = tf.compat.v1.train.start_queue_runners(sess=sess,
+                                                                 coord=coord)
+                ids = [int(sess.run(sample).id) for _ in range(40)]
+                coord.request_stop()
+                coord.join(threads, stop_grace_period_secs=5,
+                           ignore_live_threads=True)
+    assert len(ids) == 40
+    assert ids != sorted(ids)  # decorrelated
+
+
+def test_batched_reader_shuffling_rejected(scalar_dataset):
+    with tf.Graph().as_default():
+        with make_batch_reader(scalar_dataset.url,
+                               reader_pool_type='dummy') as reader:
+            with pytest.raises(ValueError, match='batched'):
+                tf_tensors(reader, shuffling_queue_capacity=10)
+
+
+def test_batched_reader_graph_mode(scalar_dataset):
+    n = scalar_dataset.table.num_rows
+    with tf.Graph().as_default():
+        with make_batch_reader(scalar_dataset.url, schema_fields=['id'],
+                               reader_pool_type='dummy',
+                               shuffle_row_groups=False) as reader:
+            batch = tf_tensors(reader)
+            total = 0
+            with tf.compat.v1.Session() as sess:
+                while total < n:
+                    total += len(sess.run(batch).id)
+    assert total == n
+
+
+def test_ngram_graph_mode(synthetic_dataset):
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.unischema import UnischemaField
+
+    fields = {
+        0: ['^id$', '^matrix$'],
+        1: ['^id$'],
+    }
+    ngram = NGram(fields=fields, delta_threshold=10, timestamp_field='id')
+    with tf.Graph().as_default():
+        with make_reader(synthetic_dataset.url, schema_fields=ngram,
+                         reader_pool_type='dummy',
+                         shuffle_row_groups=False) as reader:
+            window = tf_tensors(reader)
+            assert set(window) == {0, 1}
+            with tf.compat.v1.Session() as sess:
+                w = sess.run(window)
+    assert int(w[1].id) == int(w[0].id) + 1
