@@ -1,30 +1,55 @@
 #!/usr/bin/env python
 """Throughput benchmark. Prints ONE JSON line.
 
-Workload parity: the reference's benchmark tutorial measures its hello_world
-dataset read rate (``docs/benchmarks_tutorial.rst:20-21`` -> 709.84
-samples/sec; harness ``petastorm/benchmark/throughput.py``). This bench
-recreates the same schema (id + 128x256x3 png image + 4-D uint8 ndarray,
-``examples/hello_world/petastorm_dataset/generate_petastorm_dataset.py:29-62``)
-and measures our reader's decoded-samples/sec through a thread pool, then the
-JAX device-staging path.
+Two workloads:
+
+1. **hello_world** — parity with the reference's benchmark tutorial
+   (``docs/benchmarks_tutorial.rst:20-21`` -> 709.84 samples/sec; harness
+   ``petastorm/benchmark/throughput.py``): same schema (id + 128x256x3 png +
+   4-D uint8 ndarray, ``examples/hello_world/.../generate_petastorm_dataset.py:29-62``),
+   measured as decoded-samples/sec through a thread pool.
+
+2. **imagenet (north star)** — BASELINE.json's target workload: 224x224 jpeg
+   ``CompressedImageCodec`` rows read via ``make_reader(process-shm)`` ->
+   ``JaxLoader`` -> a jitted ResNet-50 train step on the TPU, reporting
+   ``img/s/chip`` and ``input_stall_frac`` (target: >=2000 img/s/chip, <5%
+   stall).
+
+TPU-touching measurements run in *subprocess children* with timeouts: the
+axon tunnel can wedge (backend init hangs rather than errors) and must not
+take the benchmark down. A skipped metric is LOUD in the JSON (e.g.
+``"imagenet": "skipped: jax backend unresponsive"``), never silently absent.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
-_BASELINE_SAMPLES_PER_SEC = 709.84  # docs/benchmarks_tutorial.rst:20-21
+_BASELINE_SAMPLES_PER_SEC = 709.84   # reference docs/benchmarks_tutorial.rst:20-21
+_NORTH_STAR_IMG_PER_SEC = 2000.0     # BASELINE.json: >=2000 img/s/chip
 _DATASET_DIR = '/tmp/petastorm_tpu_bench_dataset'
+_IMAGENET_DIR = '/tmp/petastorm_tpu_bench_imagenet'
 _ROWS = 400
+_IMAGENET_ROWS = 1000
+_IMAGE_SIZE = 224
 _WARMUP_SAMPLES = 200
 _MEASURE_SAMPLES = 2000
 
 
-def _ensure_dataset():
+def _repo_on_path():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------------
+# dataset generation (CPU-only; runs in the parent so child timeouts cover
+# only JAX work)
+# --------------------------------------------------------------------------
+
+def _ensure_hello_dataset():
     from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
     from petastorm_tpu.etl.writer import write_dataset
     from petastorm_tpu.unischema import Unischema, UnischemaField
@@ -50,9 +75,48 @@ def _ensure_dataset():
     return 'file://' + _DATASET_DIR
 
 
+def _synthetic_image(rng, size):
+    """Natural-image-ish synthetic photo: low-frequency random field upsampled
+    plus mild noise — compresses/decodes like a photo, unlike white noise."""
+    low = rng.integers(0, 255, (size // 16, size // 16, 3), dtype=np.uint8)
+    img = np.kron(low, np.ones((16, 16, 1), dtype=np.uint8))
+    noise = rng.integers(0, 24, (size, size, 3), dtype=np.uint8)
+    return np.clip(img.astype(np.int16) + noise - 12, 0, 255).astype(np.uint8)
+
+
+def _ensure_imagenet_dataset():
+    from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    marker = os.path.join(_IMAGENET_DIR, '_common_metadata')
+    if os.path.exists(marker):
+        return 'file://' + _IMAGENET_DIR
+
+    # ImageNet-shaped: fixed 224x224 jpeg + integer label (reference
+    # examples/imagenet/schema.py role; fixed size so the bench isolates
+    # decode+stage+train, not resize policy).
+    schema = Unischema('ImagenetBenchSchema', [
+        UnischemaField('image', np.uint8, (_IMAGE_SIZE, _IMAGE_SIZE, 3),
+                       CompressedImageCodec('jpeg', 90), False),
+        UnischemaField('label', np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    rng = np.random.default_rng(7)
+
+    def rows():
+        for i in range(_IMAGENET_ROWS):
+            yield {'image': _synthetic_image(rng, _IMAGE_SIZE),
+                   'label': int(rng.integers(0, 1000))}
+
+    write_dataset('file://' + _IMAGENET_DIR, schema, rows(), rows_per_row_group=64)
+    return 'file://' + _IMAGENET_DIR
+
+
+# --------------------------------------------------------------------------
+# host-CPU reader throughput (the reference's benchmark quantity)
+# --------------------------------------------------------------------------
+
 def _measure_reader(url, workers):
-    """Decoded samples/sec through make_reader + thread pool (the reference's
-    benchmark quantity)."""
     from petastorm_tpu import make_reader
 
     with make_reader(url, reader_pool_type='thread', workers_count=workers,
@@ -66,63 +130,180 @@ def _measure_reader(url, workers):
     return _MEASURE_SAMPLES / elapsed
 
 
-def _jax_backend_responsive(timeout_s=180):
+# --------------------------------------------------------------------------
+# TPU children (each prints ONE json line; parent runs them with a timeout)
+# --------------------------------------------------------------------------
+
+def _child_staging(url, workers):
+    """hello_world batches staged to the default JAX device."""
+    import jax
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax_loader import JaxLoader, PadTo
+
+    batch = 32
+    n_batches = 40
+    with make_reader(url, reader_pool_type='thread', workers_count=workers,
+                     num_epochs=None, shuffle_row_groups=True, seed=0) as reader:
+        with JaxLoader(reader, batch,
+                       shape_policies={'array_4d': PadTo((4, 128, 30, 3))}) as loader:
+            first = next(loader)
+            jax.block_until_ready(first.image1)
+            loader.reset_stats()
+            start = time.perf_counter()
+            got = 0
+            for b in loader:
+                jax.block_until_ready(b.image1)
+                got += 1
+                if got >= n_batches:
+                    break
+            elapsed = time.perf_counter() - start
+            stall = loader.stats.get('input_stall_frac')
+    print(json.dumps({'jax_staged_samples_per_sec': round(batch * got / elapsed, 2),
+                      'hello_input_stall_frac': stall,
+                      'platform': jax.devices()[0].platform}))
+
+
+def _child_imagenet(url, workers):
+    """North star: jpeg Parquet -> process-shm pool -> JaxLoader -> jitted
+    ResNet-50 train step; img/s/chip + input_stall_frac."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax_loader import JaxLoader
+    from petastorm_tpu.models import resnet
+    from petastorm_tpu.models.train import create_train_state, make_train_step
+    from petastorm_tpu.parallel import make_mesh
+
+    # Env overrides exist so CI can smoke the full path on CPU with a tiny
+    # model; the real bench uses the defaults.
+    batch = int(os.environ.get('BENCH_IMAGENET_BATCH', '128'))
+    warmup_steps = 3
+    measure_steps = int(os.environ.get('BENCH_IMAGENET_STEPS', '30'))
+    model_cls = {'resnet50': resnet.ResNet50, 'resnet18': resnet.ResNet18,
+                 'tiny': resnet.ResNetTiny}[os.environ.get('BENCH_IMAGENET_MODEL', 'resnet50')]
+    n_devices = jax.device_count()
+    platform = jax.devices()[0].platform
+
+    # h2d bandwidth probe: one blocked device_put of a batch-sized buffer.
+    buf = np.ones((batch, _IMAGE_SIZE, _IMAGE_SIZE, 3), np.uint8)
+    jax.block_until_ready(jax.device_put(buf))  # warm the transfer path
+    t0 = time.perf_counter()
+    jax.block_until_ready(jax.device_put(buf))
+    h2d_gbps = buf.nbytes / (time.perf_counter() - t0) / 1e9
+
+    # Multi-device hosts get a data-parallel mesh over every chip so the
+    # per-chip division below is honest; batch scales to keep 128/chip.
+    mesh = make_mesh({'data': n_devices}) if n_devices > 1 else None
+    batch = batch * n_devices
+
+    model = model_cls(num_classes=1000)
+    state = create_train_state(jax.random.PRNGKey(0), model,
+                               (1, _IMAGE_SIZE, _IMAGE_SIZE, 3),
+                               mesh=mesh, learning_rate=0.1)
+    inner_step = make_train_step(mesh=mesh)
+
+    # Normalize inside jit so the uint8->float cast fuses into the first conv
+    # (transfers ride h2d as uint8: 4x less PCIe/ICI traffic than float32).
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state, images_u8, labels):
+        return inner_step(state, images_u8.astype(jnp.float32) / 255.0, labels)
+
+    pool = 'process-shm'
+    try:
+        reader = make_reader(url, schema_fields=['image', 'label'],
+                             reader_pool_type=pool, workers_count=workers,
+                             num_epochs=None, shuffle_row_groups=True, seed=0)
+    except RuntimeError:
+        pool = 'thread'
+        reader = make_reader(url, schema_fields=['image', 'label'],
+                             reader_pool_type=pool, workers_count=workers,
+                             num_epochs=None, shuffle_row_groups=True, seed=0)
+
+    with reader:
+        with JaxLoader(reader, batch, mesh=mesh, prefetch=3) as loader:
+            it = iter(loader)
+            for _ in range(warmup_steps):
+                b = next(it)
+                state, metrics = train_step(state, b.image, b.label)
+            jax.block_until_ready(metrics['loss'])
+            loader.reset_stats()
+            start = time.perf_counter()
+            for _ in range(measure_steps):
+                b = next(it)
+                state, metrics = train_step(state, b.image, b.label)
+            jax.block_until_ready(metrics['loss'])
+            elapsed = time.perf_counter() - start
+            stats = loader.stats
+    rate = batch * measure_steps / elapsed
+    staged_gb = stats['staged_bytes'] / 1e9
+    print(json.dumps({
+        'imagenet_img_per_sec_per_chip': round(rate / n_devices, 2),
+        'input_stall_frac': stats['input_stall_frac'],
+        'step_time_ms': round(1000 * elapsed / measure_steps, 2),
+        'n_devices': n_devices,
+        'platform': platform,
+        'reader_pool': pool,
+        'stage_dispatch_s': stats['stage_dispatch_s'],
+        'staged_GB': round(staged_gb, 3),
+        'h2d_GBps': round(h2d_gbps, 2),
+        'final_loss': round(float(metrics['loss']), 4),
+    }))
+
+
+def _run_child(name, args, timeout_s):
+    """Run ``bench.py --_child <name> ...`` and parse its JSON line. Returns
+    (dict, None) on success, (None, loud-reason-string) on failure."""
+    cmd = [sys.executable, os.path.abspath(__file__), '--_child', name] + list(args)
+    try:
+        proc = subprocess.run(cmd, timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return None, 'skipped: timed out after {}s (jax backend likely wedged)'.format(timeout_s)
+    if proc.returncode != 0:
+        tail = (proc.stderr or '').strip().splitlines()[-3:]
+        return None, 'skipped: child failed rc={}: {}'.format(proc.returncode, ' | '.join(tail))
+    for line in reversed((proc.stdout or '').strip().splitlines()):
+        line = line.strip()
+        if line.startswith('{'):
+            try:
+                return json.loads(line), None
+            except ValueError:
+                continue
+    return None, 'skipped: child produced no JSON'
+
+
+def _jax_backend_responsive(timeout_s):
     """Probe JAX backend init in a subprocess — a wedged TPU tunnel hangs
     rather than erroring, and must not take the whole benchmark down."""
-    import subprocess
     try:
         proc = subprocess.run(
-            [sys.executable, '-c',
-             'import jax; jax.devices(); print("ok")'],
+            [sys.executable, '-c', 'import jax; jax.devices(); print("ok")'],
             timeout=timeout_s, capture_output=True)
         return proc.returncode == 0
     except subprocess.TimeoutExpired:
         return False
 
 
-def _measure_jax_staging(url, workers):
-    """Batches staged to the default JAX device (TPU when present)."""
-    if not _jax_backend_responsive():
-        print('jax backend unresponsive; skipping staging metric', file=sys.stderr)
-        return None, None
-    try:
-        import jax
-
-        from petastorm_tpu import make_reader
-        from petastorm_tpu.jax_loader import JaxLoader, PadTo
-
-        batch = 32
-        n_batches = 40
-        with make_reader(url, reader_pool_type='thread', workers_count=workers,
-                         num_epochs=None, shuffle_row_groups=True, seed=0) as reader:
-            with JaxLoader(reader, batch,
-                           shape_policies={'array_4d': PadTo((4, 128, 30, 3))}) as loader:
-                first = next(loader)          # warmup + compile-free staging
-                jax.block_until_ready(first.image1)
-                loader.reset_stats()          # stall metric = steady state only
-                start = time.perf_counter()
-                got = 0
-                for b in loader:
-                    jax.block_until_ready(b.image1)
-                    got += 1
-                    if got >= n_batches:
-                        break
-                elapsed = time.perf_counter() - start
-                stall = loader.stats.get('input_stall_frac')
-        return batch * got / elapsed, stall
-    except Exception as e:  # noqa: BLE001 - staging is a secondary metric
-        print('jax staging measurement failed: {}'.format(e), file=sys.stderr)
-        return None, None
-
-
 def main():
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    _repo_on_path()
     import psutil
     workers = min(10, (psutil.cpu_count(logical=True) or 4))
 
-    url = _ensure_dataset()
-    reader_rate = _measure_reader(url, workers)
-    staging_rate, stall_frac = _measure_jax_staging(url, workers)
+    if len(sys.argv) >= 3 and sys.argv[1] == '--_child':
+        name = sys.argv[2]
+        if name == 'staging':
+            _child_staging(sys.argv[3], int(sys.argv[4]))
+        elif name == 'imagenet':
+            _child_imagenet(sys.argv[3], int(sys.argv[4]))
+        else:
+            raise SystemExit('unknown child {!r}'.format(name))
+        return
+
+    hello_url = _ensure_hello_dataset()
+    reader_rate = _measure_reader(hello_url, workers)
 
     result = {
         'metric': 'hello_world_samples_per_sec',
@@ -130,10 +311,38 @@ def main():
         'unit': 'samples/s',
         'vs_baseline': round(reader_rate / _BASELINE_SAMPLES_PER_SEC, 3),
     }
-    if staging_rate is not None:
-        result['jax_staged_samples_per_sec'] = round(staging_rate, 2)
-    if stall_frac is not None:
-        result['input_stall_frac'] = stall_frac
+
+    # Probe before launching TPU children (retry once, generously: a live
+    # tunnel can still take minutes to first-connect).
+    responsive = _jax_backend_responsive(180) or _jax_backend_responsive(500)
+    if not responsive:
+        result['imagenet'] = 'skipped: jax backend unresponsive after 180s+500s probes'
+        result['jax_staging'] = 'skipped: jax backend unresponsive after 180s+500s probes'
+        print(json.dumps(result))
+        return
+
+    imagenet_url = _ensure_imagenet_dataset()
+
+    staging, err = _run_child('staging', [hello_url, str(workers)], timeout_s=600)
+    if staging:
+        result.update(staging)
+    else:
+        result['jax_staging'] = err
+
+    inet, err = _run_child('imagenet', [imagenet_url, str(workers)], timeout_s=1800)
+    if inet:
+        result.update(inet)
+        # The north star becomes the headline metric once measured.
+        result['metric'] = 'imagenet_resnet50_img_per_sec_per_chip'
+        result['value'] = inet['imagenet_img_per_sec_per_chip']
+        result['unit'] = 'img/s/chip'
+        result['vs_baseline'] = round(
+            inet['imagenet_img_per_sec_per_chip'] / _NORTH_STAR_IMG_PER_SEC, 3)
+        result['hello_world_samples_per_sec'] = round(reader_rate, 2)
+        result['hello_world_vs_reference'] = round(reader_rate / _BASELINE_SAMPLES_PER_SEC, 3)
+    else:
+        result['imagenet'] = err
+
     print(json.dumps(result))
 
 

@@ -183,3 +183,50 @@ def test_loader_stats_stall_metric(synthetic_dataset):
     assert stats['wait_s'] >= 0
     assert 0.0 <= stats['input_stall_frac'] <= 1.0
     assert 'reader_diagnostics' in stats
+
+
+# --- strict_fields (VERDICT r1 weak #6) -----------------------------------
+
+@pytest.fixture(scope='module')
+def never_null_dataset(tmp_path_factory):
+    """A field *declared* nullable whose values are never actually null —
+    the case where silent warn-and-drop surprises users."""
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('NeverNull', [
+        UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField('maybe', np.int32, (), ScalarCodec(np.int32), True),
+    ])
+    path = tmp_path_factory.mktemp('never_null') / 'dataset'
+    url = 'file://' + str(path)
+    write_dataset(url, schema, [{'id': i, 'maybe': i * 2} for i in range(20)],
+                  rows_per_row_group=5)
+    return url
+
+
+def test_nullable_declared_never_null_dropped_by_default(never_null_dataset):
+    with _row_reader(never_null_dataset) as reader:
+        with pytest.warns(UserWarning, match='maybe'):
+            b = next(iter(iter_numpy_batches(reader, 4)))
+    assert 'maybe' not in b
+
+
+def test_strict_fields_raises_on_undeliverable_field(never_null_dataset):
+    with _row_reader(never_null_dataset) as reader:
+        with pytest.raises(ValueError, match="maybe.*strict_fields"):
+            list(iter_numpy_batches(reader, 4, strict_fields=True))
+
+
+def test_strict_fields_ok_when_all_batchable(never_null_dataset):
+    with _row_reader(never_null_dataset, schema_fields=['id']) as reader:
+        batches = list(iter_numpy_batches(reader, 4, strict_fields=True))
+    assert all(b['id'].shape == (4,) for b in batches)
+
+
+def test_jax_loader_strict_fields_propagates(never_null_dataset):
+    with _row_reader(never_null_dataset) as reader:
+        with pytest.raises(ValueError, match='strict_fields'):
+            with JaxLoader(reader, 4, strict_fields=True) as loader:
+                next(loader)
