@@ -467,11 +467,13 @@ class JaxLoader(object):
         """
         elapsed = (time.perf_counter() - self._first_get_t
                    if self._first_get_t is not None else 0.0)
+        with self._stats_lock:
+            stage_s, staged_bytes = self._stage_s, self._staged_bytes
         return {'batches': self._batches_delivered,
                 'wait_s': round(self._wait_s, 4),
                 'input_stall_frac': round(self._wait_s / elapsed, 4) if elapsed else 0.0,
-                'stage_dispatch_s': round(self._stage_s, 4),
-                'staged_bytes': self._staged_bytes,
+                'stage_dispatch_s': round(stage_s, 4),
+                'staged_bytes': staged_bytes,
                 'reader_diagnostics': self._reader.diagnostics}
 
     def state_dict(self):

@@ -19,6 +19,7 @@ import pickle
 import threading
 import time
 
+import dill
 import zmq
 
 from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
@@ -106,7 +107,9 @@ class ProcessPool(object):
     def ventilate(self, *args, **kwargs):
         with self._count_lock:
             self._ventilated_unprocessed += 1
-        self._ventilator_send.send_pyobj((args, kwargs))
+        # dill, not pickle: ventilated items may close over lambdas
+        # (predicates/transforms), same as worker_args in exec_in_new_process.
+        self._ventilator_send.send(dill.dumps((args, kwargs)))
 
     def get_results(self, timeout=_DEFAULT_TIMEOUT_S):
         deadline = time.monotonic() + timeout if timeout is not None else None
@@ -226,7 +229,7 @@ def _worker_bootstrap(worker_class, worker_id, worker_args,
                 if control_receiver.recv() == _CONTROL_FINISHED:
                     break
             if socks.get(work_receiver) == zmq.POLLIN:
-                args, kwargs = work_receiver.recv_pyobj()
+                args, kwargs = dill.loads(work_receiver.recv())
                 try:
                     worker.process(*args, **kwargs)
                     results_sender.send_multipart([

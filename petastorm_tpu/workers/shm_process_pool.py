@@ -26,6 +26,8 @@ import threading
 import time
 import uuid
 
+import dill
+
 from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
                                    VentilatedItemProcessedMessage)
 from petastorm_tpu.workers.exec_in_new_process import exec_in_new_process
@@ -136,7 +138,8 @@ class ShmProcessPool(object):
         # Round-robin dispatch (zmq PUSH does the same across peers).
         ring = self._work_rings[self._next_worker % self._workers_count]
         self._next_worker += 1
-        ring.write(pickle.dumps((args, kwargs)), timeout_ms=-1)
+        # dill: work items may close over lambdas (predicates/transforms)
+        ring.write(dill.dumps((args, kwargs)), timeout_ms=-1)
 
     def _poll_once(self, timeout_ms):
         """One sweep over all result rings; returns (tag, payload) or None.
@@ -290,7 +293,7 @@ def _shm_worker_bootstrap(worker_class, worker_id, worker_args, base,
                 break
             if item is None:
                 continue
-            args, kwargs = pickle.loads(item)
+            args, kwargs = dill.loads(item)
             try:
                 worker.process(*args, **kwargs)
                 send_control(VentilatedItemProcessedMessage())

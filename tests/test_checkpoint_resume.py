@@ -109,13 +109,14 @@ def test_batch_reader_resume(scalar_dataset):
     assert sorted(seen) == all_ids
 
 
-def test_process_pool_resume(synthetic_dataset):
+@pytest.mark.parametrize('pool', ['process-zmq', 'process-shm'])
+def test_process_pool_resume(synthetic_dataset, pool):
     all_ids = sorted(r['id'] for r in synthetic_dataset.data)
-    with make_reader(synthetic_dataset.url, reader_pool_type='process',
+    with make_reader(synthetic_dataset.url, reader_pool_type=pool,
                      workers_count=2, shuffle_row_groups=False) as reader:
         first = _collect_ids(reader, 25)
         state = reader.state_dict()
-    with make_reader(synthetic_dataset.url, reader_pool_type='process',
+    with make_reader(synthetic_dataset.url, reader_pool_type=pool,
                      workers_count=2, shuffle_row_groups=False,
                      resume_state=state) as reader:
         rest = [row.id for row in reader]

@@ -71,7 +71,7 @@ def test_invalid_constructions():
         NGram({0: 'not_a_list'}, 1, 'ts')
 
 
-@pytest.mark.parametrize('pool', ['dummy', 'thread'])
+@pytest.mark.parametrize('pool', ['dummy', 'thread', 'process-zmq', 'process-shm'])
 def test_ngram_end_to_end(timeseries_dataset, pool):
     fields = {0: [TimeseriesSchema.timestamp, TimeseriesSchema.sensor],
               1: [TimeseriesSchema.timestamp, TimeseriesSchema.sensor,
