@@ -100,7 +100,13 @@ def _to_arrow_table(df):
 
 
 def _fingerprint(table):
-    """sha1 over the Arrow IPC stream: schema + data content."""
+    """sha1 over the Arrow IPC stream: schema + data content.
+
+    Chunk-layout independent with bounded memory: fixed 64Ki-row windows are
+    sliced and combined one at a time, so content-identical tables that arrive
+    with different record-batch boundaries hash identically while peak extra
+    memory stays one window (not a contiguous copy of the table).
+    """
     import pyarrow as pa
 
     class _HashSink(object):
@@ -122,9 +128,11 @@ def _fingerprint(table):
             return False
 
     sink = _HashSink()
+    window = 1 << 16
     with pa.ipc.new_stream(pa.PythonFile(sink, mode='w'), table.schema) as writer:
-        for batch in table.to_batches(max_chunksize=1 << 16):
-            writer.write_batch(batch)
+        for offset in range(0, table.num_rows, window):
+            for batch in table.slice(offset, window).combine_chunks().to_batches():
+                writer.write_batch(batch)
     return sink.digest.hexdigest()
 
 

@@ -8,8 +8,9 @@ Parity: reference ``petastorm/fs_utils.py`` (``FilesystemResolver``,
 
 TPU-first differences: everything routes through **fsspec** (the TPU-VM-native
 IO stack, GCS-first) instead of pyarrow legacy filesystems + libhdfs. The
-reference's HA-namenode failover machinery (``hdfs/namenode.py``) is subsumed
-by fsspec's hdfs/webhdfs drivers; retry-on-error wrapping lives in
+reference's HA-namenode failover machinery (``hdfs/namenode.py``) lives in
+:mod:`petastorm_tpu.hdfs` (nameservice resolution + namenode-alternating
+proxy); same-connection retry-on-error wrapping lives in
 :class:`RetryingFilesystemWrapper` below.
 """
 
@@ -48,8 +49,13 @@ class FilesystemResolver(object):
         self._scheme = parsed.scheme
         if self._scheme == 'gcs':
             self._scheme = 'gs'
+        self._netloc = parsed.netloc
         if self._scheme == 'file':
             self._path = parsed.path
+        elif self._scheme in ('hdfs', 'webhdfs'):
+            # netloc is the nameservice/namenode, not part of the in-fs path;
+            # connection routes through petastorm_tpu.hdfs (HA failover).
+            self._path = parsed.path or '/'
         else:
             # bucket/host lives in the path for object stores (reference quirk
             # handled at fs_utils.py:155-166)
@@ -66,7 +72,8 @@ class FilesystemResolver(object):
 
     def filesystem(self):
         if self._fs is None:
-            self._fs = fsspec.filesystem(self._scheme, **self._storage_options)
+            self._fs = _build_filesystem(self._scheme, self._storage_options,
+                                         self._netloc)
         return self._fs
 
     def get_dataset_path(self):
@@ -75,7 +82,8 @@ class FilesystemResolver(object):
     def filesystem_factory(self):
         """A picklable zero-arg callable recreating the filesystem on a remote
         worker process (parity: ``fs_utils.py:174-180``)."""
-        return _FilesystemFactory(self._scheme, dict(self._storage_options))
+        return _FilesystemFactory(self._scheme, dict(self._storage_options),
+                                  self._netloc)
 
     def __getstate__(self):
         # Parity with the reference's explicit no-pickling rule
@@ -83,15 +91,31 @@ class FilesystemResolver(object):
         raise RuntimeError('FilesystemResolver cannot be pickled; use filesystem_factory()')
 
 
+def _build_filesystem(scheme, options, netloc=''):
+    if scheme == 'hdfs':
+        # Routes through the HA layer: a nameservice netloc gets namenode
+        # failover, a concrete host:port connects directly.
+        from petastorm_tpu.hdfs import connect_for_netloc
+        return connect_for_netloc(netloc, options)
+    if scheme == 'webhdfs' and netloc:
+        host, _, port = netloc.partition(':')
+        options = dict(options)
+        options.setdefault('host', host)
+        if port:
+            options.setdefault('port', int(port))
+    return fsspec.filesystem(scheme, **options)
+
+
 class _FilesystemFactory(object):
     """Module-level (stdlib-picklable) zero-arg filesystem constructor."""
 
-    def __init__(self, scheme, options):
+    def __init__(self, scheme, options, netloc=''):
         self._scheme = scheme
         self._options = options
+        self._netloc = netloc
 
     def __call__(self):
-        return fsspec.filesystem(self._scheme, **self._options)
+        return _build_filesystem(self._scheme, self._options, self._netloc)
 
 
 class RetryingFilesystemWrapper(object):

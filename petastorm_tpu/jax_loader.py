@@ -482,8 +482,12 @@ class JaxLoader(object):
         Capture at a batch boundary and rebuild via
         ``make_reader(..., resume_state=state)`` + a new JaxLoader. Rows
         sitting in the prefetch/shuffle buffers count as consumed: resume
-        never replays a delivered batch; buffered-but-undelivered rows return
-        next epoch instead of being duplicated.
+        never replays a delivered batch. With ``num_epochs=None`` (the
+        training default) buffered-but-undelivered rows come around again on
+        a later epoch; with a *finite* epoch count they are lost to the
+        resumed run — exactly-once holds, at-least-once does not. Checkpoint
+        between epochs (or drain the loader) if finite-epoch completeness
+        matters.
         """
         return self._reader.state_dict()
 

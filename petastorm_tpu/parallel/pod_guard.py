@@ -34,6 +34,10 @@ class PodAbortError(PetastormTpuError):
     """Raised on every host when any host's input pipeline failed."""
 
 
+#: mesh ids whose sub-mesh coverage warning already fired (warn once per mesh).
+_submesh_warned = set()
+
+
 def global_all(local_ok, mesh=None):
     """True iff every process reports ``local_ok`` — one bool all-reduce.
 
@@ -45,6 +49,15 @@ def global_all(local_ok, mesh=None):
 
     if jax.process_count() == 1:
         return bool(local_ok)
+    if mesh is not None and id(mesh) not in _submesh_warned:
+        # Once per mesh object: this runs on the per-step consensus path.
+        _submesh_warned.add(id(mesh))
+        mesh_procs = {d.process_index for d in np.asarray(mesh.devices).flat}
+        if len(mesh_procs) < jax.process_count():
+            logger.warning(
+                'global_all: mesh spans %d of %d processes, but consensus '
+                'always covers ALL processes — a sub-mesh does not scope it',
+                len(mesh_procs), jax.process_count())
     from jax.experimental import multihost_utils
     flags = multihost_utils.process_allgather(np.array([bool(local_ok)]))
     return bool(np.all(flags))
@@ -70,14 +83,29 @@ class PodSafeIterator(object):
         device collectives deadlock before the next scheduled check — the
         very failure mode this wrapper exists to prevent. Keep k=1 for
         pjit/shard_map training loops.
+    :param step_has_collectives: declare whether the *training step* contains
+        cross-host collectives (pjit/shard_map programs over a multi-host
+        mesh do). Defaults to True; combined with ``consensus_interval > 1``
+        that configuration is the documented deadlock, so construction
+        raises — pass ``step_has_collectives=False`` explicitly for
+        collective-free steps to amortize the consensus.
     """
 
     def __init__(self, iterator, mesh=None, on_abort='raise',
-                 consensus_interval=1):
+                 consensus_interval=1, step_has_collectives=True):
         if on_abort not in ('raise', 'stop'):
             raise ValueError("on_abort must be 'raise' or 'stop'")
         if consensus_interval < 1:
             raise ValueError('consensus_interval must be >= 1')
+        if consensus_interval > 1 and step_has_collectives:
+            raise ValueError(
+                'consensus_interval={} with step_has_collectives=True: peers '
+                'would run up to {} steps whose device collectives a failed '
+                'host can no longer join — that deadlocks the pod. Keep '
+                'consensus_interval=1 for pjit/shard_map training loops, or '
+                'pass step_has_collectives=False if the step really has no '
+                'cross-host collectives.'.format(consensus_interval,
+                                                 consensus_interval - 1))
         self._it = iter(iterator)
         self._mesh = mesh
         self._on_abort = on_abort

@@ -395,9 +395,14 @@ class Reader(object):
 
         Pass the returned dict as ``resume_state=`` to a new
         ``make_reader``/``make_batch_reader`` call with the **same
-        configuration** to continue where this reader stopped: every row is
-        delivered exactly once per epoch across the two sessions (order may
-        differ — worker interleaving is not part of the contract). See
+        configuration** to continue where this reader stopped: no row is
+        delivered twice within an epoch across the two sessions (order may
+        differ — worker interleaving is not part of the contract). The
+        batched (Arrow) path counts a whole chunk as consumed when it leaves
+        the reader, so rows still buffered downstream (e.g. in a JaxLoader
+        prefetch/shuffle queue) at checkpoint time are treated as consumed:
+        with ``num_epochs=None`` they simply recur on a later epoch, but with
+        a finite epoch count they will not be re-delivered after resume. See
         ``petastorm_tpu/checkpoint.py`` for the full semantics.
         """
         state = self._tracker.state_dict()

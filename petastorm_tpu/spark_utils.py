@@ -8,8 +8,13 @@ clear error.
 
 
 def dataset_as_rdd(dataset_url, spark_session, schema_fields=None,
-                   storage_options=None):
+                   storage_options=None, max_partitions=64):
     """An RDD of decoded namedtuple rows from a materialized dataset.
+
+    :param max_partitions: cap on Spark partitions (default 64; pass ``None``
+        for one partition per row-group). When the cap truncates, each task
+        reads ``n_pieces / max_partitions`` row-groups single-threaded — a
+        log line records the truncation.
 
     Each Spark partition opens its own single-threaded reader over one shard
     of the row-groups — decode happens on the executors, like the reference's
@@ -21,12 +26,23 @@ def dataset_as_rdd(dataset_url, spark_session, schema_fields=None,
         raise ImportError('dataset_as_rdd requires pyspark; install it or use '
                           'make_reader directly')
 
+    if max_partitions is not None and max_partitions < 1:
+        raise ValueError('max_partitions must be >= 1 or None, got {!r}'
+                         .format(max_partitions))
+
     from petastorm_tpu.etl.dataset_metadata import get_schema_from_dataset_url
     from petastorm_tpu.storage import ParquetStore
 
     schema = get_schema_from_dataset_url(dataset_url, storage_options)
     n_pieces = len(ParquetStore(dataset_url, storage_options).row_groups())
-    n_partitions = min(max(1, n_pieces), 64)
+    n_partitions = max(1, n_pieces)
+    if max_partitions is not None and n_partitions > max_partitions:
+        import logging
+        logging.getLogger(__name__).info(
+            'dataset_as_rdd: capping %d row-groups to %d partitions '
+            '(~%d row-groups per task); raise max_partitions to spread wider',
+            n_pieces, max_partitions, -(-n_pieces // max_partitions))
+        n_partitions = max_partitions
 
     field_names = None
     if schema_fields is not None:

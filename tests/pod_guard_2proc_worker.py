@@ -1,0 +1,50 @@
+"""Helper for the real 2-process pod-consensus test (run via subprocess).
+
+``python pod_guard_2proc_worker.py <coordinator> <process_id> <mode> <out>``
+joins a 2-process jax.distributed CPU cluster and iterates a
+``PodSafeIterator``. Modes:
+
+* ``fail``   — process 1's input raises after 2 batches; process 0 has many.
+* ``uneven`` — process 1 has 3 batches, process 0 has 6, ``on_abort='stop'``.
+
+Writes ``<outcome> <batches_delivered>`` to <out>.
+"""
+
+import sys
+
+
+def main(coordinator, process_id, mode, out_path):
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=2, process_id=process_id)
+    assert jax.process_count() == 2
+
+    from petastorm_tpu.parallel.pod_guard import PodAbortError, PodSafeIterator
+
+    def batches():
+        if mode == 'fail':
+            for i in range(50):
+                if process_id == 1 and i == 2:
+                    raise RuntimeError('simulated input failure')
+                yield i
+        else:  # uneven shard tails
+            for i in range(3 if process_id == 1 else 6):
+                yield i
+
+    on_abort = 'stop' if mode == 'uneven' else 'raise'
+    delivered = 0
+    outcome = 'completed'
+    try:
+        for _ in PodSafeIterator(batches(), on_abort=on_abort):
+            delivered += 1
+    except PodAbortError:
+        outcome = 'pod_abort'
+    except RuntimeError as e:
+        outcome = 'local_error:{}'.format(e)
+    with open(out_path, 'w') as f:
+        f.write('{} {}'.format(outcome, delivered))
+
+
+if __name__ == '__main__':
+    main(sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4])

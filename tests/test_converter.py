@@ -126,3 +126,19 @@ def test_row_group_size_mb(tmp_path):
     files = [f for f in os.listdir(local) if f.endswith('.parquet')]
     pf = pq.ParquetFile(os.path.join(local, files[0]))
     assert pf.num_row_groups == 10
+
+
+def test_fingerprint_chunk_layout_independent():
+    """Content-identical tables with different chunkings must dedupe
+    (ADVICE r1: chunk boundaries used to leak into the hash)."""
+    import pyarrow as pa
+
+    from petastorm_tpu.converter import _fingerprint
+
+    data = list(range(1000))
+    one_chunk = pa.table({'x': pa.array(data)})
+    many_chunks = pa.table(
+        {'x': pa.chunked_array([data[:100], data[100:400], data[400:]])})
+    assert _fingerprint(one_chunk) == _fingerprint(many_chunks)
+    different = pa.table({'x': pa.array(data[:-1] + [9999])})
+    assert _fingerprint(one_chunk) != _fingerprint(different)

@@ -1,0 +1,201 @@
+"""HDFS HA namenode failover tests (mock-driven, no hdfs needed).
+
+Parity: reference ``petastorm/hdfs/tests/test_hdfs_namenode.py:250-451``
+(failover counts, round-robin alternation, max-failover error, pickling) and
+``:60-170`` (nameservice resolution from hadoop site XML).
+"""
+
+import os
+import pickle
+
+import pytest
+
+from petastorm_tpu.hdfs import (HdfsConnectError, HdfsNamenodeResolver,
+                                HANamenodeFilesystem, MaxFailoversExceeded,
+                                connect_ha_hdfs)
+
+HDFS_SITE = """<?xml version="1.0"?>
+<configuration>
+  <property><name>dfs.ha.namenodes.ns1</name><value>nn1,nn2</value></property>
+  <property><name>dfs.namenode.rpc-address.ns1.nn1</name><value>nnhost1:8020</value></property>
+  <property><name>dfs.namenode.rpc-address.ns1.nn2</name><value>nnhost2:8020</value></property>
+  <property><name>dfs.ha.namenodes.broken</name><value>nn1</value></property>
+</configuration>
+"""
+
+CORE_SITE = """<?xml version="1.0"?>
+<configuration>
+  <property><name>fs.defaultFS</name><value>hdfs://ns1</value></property>
+</configuration>
+"""
+
+
+# --- nameservice resolution -------------------------------------------------
+
+@pytest.fixture
+def hadoop_home(tmp_path, monkeypatch):
+    conf = tmp_path / 'etc' / 'hadoop'
+    conf.mkdir(parents=True)
+    (conf / 'hdfs-site.xml').write_text(HDFS_SITE)
+    (conf / 'core-site.xml').write_text(CORE_SITE)
+    for env in ('HADOOP_PREFIX', 'HADOOP_INSTALL'):
+        monkeypatch.delenv(env, raising=False)
+    monkeypatch.setenv('HADOOP_HOME', str(tmp_path))
+    return tmp_path
+
+
+def test_resolve_nameservice_from_hadoop_home(hadoop_home):
+    resolver = HdfsNamenodeResolver()
+    assert resolver.resolve_hdfs_name_service('ns1') == \
+        ['nnhost1:8020', 'nnhost2:8020']
+
+
+def test_resolve_default_service(hadoop_home):
+    assert HdfsNamenodeResolver().resolve_default_hdfs_service() == \
+        ('ns1', ['nnhost1:8020', 'nnhost2:8020'])
+
+
+def test_unknown_namespace_returns_none(hadoop_home):
+    assert HdfsNamenodeResolver().resolve_hdfs_name_service('plainhost') is None
+
+
+def test_missing_rpc_address_raises(hadoop_home):
+    with pytest.raises(RuntimeError, match='dfs.namenode.rpc-address.broken.nn1'):
+        HdfsNamenodeResolver().resolve_hdfs_name_service('broken')
+
+
+def test_explicit_configuration_dict():
+    resolver = HdfsNamenodeResolver({
+        'dfs.ha.namenodes.x': 'a,b',
+        'dfs.namenode.rpc-address.x.a': 'h1:9000',
+        'dfs.namenode.rpc-address.x.b': 'h2:9000',
+    })
+    assert resolver.resolve_hdfs_name_service('x') == ['h1:9000', 'h2:9000']
+
+
+def test_no_default_fs_raises():
+    with pytest.raises(RuntimeError, match='fs.defaultFS'):
+        HdfsNamenodeResolver({}).resolve_default_hdfs_service()
+
+
+# --- failover behavior ------------------------------------------------------
+
+class _MockFs(object):
+    """Filesystem stub; the per-namenode failure budget lives on the
+    connector so it survives reconnects (a standby namenode stays standby)."""
+
+    def __init__(self, namenode, connector):
+        self.namenode = namenode
+        self.connector = connector
+        self.readonly_attr = 'not-callable'
+
+    def ls(self, path):
+        remaining = self.connector.fail_calls_by_nn.get(self.namenode, 0)
+        if remaining > 0:
+            self.connector.fail_calls_by_nn[self.namenode] = remaining - 1
+            raise IOError('standby namenode {}'.format(self.namenode))
+        return ['{}:{}'.format(self.namenode, path)]
+
+
+class _MockConnector(object):
+    """Picklable connect factory with scriptable per-namenode behavior."""
+
+    def __init__(self, fail_calls_by_nn=None, refuse=()):
+        self.fail_calls_by_nn = dict(fail_calls_by_nn or {})
+        self.refuse = tuple(refuse)
+        self.connects = []
+
+    def __call__(self, namenode):
+        self.connects.append(namenode)
+        if namenode in self.refuse:
+            raise IOError('connection refused: {}'.format(namenode))
+        return _MockFs(namenode, self)
+
+
+def test_connects_to_first_healthy_namenode():
+    connector = _MockConnector(refuse=('nn-a:8020',))
+    fs = HANamenodeFilesystem(connector, ['nn-a:8020', 'nn-b:8020'])
+    assert fs.current_namenode == 'nn-b:8020'
+    assert fs.ls('/x') == ['nn-b:8020:/x']
+
+
+def test_no_namenode_reachable_raises():
+    connector = _MockConnector(refuse=('a:1', 'b:1'))
+    with pytest.raises(HdfsConnectError):
+        HANamenodeFilesystem(connector, ['a:1', 'b:1'])
+
+
+def test_single_failover_on_standby_error():
+    """First namenode accepts the connection but fails calls (standby):
+    exactly one failover, call answered by the second namenode."""
+    connector = _MockConnector(fail_calls_by_nn={'nn1:8020': 100})
+    fs = HANamenodeFilesystem(connector, ['nn1:8020', 'nn2:8020'])
+    assert fs.ls('/data') == ['nn2:8020:/data']
+    assert connector.connects == ['nn1:8020', 'nn2:8020']
+
+
+def test_round_robin_returns_to_original():
+    """Two failovers with two namenodes retry the original (reference
+    namenode.py:151-152 'if 2 NNs, try back to the original')."""
+    # nn1 fails its first call (transient), nn2 always fails.
+    connector = _MockConnector(fail_calls_by_nn={'nn1:8020': 1, 'nn2:8020': 100})
+    fs = HANamenodeFilesystem(connector, ['nn1:8020', 'nn2:8020'])
+    assert fs.ls('/d') == ['nn1:8020:/d']
+    # connect order: nn1 (init), nn2 (1st failover), nn1 (2nd failover)
+    assert connector.connects == ['nn1:8020', 'nn2:8020', 'nn1:8020']
+
+
+def test_max_failovers_exceeded():
+    connector = _MockConnector(fail_calls_by_nn={'a:1': 100, 'b:1': 100})
+    fs = HANamenodeFilesystem(connector, ['a:1', 'b:1'])
+    with pytest.raises(MaxFailoversExceeded) as exc_info:
+        fs.ls('/d')
+    assert len(exc_info.value.failed_exceptions) == \
+        HANamenodeFilesystem.MAX_FAILOVER_ATTEMPTS + 1
+    assert exc_info.value.__name__ == 'ls'
+
+
+def test_non_callable_attributes_pass_through():
+    fs = HANamenodeFilesystem(_MockConnector(), ['nn:1'])
+    assert fs.readonly_attr == 'not-callable'
+
+
+def test_pickle_reconnects():
+    """Parity: reference HAHdfsClient.__reduce__ (namenode.py:231-233) —
+    the proxy pickles by (connector, namenodes), reconnecting on load."""
+    fs = HANamenodeFilesystem(_MockConnector(), ['nn-a:1', 'nn-b:1'])
+    clone = pickle.loads(pickle.dumps(fs))
+    assert clone.ls('/p') == ['nn-a:1:/p']
+
+
+def test_connect_ha_hdfs_resolves_nameservice(hadoop_home, monkeypatch):
+    import petastorm_tpu.hdfs as hdfs_mod
+    monkeypatch.setattr(hdfs_mod, 'FsspecHdfsConnector',
+                        lambda storage_options=None: _MockConnector())
+    fs, path = connect_ha_hdfs('hdfs://ns1/user/data')
+    assert isinstance(fs, HANamenodeFilesystem)
+    assert path == '/user/data'
+    assert fs.ls('/q') == ['nnhost1:8020:/q']
+
+
+def test_connect_ha_hdfs_rejects_other_schemes():
+    with pytest.raises(ValueError, match='hdfs://'):
+        connect_ha_hdfs('gs://bucket/x')
+
+
+def test_filesystem_resolver_routes_hdfs_through_ha(hadoop_home, monkeypatch):
+    """The dataset-read path (FilesystemResolver, used by make_reader) must
+    build the HA wrapper for nameservice URLs — not a plain fsspec hdfs fs."""
+    import petastorm_tpu.hdfs as hdfs_mod
+    from petastorm_tpu.fs import FilesystemResolver
+
+    monkeypatch.setattr(hdfs_mod, 'FsspecHdfsConnector',
+                        lambda storage_options=None: _MockConnector())
+    resolver = FilesystemResolver('hdfs://ns1/user/data')
+    fs = resolver.filesystem()
+    assert isinstance(fs, HANamenodeFilesystem)
+    assert resolver.get_dataset_path() == '/user/data'
+    # The picklable factory reconnects through the same HA path on workers.
+    factory = resolver.filesystem_factory()
+    clone_fs = pickle.loads(pickle.dumps(factory))()
+    assert isinstance(clone_fs, HANamenodeFilesystem)
