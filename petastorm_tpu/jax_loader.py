@@ -360,8 +360,6 @@ class JaxLoader(object):
         self._queue = queue.Queue(maxsize=max(1, prefetch))
         self._stop = threading.Event()
         self._exhausted = False
-        self._thread = threading.Thread(target=self._stage_loop, daemon=True)
-        self._thread.start()
         self._namedtuple_cache = {}
         # input-stall accounting (BASELINE.json targets <5% input stall)
         self._batches_delivered = 0
@@ -372,6 +370,9 @@ class JaxLoader(object):
         self._stats_lock = threading.Lock()
         self._stage_s = 0.0
         self._staged_bytes = 0
+        # Start the stager LAST: it touches the state above immediately.
+        self._thread = threading.Thread(target=self._stage_loop, daemon=True)
+        self._thread.start()
 
     # -- staging thread --------------------------------------------------
 
