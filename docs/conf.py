@@ -1,0 +1,29 @@
+# Sphinx configuration (parity: reference docs/ readthedocs tree).
+# Build with: sphinx-build -b html docs docs/_build  (sphinx is not part of
+# the TPU-VM image; docs are plain reST and render on any sphinx >= 4).
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath('..'))
+
+project = 'petastorm-tpu'
+author = 'petastorm-tpu developers'
+release = '0.1.0'
+
+extensions = [
+    'sphinx.ext.autodoc',
+    'sphinx.ext.autosummary',
+    'sphinx.ext.napoleon',
+    'sphinx.ext.viewcode',
+]
+
+autosummary_generate = True
+autodoc_member_order = 'bysource'
+# Heavy/optional imports are mocked so API docs build on doc-only machines.
+autodoc_mock_imports = ['jax', 'jaxlib', 'flax', 'optax', 'tensorflow',
+                        'torch', 'zmq', 'dill', 'fsspec', 'pyspark']
+
+templates_path = ['_templates']
+exclude_patterns = ['_build']
+html_theme = 'alabaster'

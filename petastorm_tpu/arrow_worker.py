@@ -76,8 +76,7 @@ class ArrowWorker(RowGroupWorkerBase):
             hashlib.md5(','.join(field_names).encode()).hexdigest()[:8])
 
         def load():
-            pf = self._parquet_file(piece.path)
-            table = pf.read_row_group(piece.row_group, columns=physical)
+            table = self._read_row_group(piece, physical)
             return self._append_partition_columns(table, piece, field_names)
 
         return self.args['cache'].get(cache_key, load)
@@ -96,10 +95,9 @@ class ArrowWorker(RowGroupWorkerBase):
         unknown = set(predicate_fields) - set(full_schema.fields)
         if unknown:
             raise ValueError('Predicate uses unknown fields: {}'.format(sorted(unknown)))
-        pf = self._parquet_file(piece.path)
         partition_names = set(self.args['partition_names'])
         pred_physical = [n for n in predicate_fields if n not in partition_names]
-        pred_table = pf.read_row_group(piece.row_group, columns=pred_physical)
+        pred_table = self._read_row_group(piece, pred_physical)
         pred_table = self._append_partition_columns(pred_table, piece, predicate_fields)
         pred_df = pred_table.to_pandas()
         mask = pred_df.apply(
@@ -109,7 +107,7 @@ class ArrowWorker(RowGroupWorkerBase):
             return None
         other = [n for n in physical if n not in predicate_fields]
         if other:
-            other_table = pf.read_row_group(piece.row_group, columns=other)
+            other_table = self._read_row_group(piece, other)
             for col in other_table.column_names:
                 pred_table = pred_table.append_column(col, other_table.column(col))
         table = self._append_partition_columns(pred_table, piece, field_names)
@@ -178,6 +176,9 @@ def _arrow_column_to_numpy(column, field):
     if np_dtype.kind in ('O', 'S', 'U'):
         return column.to_pandas().values
     try:
+        # Zero-copy for single-chunk null-free primitives: the numpy array
+        # is a read-only view over the Arrow buffer the C++ decode produced
+        # (SURVEY §2.9's "Arrow-compatible columnar buffers" leg).
         return column.to_numpy(zero_copy_only=False)
     except (pa.ArrowInvalid, NotImplementedError):
         return column.to_pandas().values

@@ -370,6 +370,10 @@ class JaxLoader(object):
         self._stats_lock = threading.Lock()
         self._stage_s = 0.0
         self._staged_bytes = 0
+        try:
+            self._dlpack_staging = jax.default_backend() == 'cpu'
+        except Exception:  # noqa: BLE001 - backend probe must not kill init
+            self._dlpack_staging = False
         # Start the stager LAST: it touches the state above immediately.
         self._thread = threading.Thread(target=self._stage_loop, daemon=True)
         self._thread.start()
@@ -394,6 +398,16 @@ class JaxLoader(object):
             if self._mesh is not None or self._sharding is not None:
                 sharding = self._field_sharding(name)
                 out[name] = jax.make_array_from_process_local_data(sharding, array)
+            elif self._dlpack_staging:
+                # CPU backend: import the host buffer zero-copy via DLPack
+                # (batch buffers are freshly assembled, never mutated after
+                # staging, so aliasing is safe). TPU backends need the real
+                # h2d transfer and take the device_put branch.
+                try:
+                    out[name] = jax.dlpack.from_dlpack(array)
+                except (TypeError, BufferError, RuntimeError):
+                    self._dlpack_staging = False
+                    out[name] = jax.device_put(array)
             else:
                 out[name] = jax.device_put(array)
         # Dispatch time only (device_put is async); the transfer itself

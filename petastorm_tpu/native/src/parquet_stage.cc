@@ -1,0 +1,171 @@
+// C++ Parquet row-group reader with Arrow C Data export.
+//
+// SURVEY.md §2.9 names this the one mandatory native component: "a C++
+// Parquet row-group reader + Arrow-compatible columnar buffers with zero-copy
+// export for JAX device_put" (the reference's native horsepower is the same
+// Arrow/Parquet C++ stack, reached via pyarrow — reference setup.py:41).
+//
+// The whole read happens inside one extern-"C" call: file open (optionally
+// memory-mapped), footer/metadata decode, column projection, decompression
+// and decode into Arrow columnar buffers — all GIL-free (ctypes releases the
+// GIL for the duration). The result crosses back into Python through the
+// Arrow C Data Interface (ArrowSchema/ArrowArray), which pyarrow imports
+// without copying; fixed-width columns then reach numpy/JAX zero-copy.
+//
+// Built against the pyarrow wheel's bundled libarrow/libparquet (same
+// libraries pyarrow itself runs), so buffers are allocated from the same
+// Arrow memory pool and stay compatible across the boundary.
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include <arrow/api.h>
+#include <arrow/c/bridge.h>
+#include <arrow/io/api.h>
+#include <parquet/arrow/reader.h>
+#include <parquet/file_reader.h>
+#include <parquet/properties.h>
+
+namespace {
+
+int32_t set_err(const std::string& msg, char* err, int32_t err_cap) {
+  if (err != nullptr && err_cap > 0) {
+    std::strncpy(err, msg.c_str(), static_cast<size_t>(err_cap) - 1);
+    err[err_cap - 1] = '\0';
+  }
+  return -1;
+}
+
+arrow::Result<std::shared_ptr<arrow::io::RandomAccessFile>> open_file(
+    const char* path, int32_t use_mmap) {
+  if (use_mmap) {
+    ARROW_ASSIGN_OR_RAISE(auto mmapped, arrow::io::MemoryMappedFile::Open(
+                                            path, arrow::io::FileMode::READ));
+    return std::static_pointer_cast<arrow::io::RandomAccessFile>(mmapped);
+  }
+  ARROW_ASSIGN_OR_RAISE(auto file, arrow::io::ReadableFile::Open(path));
+  return std::static_pointer_cast<arrow::io::RandomAccessFile>(file);
+}
+
+arrow::Status make_reader(const char* path, int32_t use_mmap,
+                          int32_t use_threads,
+                          std::unique_ptr<parquet::arrow::FileReader>* out) {
+  ARROW_ASSIGN_OR_RAISE(auto file, open_file(path, use_mmap));
+  parquet::arrow::FileReaderBuilder builder;
+  ARROW_RETURN_NOT_OK(builder.Open(file));
+  parquet::ArrowReaderProperties props;
+  props.set_use_threads(use_threads != 0);
+  // Coalesced async column-chunk prefetch: one large read per column chunk
+  // instead of many small ones — matters on object-store-backed mounts.
+  props.set_pre_buffer(true);
+  builder.properties(props);
+  return builder.Build(out);
+}
+
+struct ReaderHandle {
+  std::unique_ptr<parquet::arrow::FileReader> reader;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- cached-handle API: open once, read many row groups -------------------
+// (Re-opening per read costs a footer parse per call — ~25% on small groups.)
+
+// Returns an opaque handle (0 on failure). One handle per thread: the
+// underlying FileReader is not safe for concurrent reads.
+void* pst_open(const char* path, int32_t use_mmap, int32_t use_threads,
+               char* err, int32_t err_cap) {
+  auto handle = std::make_unique<ReaderHandle>();
+  auto st = make_reader(path, use_mmap, use_threads, &handle->reader);
+  if (!st.ok()) {
+    set_err(st.ToString(), err, err_cap);
+    return nullptr;
+  }
+  return handle.release();
+}
+
+void pst_close(void* opaque) {
+  delete static_cast<ReaderHandle*>(opaque);
+}
+
+int32_t pst_handle_num_row_groups(void* opaque) {
+  auto* handle = static_cast<ReaderHandle*>(opaque);
+  return handle->reader->parquet_reader()->metadata()->num_row_groups();
+}
+
+int32_t pst_handle_read_row_group(void* opaque, int32_t row_group,
+                                  const int32_t* columns, int32_t n_columns,
+                                  struct ArrowSchema* out_schema,
+                                  struct ArrowArray* out_array,
+                                  char* err, int32_t err_cap) {
+  auto* handle = static_cast<ReaderHandle*>(opaque);
+  auto* reader = handle->reader.get();
+  if (row_group < 0 ||
+      row_group >= reader->parquet_reader()->metadata()->num_row_groups()) {
+    return set_err("row_group index out of range", err, err_cap);
+  }
+  std::shared_ptr<arrow::Table> table;
+  arrow::Status st;
+  if (n_columns >= 0) {
+    std::vector<int> cols(columns, columns + n_columns);
+    st = reader->ReadRowGroup(row_group, cols, &table);
+  } else {
+    st = reader->ReadRowGroup(row_group, &table);
+  }
+  if (!st.ok()) return set_err(st.ToString(), err, err_cap);
+  auto batch_result = table->CombineChunksToBatch(arrow::default_memory_pool());
+  if (!batch_result.ok()) {
+    return set_err(batch_result.status().ToString(), err, err_cap);
+  }
+  st = arrow::ExportRecordBatch(*batch_result.ValueUnsafe(), out_array,
+                                out_schema);
+  if (!st.ok()) return set_err(st.ToString(), err, err_cap);
+  return 0;
+}
+
+// Footer probe: row-group count, total rows, per-row-group row counts
+// (out_rg_rows may be null; otherwise it must hold >= the returned count).
+int32_t pst_parquet_file_info(const char* path, int32_t use_mmap,
+                              int64_t* out_num_row_groups, int64_t* out_num_rows,
+                              int64_t* out_rg_rows, int32_t rg_rows_cap,
+                              char* err, int32_t err_cap) {
+  std::unique_ptr<parquet::arrow::FileReader> reader;
+  auto st = make_reader(path, use_mmap, /*use_threads=*/0, &reader);
+  if (!st.ok()) return set_err(st.ToString(), err, err_cap);
+  auto metadata = reader->parquet_reader()->metadata();
+  *out_num_row_groups = metadata->num_row_groups();
+  *out_num_rows = metadata->num_rows();
+  if (out_rg_rows != nullptr) {
+    int32_t n = metadata->num_row_groups();
+    if (n > rg_rows_cap) return set_err("rg_rows_cap too small", err, err_cap);
+    for (int32_t i = 0; i < n; ++i) {
+      out_rg_rows[i] = metadata->RowGroup(i)->num_rows();
+    }
+  }
+  return 0;
+}
+
+// Read one row group (optionally a projection of parquet leaf-column
+// indices; n_columns < 0 reads all) into a single Arrow record batch and
+// export it via the C Data Interface. The caller owns out_schema/out_array
+// and must release them (pyarrow's import does).
+int32_t pst_read_row_group(const char* path, int32_t row_group,
+                           const int32_t* columns, int32_t n_columns,
+                           int32_t use_mmap, int32_t use_threads,
+                           struct ArrowSchema* out_schema,
+                           struct ArrowArray* out_array,
+                           char* err, int32_t err_cap) {
+  void* handle = pst_open(path, use_mmap, use_threads, err, err_cap);
+  if (handle == nullptr) return -1;
+  int32_t rc = pst_handle_read_row_group(handle, row_group, columns, n_columns,
+                                         out_schema, out_array, err, err_cap);
+  pst_close(handle);
+  return rc;
+}
+
+}  // extern "C"

@@ -75,9 +75,8 @@ class PyDictWorker(RowGroupWorkerBase):
         return [n for n in field_names if n not in partition_names]
 
     def _read_columns(self, piece, column_names):
-        pf = self._parquet_file(piece.path)
         physical = self._columns_to_read(column_names)
-        table = pf.read_row_group(piece.row_group, columns=physical)
+        table = self._read_row_group(piece, physical)
         encoded_rows = table.to_pylist()
         for row in encoded_rows:
             for name, value in piece.partition_values.items():

@@ -1,15 +1,21 @@
 """Shared machinery for row-group workers (dict & arrow flavors).
 
-Hosts the per-worker Parquet file-handle LRU cache and the
-shuffle-row-drop-partition slice computation so the two worker
-implementations cannot drift apart.
+Hosts the per-worker Parquet file-handle LRU cache, the native C++ row-group
+fast path, and the shuffle-row-drop-partition slice computation so the two
+worker implementations cannot drift apart.
 """
 
+import logging
+import os
 from collections import OrderedDict
+from urllib.parse import urlparse
 
+import pyarrow as pa
 import pyarrow.parquet as pq
 
 from petastorm_tpu.workers import WorkerBase
+
+logger = logging.getLogger(__name__)
 
 _PARQUET_FILE_CACHE_SIZE = 32
 
@@ -21,9 +27,96 @@ class RowGroupWorkerBase(WorkerBase):
         super().__init__(worker_id, publish_func, args)
         self._store = None
         self._file_cache = OrderedDict()
+        self._native_parquet = None      # resolved lazily at first read
+        self._native_required = False
+        self._leaf_index_cache = {}
 
     def initialize(self):
         self._store = self.args['store_factory']()
+
+    # --- row-group reads ----------------------------------------------
+
+    def _native_parquet_enabled(self):
+        """Native C++ row-group decode (SURVEY §2.9): used for local stores
+        when the library builds; ``PETASTORM_TPU_NATIVE_PARQUET=0`` disables,
+        ``=1`` requires — build failure, a remote store, or a native read
+        error then raise instead of silently measuring the pyarrow path."""
+        if self._native_parquet is None:
+            setting = os.environ.get('PETASTORM_TPU_NATIVE_PARQUET', 'auto')
+            self._native_required = setting == '1'
+            if setting == '0':
+                self._native_parquet = False
+            else:
+                from petastorm_tpu.native import parquet as native_pq
+                local = urlparse(self._store.url).scheme == 'file'
+                available = native_pq.is_available()
+                if self._native_required:
+                    if not available:
+                        raise RuntimeError('PETASTORM_TPU_NATIVE_PARQUET=1 but '
+                                           'the native parquet reader failed to build')
+                    if not local:
+                        raise RuntimeError('PETASTORM_TPU_NATIVE_PARQUET=1 but the '
+                                           'store is not local ({}); the C++ reader '
+                                           'opens filesystem paths'.format(self._store.url))
+                self._native_parquet = bool(available and local)
+        return self._native_parquet
+
+    def _leaf_indices(self, path, columns):
+        # Keyed by (path, columns): files written by different writers may
+        # order the same columns differently.
+        key = (path, tuple(columns))
+        indices = self._leaf_index_cache.get(key, -1)
+        if indices == -1:
+            from petastorm_tpu.native import parquet as native_pq
+            indices = native_pq.leaf_indices_for_fields(
+                self._parquet_file(path).schema, columns)
+            self._leaf_index_cache[key] = indices  # None => nested; fall back
+        return indices
+
+    def _read_row_group(self, piece, columns):
+        """One row-group as a ``pa.Table``, restricted to ``columns``.
+
+        Native path: decode runs wholly in C++ with the GIL released and the
+        buffers import zero-copy (Arrow C Data Interface). Falls back to
+        pyarrow for remote stores, nested columns, or build failure.
+        """
+        if self._native_parquet_enabled():
+            indices = self._leaf_indices(piece.path, columns)
+            if indices is not None:
+                from petastorm_tpu.native import parquet as native_pq
+                try:
+                    batch = self._native_file(piece.path).read_row_group(
+                        piece.row_group, columns=indices)
+                    table = pa.Table.from_batches([batch])
+                    # Column order follows leaf order; restore the request's.
+                    return table.select(columns)
+                except native_pq.NativeParquetError as e:
+                    if self._native_required:
+                        raise
+                    logger.warning('native row-group read failed (%s); '
+                                   'falling back to pyarrow', e)
+                    self._native_parquet = False
+        pf = self._parquet_file(piece.path)
+        return pf.read_row_group(piece.row_group, columns=columns)
+
+    def _native_file(self, path):
+        """Handle-cached native reader, LRU'd alongside the pyarrow handles."""
+        from petastorm_tpu.native import parquet as native_pq
+
+        key = ('native', path)
+        nf = self._file_cache.get(key)
+        if nf is not None:
+            self._file_cache.move_to_end(key)
+            return nf
+        if len(self._file_cache) >= _PARQUET_FILE_CACHE_SIZE:
+            _, old = self._file_cache.popitem(last=False)
+            try:
+                old.close()
+            except Exception:  # noqa: BLE001
+                pass
+        nf = native_pq.NativeParquetFile(path)
+        self._file_cache[key] = nf
+        return nf
 
     def _parquet_file(self, path):
         pf = self._file_cache.get(path)

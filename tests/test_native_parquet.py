@@ -1,0 +1,160 @@
+"""Native C++ Parquet row-group reader tests (SURVEY §2.9 mandatory native
+component). Equality against pyarrow is the contract: the native path is a
+transparent fast path, never a behavior change.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.native import parquet as native_pq
+
+pytestmark = pytest.mark.skipif(not native_pq.is_available(),
+                                reason='native parquet reader did not build')
+
+
+@pytest.fixture(scope='module')
+def plain_parquet(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp('npq') / 'data.parquet')
+    rng = np.random.default_rng(3)
+    table = pa.table({
+        'id': pa.array(range(400), pa.int64()),
+        'name': pa.array(['row{}'.format(i) for i in range(400)], pa.string()),
+        'blob': pa.array([bytes([i % 251]) * (i % 64 + 1) for i in range(400)],
+                         pa.binary()),
+        'value': pa.array(rng.standard_normal(400), pa.float64()),
+        'flag': pa.array([i % 3 == 0 for i in range(400)], pa.bool_()),
+    })
+    pq.write_table(table, path, row_group_size=100)
+    return path, table
+
+
+def test_file_info_matches_footer(plain_parquet):
+    path, table = plain_parquet
+    assert native_pq.file_info(path) == (4, 400, [100, 100, 100, 100])
+
+
+@pytest.mark.parametrize('use_mmap', [False, True])
+def test_row_group_equals_pyarrow(plain_parquet, use_mmap):
+    path, _ = plain_parquet
+    pf = pq.ParquetFile(path)
+    for rg in range(4):
+        native = pa.Table.from_batches(
+            [native_pq.read_row_group(path, rg, use_mmap=use_mmap)])
+        assert native.equals(pf.read_row_group(rg))
+
+
+def test_column_projection(plain_parquet):
+    path, _ = plain_parquet
+    pf = pq.ParquetFile(path)
+    indices = native_pq.leaf_indices_for_fields(pf.schema, ['value', 'id'])
+    batch = native_pq.read_row_group(path, 1, columns=indices)
+    assert set(batch.schema.names) == {'value', 'id'}
+    np.testing.assert_array_equal(batch.column('id').to_numpy(),
+                                  np.arange(100, 200))
+
+
+def test_out_of_range_row_group_errors(plain_parquet):
+    path, _ = plain_parquet
+    with pytest.raises(native_pq.NativeParquetError, match='out of range'):
+        native_pq.read_row_group(path, 99)
+
+
+def test_missing_file_errors():
+    with pytest.raises(native_pq.NativeParquetError):
+        native_pq.file_info('/nonexistent/x.parquet')
+
+
+def test_single_leaf_list_reads_natively(tmp_path):
+    """A list column has one parquet leaf (``lst.list.element``): the mapping
+    resolves and the native read reconstructs the full list column."""
+    path = str(tmp_path / 'nested.parquet')
+    table = pa.table({'id': pa.array([1, 2]),
+                      'lst': pa.array([[1, 2], [3]], pa.list_(pa.int64()))})
+    pq.write_table(table, path)
+    schema = pq.ParquetFile(path).schema
+    indices = native_pq.leaf_indices_for_fields(schema, ['id', 'lst'])
+    assert indices == [0, 1]
+    batch = native_pq.read_row_group(path, 0, columns=indices)
+    assert batch.column('lst').to_pylist() == [[1, 2], [3]]
+
+
+def test_multi_leaf_struct_declines_leaf_mapping(tmp_path):
+    path = str(tmp_path / 'struct.parquet')
+    table = pa.table({'id': pa.array([1, 2]),
+                      's': pa.array([{'a': 1, 'b': 'x'}, {'a': 2, 'b': 'y'}],
+                                    pa.struct([('a', pa.int64()), ('b', pa.string())]))})
+    pq.write_table(table, path)
+    schema = pq.ParquetFile(path).schema
+    assert native_pq.leaf_indices_for_fields(schema, ['id', 's']) is None
+    assert native_pq.leaf_indices_for_fields(schema, ['id']) == [0]
+
+
+def test_reader_uses_native_path(synthetic_dataset, monkeypatch):
+    """The worker fast path must actually fire for local stores — and produce
+    identical rows to the pyarrow path."""
+    calls = []
+    real = native_pq.NativeParquetFile.read_row_group
+
+    def counting(self, *args, **kwargs):
+        calls.append(args[:1])
+        return real(self, *args, **kwargs)
+
+    monkeypatch.setattr(native_pq.NativeParquetFile, 'read_row_group', counting)
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     shuffle_row_groups=False, schema_fields=['id', 'matrix']) as r:
+        native_rows = {row.id: row.matrix for row in r}
+    assert calls, 'native fast path never fired'
+
+    monkeypatch.setenv('PETASTORM_TPU_NATIVE_PARQUET', '0')
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     shuffle_row_groups=False, schema_fields=['id', 'matrix']) as r:
+        py_rows = {row.id: row.matrix for row in r}
+    assert native_rows.keys() == py_rows.keys()
+    for k in native_rows:
+        np.testing.assert_array_equal(native_rows[k], py_rows[k])
+
+
+def test_env_disable(synthetic_dataset, monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_NATIVE_PARQUET', '0')
+    calls = []
+    monkeypatch.setattr(native_pq.NativeParquetFile, 'read_row_group',
+                        lambda self, *a, **k: calls.append(a))
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     shuffle_row_groups=False, schema_fields=['id']) as r:
+        assert len(list(r)) == 50
+    assert not calls
+
+
+def test_arrow_column_zero_copy(scalar_dataset):
+    """Batched reads export primitive Arrow columns zero-copy: the numpy
+    array is a read-only view over the Arrow buffer (SURVEY §2.9)."""
+    from petastorm_tpu import make_batch_reader
+
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                           shuffle_row_groups=False,
+                           schema_fields=['id', 'float_col']) as reader:
+        batch = next(reader)
+    assert batch.id.dtype == np.int64
+    assert batch.float_col.dtype == np.float64
+    # A DLPack view is read-only/unwriteable; a copy would be writeable.
+    assert not batch.float_col.flags.writeable
+
+
+def test_jax_loader_dlpack_staging_zero_copy(synthetic_dataset):
+    """On the CPU backend staging aliases the host buffer (no copy)."""
+    import jax
+
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     shuffle_row_groups=False, schema_fields=['id', 'matrix']) as r:
+        with JaxLoader(r, 8) as loader:
+            assert loader._dlpack_staging  # cpu backend in tests
+            batch = next(loader)
+            assert isinstance(batch.matrix, jax.Array)
+            assert batch.matrix.shape == (8, 4, 5)
