@@ -1,6 +1,7 @@
 """Mesh/sharding utilities for pod-scale input pipelines."""
 
 from petastorm_tpu.parallel.mesh import (batch_sharding, make_mesh,  # noqa: F401
-                                         process_shard)
+                                         process_shard, replicated_sharding,
+                                         sequence_sharding)
 from petastorm_tpu.parallel.pod_guard import (PodAbortError,  # noqa: F401
                                               PodSafeIterator, global_all)

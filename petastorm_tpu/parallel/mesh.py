@@ -56,3 +56,21 @@ def batch_sharding(mesh, batch_axes='data'):
 
 def replicated_sharding(mesh):
     return NamedSharding(mesh, PartitionSpec())
+
+
+def sequence_sharding(mesh, batch_axis='data', seq_axis='model', seq_dim=1):
+    """NamedSharding for long-context inputs: batch dim on ``batch_axis``,
+    sequence dim (``seq_dim``) on ``seq_axis``, rest replicated.
+
+    The layout ring attention (``models/attention.py``) consumes: each device
+    holds a ``[B/dp, T/sp, ...]`` tile, kv blocks rotate over ``seq_axis``'s
+    ICI ring. Use as ``JaxLoader(..., sharding={'tokens': sequence_sharding(
+    mesh)})`` (per-field dict: only sequence fields shard the T dim; labels
+    etc. keep ``batch_sharding``).
+    """
+    if seq_dim < 1:
+        raise ValueError('seq_dim must be >= 1 (0 is the batch dim)')
+    spec = [None] * (seq_dim + 1)
+    spec[0] = batch_axis
+    spec[seq_dim] = seq_axis
+    return NamedSharding(mesh, PartitionSpec(*spec))

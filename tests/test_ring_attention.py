@@ -1,0 +1,110 @@
+"""Ring attention + sequence-parallel staging tests (8 virtual CPU devices).
+
+The correctness contract: ring attention over a sequence-sharded mesh equals
+dense attention on the unsharded arrays, causal and non-causal, including
+sequences fed end-to-end from a Parquet store through JaxLoader with
+``sequence_sharding``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from petastorm_tpu.models.attention import dense_attention, ring_self_attention
+from petastorm_tpu.parallel import make_mesh, sequence_sharding
+
+
+def _qkv(key, b=2, t=64, h=2, d=16, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (b, t, h, d)
+    return (jax.random.normal(kq, shape, dtype),
+            jax.random.normal(kk, shape, dtype),
+            jax.random.normal(kv, shape, dtype))
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_ring_matches_dense(causal):
+    mesh = make_mesh({'sp': 8})
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    ring = ring_self_attention(q, k, v, mesh, 'sp', causal=causal)
+    dense = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_2d_mesh_dp_and_sp():
+    """Batch on 'data', sequence on 'sp' — the production long-context
+    layout: dp x sp mesh, both parallelisms at once."""
+    mesh = make_mesh({'data': 2, 'sp': 4})
+    q, k, v = _qkv(jax.random.PRNGKey(1), b=4, t=32)
+    ring = ring_self_attention(q, k, v, mesh, 'sp', causal=True)
+    dense = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_is_jittable_and_differentiable():
+    mesh = make_mesh({'sp': 8})
+    q, k, v = _qkv(jax.random.PRNGKey(2))
+
+    @jax.jit
+    def loss(q, k, v):
+        return jnp.sum(ring_self_attention(q, k, v, mesh, 'sp', causal=True) ** 2)
+
+    @jax.jit
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.grad(loss)(q, k, v)
+    g_dense = jax.grad(loss_dense)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_sequence_sharding_spec():
+    mesh = make_mesh({'data': 4, 'model': 2})
+    sharding = sequence_sharding(mesh, seq_axis='model')
+    assert sharding.spec == jax.sharding.PartitionSpec('data', 'model')
+    with pytest.raises(ValueError, match='seq_dim'):
+        sequence_sharding(mesh, seq_dim=0)
+
+
+def test_sequence_sharded_staging_feeds_ring_attention(tmp_path):
+    """End to end: token sequences in Parquet -> JaxLoader with per-field
+    sequence sharding -> ring attention over the 'sp' axis."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.jax_loader import JaxLoader
+    from petastorm_tpu.parallel import batch_sharding
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    t, d = 32, 8
+    schema = Unischema('Seq', [
+        UnischemaField('seq_id', np.int64, (), ScalarCodec(np.int64), False),
+        UnischemaField('tokens', np.float32, (t, d), NdarrayCodec(), False),
+    ])
+    rng = np.random.default_rng(0)
+    rows = [{'seq_id': i, 'tokens': rng.standard_normal((t, d), dtype=np.float32)}
+            for i in range(32)]
+    url = 'file://' + str(tmp_path / 'seqs')
+    write_dataset(url, schema, rows, rows_per_row_group=8)
+
+    mesh = make_mesh({'data': 2, 'sp': 4})
+    shardings = {'tokens': sequence_sharding(mesh, seq_axis='sp'),
+                 'seq_id': batch_sharding(mesh)}
+    with make_reader(url, reader_pool_type='dummy', shuffle_row_groups=False) as r:
+        with JaxLoader(r, 8, mesh=mesh, sharding=shardings) as loader:
+            batch = next(loader)
+    assert batch.tokens.shape == (8, t, d)
+    # tokens tiled (B/2, T/4) per device; seq_id sharded on batch only
+    assert batch.tokens.addressable_shards[0].data.shape == (4, t // 4, d)
+    assert batch.seq_id.addressable_shards[0].data.shape == (4,)
+
+    # reshape [B, T, D] -> [B, T, H=1, D] and attend over the sp ring
+    q = batch.tokens[:, :, None, :]
+    out = ring_self_attention(q, q, q, mesh, 'sp', causal=True)
+    dense = dense_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=1e-5, atol=1e-5)
