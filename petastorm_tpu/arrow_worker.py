@@ -17,7 +17,7 @@ import hashlib
 import numpy as np
 import pyarrow as pa
 
-from petastorm_tpu.checkpoint import chunk_key
+from petastorm_tpu.checkpoint import DeferredRowAccounting, chunk_key
 from petastorm_tpu.workers.rowgroup_worker_base import (RowGroupWorkerBase,
                                                         compute_row_slice)
 
@@ -116,17 +116,13 @@ class ArrowWorker(RowGroupWorkerBase):
         return table.select(keep).take(pa.array(indices))
 
 
-class ArrowResultsQueueReader(object):
+class ArrowResultsQueueReader(DeferredRowAccounting):
     """Consumer-side: one Arrow table -> namedtuple of numpy arrays (a batch).
 
-    Parity: reference ``arrow_reader_worker.py:39-79``.
+    Parity: reference ``arrow_reader_worker.py:39-79``. Checkpoint
+    accounting is chunk-level by default, row-granular after
+    ``enable_deferred_rows`` (see ``checkpoint.DeferredRowAccounting``).
     """
-
-    def __init__(self):
-        self._tracker = None
-
-    def set_tracker(self, tracker):
-        self._tracker = tracker
 
     @property
     def batched_output(self):
@@ -146,7 +142,7 @@ class ArrowResultsQueueReader(object):
                     table = table.slice(skip)
                 if table.num_rows == 0:
                     continue
-                self._tracker.rows_yielded(key, table.num_rows)
+                self._record_chunk(key, table.num_rows)
             break
         columns = {}
         for name in schema.fields:

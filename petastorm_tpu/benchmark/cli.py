@@ -15,13 +15,17 @@ def _parse_args(argv):
     parser.add_argument('--pool-type', '-p', choices=['thread', 'process', 'dummy'],
                         default='thread')
     parser.add_argument('--loaders-count', '-l', type=int, default=3)
-    parser.add_argument('--read-method', '-d', choices=['python', 'jax'],
+    parser.add_argument('--read-method', '-d',
+                        choices=['python', 'jax', 'tensor', 'tf'],
                         default='python')
     parser.add_argument('--shuffling-queue-size', '-q', type=int, default=500)
     parser.add_argument('--min-after-dequeue', type=int, default=400)
     parser.add_argument('--jax-batch-size', type=int, default=32)
     parser.add_argument('--spawn-new-process', action='store_true',
                         help='Measure in a fresh interpreter for clean memory stats')
+    parser.add_argument('--profile-threads', action='store_true',
+                        help='Per-worker cProfile, aggregated and printed on '
+                             'pool join (thread pool only)')
     return parser.parse_args(argv)
 
 
@@ -38,7 +42,8 @@ def main(argv=None):
         shuffling_queue_size=args.shuffling_queue_size,
         min_after_dequeue=args.min_after_dequeue,
         jax_batch_size=args.jax_batch_size,
-        spawn_new_process=args.spawn_new_process)
+        spawn_new_process=args.spawn_new_process,
+        profile_threads=args.profile_threads)
     print('samples/sec: {:.2f}  time/sample: {:.6f}s  rss: {:.1f} MB  cpu: {:.1f}%'.format(
         result.samples_per_second, result.time_mean, result.memory_rss_mb,
         result.cpu_percent))

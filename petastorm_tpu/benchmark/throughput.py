@@ -15,7 +15,7 @@ BenchmarkResult = namedtuple('BenchmarkResult',
                              ['time_mean', 'samples_per_second', 'memory_rss_mb',
                               'cpu_percent'])
 
-_READ_PATHS = ('python', 'jax')
+_READ_PATHS = ('python', 'jax', 'tensor', 'tf')
 
 
 def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
@@ -23,8 +23,18 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
                       loaders_count=3, read_method='python',
                       shuffling_queue_size=500, min_after_dequeue=400,
                       spawn_new_process=False, reader_extra_args=None,
-                      jax_batch_size=32, shape_policies=None):
-    """Measure decoded-samples/sec of a reader configuration."""
+                      jax_batch_size=32, shape_policies=None,
+                      profile_threads=False):
+    """Measure decoded-samples/sec of a reader configuration.
+
+    ``read_method``: 'python' (per-row ``make_reader``), 'jax' (JaxLoader
+    batches), 'tensor' (decoded-columnar ``make_tensor_reader`` chunks), or
+    'tf' (``make_petastorm_dataset`` tf.data feed — parity with the
+    reference's TF read path, ``benchmark/throughput.py:94-110``).
+    ``profile_threads`` enables per-worker cProfile, aggregated and printed
+    on pool join (parity: reference ``--profile-threads``,
+    ``benchmark/throughput.py:190`` / ``thread_pool.py:48-49``).
+    """
     if read_method not in _READ_PATHS:
         raise ValueError('read_method must be one of {}'.format(_READ_PATHS))
     if spawn_new_process:
@@ -39,7 +49,7 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
             _run_and_dump, out_path, dataset_url, field_regex, warmup_cycles_count,
             measure_cycles_count, pool_type, loaders_count, read_method,
             shuffling_queue_size, min_after_dequeue, reader_extra_args,
-            jax_batch_size, shape_policies)
+            jax_batch_size, shape_policies, profile_threads)
         process.wait()
         with open(out_path) as f:
             payload = json.load(f)
@@ -48,7 +58,7 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles_count=200,
     return _measure(dataset_url, field_regex, warmup_cycles_count,
                     measure_cycles_count, pool_type, loaders_count, read_method,
                     shuffling_queue_size, min_after_dequeue, reader_extra_args,
-                    jax_batch_size, shape_policies)
+                    jax_batch_size, shape_policies, profile_threads)
 
 
 def _run_and_dump(out_path, *args):
@@ -60,18 +70,29 @@ def _run_and_dump(out_path, *args):
 
 def _measure(dataset_url, field_regex, warmup_cycles_count, measure_cycles_count,
              pool_type, loaders_count, read_method, shuffling_queue_size,
-             min_after_dequeue, reader_extra_args, jax_batch_size, shape_policies):
-    from petastorm_tpu import make_reader
+             min_after_dequeue, reader_extra_args, jax_batch_size, shape_policies,
+             profile_threads=False):
+    from petastorm_tpu import make_reader, make_tensor_reader
 
     extra = dict(reader_extra_args or {})
     extra.setdefault('num_epochs', None)
-    reader = make_reader(dataset_url, schema_fields=field_regex,
-                         reader_pool_type=pool_type, workers_count=loaders_count,
-                         **extra)
+    factory = make_tensor_reader if read_method == 'tensor' else make_reader
+    reader = factory(dataset_url, schema_fields=field_regex,
+                     reader_pool_type=pool_type, workers_count=loaders_count,
+                     pool_profiling=profile_threads, **extra)
     process = psutil.Process()
     try:
         if read_method == 'python':
             iterator = iter(reader)
+            unit = 1
+        elif read_method == 'tensor':
+            # Chunk-sized samples; count real rows per chunk.
+            iterator = iter(reader)
+            unit = None
+        elif read_method == 'tf':
+            from petastorm_tpu.tf_utils import make_petastorm_dataset
+            dataset = make_petastorm_dataset(reader)
+            iterator = iter(dataset.as_numpy_iterator())
             unit = 1
         else:
             from petastorm_tpu.jax_loader import JaxLoader
@@ -82,17 +103,20 @@ def _measure(dataset_url, field_regex, warmup_cycles_count, measure_cycles_count
             iterator = iter(loader)
             unit = jax_batch_size
 
-        for _ in range(max(1, warmup_cycles_count // unit)):
-            next(iterator)
+        def consume(target):
+            done = 0
+            while done < target:
+                sample = next(iterator)
+                done += len(sample[0]) if unit is None else unit
+            return done
+
+        consume(max(1, warmup_cycles_count))
         process.cpu_percent()  # reset the CPU window
         start = time.perf_counter()
-        cycles = max(1, measure_cycles_count // unit)
-        for _ in range(cycles):
-            next(iterator)
+        samples = consume(max(1, measure_cycles_count))
         elapsed = time.perf_counter() - start
         cpu = process.cpu_percent()
         rss_mb = process.memory_info().rss / (1024 * 1024)
-        samples = cycles * unit
         return BenchmarkResult(time_mean=elapsed / samples,
                                samples_per_second=samples / elapsed,
                                memory_rss_mb=rss_mb, cpu_percent=cpu)

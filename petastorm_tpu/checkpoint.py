@@ -44,6 +44,54 @@ def chunk_key(piece_index, shuffle_row_drop_partition):
     return '{}:{}'.format(piece_index, drop_idx)
 
 
+class DeferredRowAccounting(object):
+    """Mixin for batched results-queue readers: optional row-granular
+    checkpoint attribution.
+
+    Default (chunk-level): a chunk's rows are counted consumed the moment it
+    leaves the reader. After :meth:`enable_deferred_rows` (requested by a
+    loader that consumes rows strictly in delivery order, e.g. ``JaxLoader``
+    without a shuffling buffer), ``_record_chunk`` queues (key, rows) and the
+    loader attributes actual consumption via :meth:`rows_consumed` — rows
+    buffered downstream at checkpoint time then re-deliver on resume instead
+    of being lost.
+    """
+
+    _tracker = None
+    _pending_rows = None
+
+    def set_tracker(self, tracker):
+        self._tracker = tracker
+
+    def enable_deferred_rows(self):
+        from collections import deque
+        if self._pending_rows is None:
+            self._pending_rows = deque()
+
+    def _record_chunk(self, key, n_rows):
+        """Called by read_next once a chunk's post-skip rows are delivered."""
+        if self._tracker is None:
+            return
+        if self._pending_rows is not None:
+            self._pending_rows.append((key, n_rows))
+        else:
+            self._tracker.rows_yielded(key, n_rows)
+
+    def rows_consumed(self, n):
+        """Attribute ``n`` consumed rows to chunks in delivery order."""
+        if self._tracker is None or self._pending_rows is None:
+            return
+        while n > 0 and self._pending_rows:
+            key, left = self._pending_rows[0]
+            take = min(n, left)
+            self._tracker.rows_yielded(key, take)
+            n -= take
+            if take == left:
+                self._pending_rows.popleft()
+            else:
+                self._pending_rows[0] = (key, left - take)
+
+
 class ConsumptionTracker(object):
     """Counts per-key consumption; computes resume-time skips.
 

@@ -65,6 +65,11 @@ def _ring_attention_local(q, k, v, axis_name, causal):
     out0 = jnp.zeros(q.shape, dtype=jnp.float32)
     max0 = jnp.full((q.shape[0], q.shape[1], q.shape[2]), -jnp.inf)  # [B,Tq,H]
     denom0 = jnp.zeros_like(max0)
+    # The scan carry must be device-varying from step 0: the accumulators are
+    # built from constants, but each step mixes in ppermuted (varying) blocks,
+    # so shard_map's vma check requires the initial carry be cast to varying.
+    out0, max0, denom0 = (jax.lax.pcast(x, axis_name, to='varying')
+                          for x in (out0, max0, denom0))
     carry = (k, v, my_index, out0, max0, denom0)
     (_, _, _, out, _, denom), _ = jax.lax.scan(step, carry, None,
                                                length=axis_size)
@@ -81,12 +86,10 @@ def ring_self_attention(q, k, v, mesh, seq_axis, causal=False):
     :param causal: apply a causal mask using *global* positions, so the
         result matches dense causal attention on the unsharded arrays.
     """
-    from jax.experimental.shard_map import shard_map
-
     spec = PartitionSpec(None, seq_axis, None, None)
-    fn = shard_map(partial(_ring_attention_local, axis_name=seq_axis,
-                           causal=causal),
-                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    fn = jax.shard_map(partial(_ring_attention_local, axis_name=seq_axis,
+                               causal=causal),
+                       mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 

@@ -149,6 +149,48 @@ def decode_batch(blobs, num_threads=None):
     return [_squeeze(o) for o in outs]
 
 
+def decode_batch_into(ptrs, lens, out, num_threads=None):
+    """Decode N JPEG/PNG streams directly into one contiguous output block.
+
+    ``ptrs``/``lens`` are integer arrays of blob addresses/sizes (typically
+    pointer math over an Arrow BinaryArray's value buffer — no per-cell
+    ``bytes`` objects are materialized), and ``out`` is a C-contiguous
+    ``[N, H, W, C]`` array; image ``i`` decodes into ``out[i]``. The GIL is
+    released for the whole batch. Returns per-image ``(results, channels,
+    heights, widths)`` lists: a nonzero result marks a slot the caller must
+    redo itself (e.g. an RGBA stream in an RGB-capacity slot fails with
+    'buffer too small' *before* its channel count is knowable — the caller
+    falls back to a per-cell decode for exactly those slots).
+    """
+    lib = _load()
+    n = len(ptrs)
+    if n == 0:
+        return [], [], [], []
+    if not out.flags['C_CONTIGUOUS'] or out.shape[0] != n:
+        raise ValueError('out must be C-contiguous with leading dim {}'.format(n))
+    if num_threads is None:
+        num_threads = min(n, os.cpu_count() or 4)
+    stride = out.nbytes // n
+    base = out.ctypes.data
+    datas = (ctypes.c_void_p * n)(*[int(p) for p in ptrs])
+    lens_arr = (ctypes.c_size_t * n)(*[int(l) for l in lens])
+    out_ptrs = (ctypes.c_void_p * n)(*[base + i * stride for i in range(n)])
+    caps = (ctypes.c_size_t * n)(*([stride] * n))
+    ws = (ctypes.c_int * n)()
+    hs = (ctypes.c_int * n)()
+    chs = (ctypes.c_int * n)()
+    bds = (ctypes.c_int * n)()
+    results = (ctypes.c_int * n)()
+    lib.pst_image_decode_batch(n, datas, lens_arr, out_ptrs, caps, ws, hs,
+                               chs, bds, results, num_threads)
+    return list(results), list(chs), list(hs), list(ws)
+
+
+def decode_error_message(code):
+    """Human-readable message for a nonzero ``decode_batch_into`` result."""
+    return _ERRORS.get(code, 'error {}'.format(code))
+
+
 def encode_jpeg(array, quality=80):
     """Encode a uint8 gray/RGB ndarray to JPEG bytes."""
     array = np.ascontiguousarray(array)

@@ -30,6 +30,8 @@ class PyDictWorker(RowGroupWorkerBase):
       dataset_path_hash: stable dataset identity for cache keys
     """
 
+    _prefer_native_parquet = False  # pyarrow is faster for the to-rows path
+
     def process(self, piece_index, worker_predicate=None, shuffle_row_drop_partition=None):
         piece = self.args['row_groups'][piece_index]
         schema = self.args['schema']

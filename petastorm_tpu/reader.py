@@ -36,9 +36,13 @@ _VENTILATE_EXTRA_ROWGROUPS = 2
 
 
 def _make_pool(reader_pool_type, workers_count, results_queue_size, arrow_payloads=False,
-               shm_result_ring_bytes=None):
+               shm_result_ring_bytes=None, profiling=False):
     if reader_pool_type == 'thread':
-        return ThreadPool(workers_count, results_queue_size)
+        return ThreadPool(workers_count, results_queue_size,
+                          profiling_enabled=profiling)
+    if profiling:
+        warnings.warn('pool_profiling is only supported by the thread pool; '
+                      'ignoring for {!r}'.format(reader_pool_type))
     if reader_pool_type == 'dummy':
         return DummyPool()
     if reader_pool_type in ('process', 'process-shm', 'process-zmq'):
@@ -76,6 +80,9 @@ def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_est
         cls = LocalDiskArrowTableCache if arrow_cache else LocalDiskCache
         return cls(cache_location, size_limit=cache_size_limit,
                    expected_row_size_bytes=cache_row_size_estimate, **extra)
+    if cache_type == 'memory':
+        from petastorm_tpu.cache import MemoryCache
+        return MemoryCache(size_limit_bytes=cache_size_limit)
     raise ValueError('Unknown cache_type {!r}'.format(cache_type))
 
 
@@ -95,7 +102,8 @@ def make_reader(dataset_url,
                 transform_spec=None,
                 storage_options=None,
                 shm_result_ring_bytes=None,
-                resume_state=None):
+                resume_state=None,
+                pool_profiling=False):
     """Reader for datasets materialized with petastorm_tpu codecs.
 
     Parity: reference ``petastorm/reader.py:50-174``. Rejects plain Parquet
@@ -120,11 +128,98 @@ def make_reader(dataset_url,
                         cache_row_size_estimate, arrow_cache=False,
                         **(cache_extra_settings or {}))
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      shm_result_ring_bytes=shm_result_ring_bytes)
+                      shm_result_ring_bytes=shm_result_ring_bytes,
+                      profiling=pool_profiling)
     return Reader(store, stored_schema,
                   schema_fields=schema_fields, ngram=ngram,
                   worker_class=PyDictWorker,
                   results_queue_reader=PyDictResultsQueueReader(),
+                  reader_pool=pool,
+                  shuffle_row_groups=shuffle_row_groups,
+                  shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                  seed=seed, predicate=predicate, rowgroup_selector=rowgroup_selector,
+                  num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
+                  cache=cache, transform_spec=transform_spec,
+                  resume_state=resume_state)
+
+
+def make_tensor_reader(dataset_url,
+                       schema_fields=None,
+                       reader_pool_type='thread', workers_count=10,
+                       results_queue_size=50,
+                       shuffle_row_groups=True, shuffle_row_drop_partitions=1,
+                       seed=None,
+                       predicate=None,
+                       rowgroup_selector=None,
+                       num_epochs=1,
+                       cur_shard=None, shard_count=None,
+                       cache_type='null', cache_location=None, cache_size_limit=None,
+                       cache_row_size_estimate=None, cache_extra_settings=None,
+                       transform_spec=None,
+                       storage_options=None,
+                       shm_result_ring_bytes=None,
+                       resume_state=None,
+                       pool_profiling=False):
+    """Decoded-columnar reader: the TPU hot path (no reference equivalent).
+
+    Like :func:`make_reader` (codecs run, values are decoded) but columnar
+    like :func:`make_batch_reader` (``batched_output=True``): each sample is
+    a namedtuple of ``[rows, ...field.shape]`` numpy blocks, decoded inside
+    the workers by the native C++ batch decoder straight into contiguous
+    buffers. Feed it to :class:`~petastorm_tpu.jax_loader.JaxLoader`, whose
+    block fast path slices these into fixed batches with one memcpy per
+    batch — decoded tensors never cross a per-row Python boundary.
+
+    Extra requirements over ``make_reader``: every tensor field needs a
+    fully static shape; predicates may only use scalar fields; no NGram.
+    ``cache_type='memory'`` caches *decoded* chunks in RAM — steady-state
+    epochs then skip parquet read + decode entirely.
+
+    TransformSpec semantics differ: ``func`` receives a dict of column
+    blocks (numpy in/numpy out), the vectorized analog of the reference's
+    pandas transform (``arrow_reader_worker.py:163-178``).
+    """
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.tensor_worker import (TensorResultsQueueReader,
+                                             TensorWorker,
+                                             validate_tensor_schema)
+
+    store = ParquetStore(dataset_url, storage_options)
+    try:
+        stored_schema = get_schema(store)
+    except PetastormMetadataError as e:
+        raise RuntimeError(
+            'make_tensor_reader requires a petastorm_tpu (codec-materialized) '
+            'dataset. Use make_batch_reader for plain Parquet stores: {}'.format(e))
+    if isinstance(schema_fields, NGram):
+        raise NotImplementedError('NGram is not supported with tensor readers; '
+                                  'use make_reader')
+
+    # Validate BEFORE constructing the Reader (which starts pool threads).
+    if schema_fields is not None:
+        view = stored_schema.create_schema_view(
+            match_unischema_fields(stored_schema, schema_fields,
+                                   allow_empty_match=False))
+    else:
+        view = stored_schema
+    validate_tensor_schema(view)
+    if predicate is not None:
+        bad = [f for f in predicate.get_fields()
+               if f in stored_schema.fields and stored_schema.fields[f].shape != ()]
+        if bad:
+            raise ValueError('Tensor-reader predicates may only reference scalar '
+                             'fields; got tensor fields {}'.format(bad))
+
+    cache = _make_cache(cache_type, cache_location, cache_size_limit,
+                        cache_row_size_estimate, arrow_cache=False,
+                        **(cache_extra_settings or {}))
+    pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
+                      shm_result_ring_bytes=shm_result_ring_bytes,
+                      profiling=pool_profiling)
+    return Reader(store, stored_schema,
+                  schema_fields=schema_fields,
+                  worker_class=TensorWorker,
+                  results_queue_reader=TensorResultsQueueReader(),
                   reader_pool=pool,
                   shuffle_row_groups=shuffle_row_groups,
                   shuffle_row_drop_partitions=shuffle_row_drop_partitions,
@@ -149,7 +244,8 @@ def make_batch_reader(dataset_url,
                       transform_spec=None,
                       storage_options=None,
                       shm_result_ring_bytes=None,
-                      resume_state=None):
+                      resume_state=None,
+                      pool_profiling=False):
     """Columnar batch reader for **any** Parquet store (no codecs needed).
 
     Parity: reference ``petastorm/reader.py:177-289``. Warns when pointed at a
@@ -172,7 +268,8 @@ def make_batch_reader(dataset_url,
                         cache_row_size_estimate, arrow_cache=True,
                         **(cache_extra_settings or {}))
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      arrow_payloads=True, shm_result_ring_bytes=shm_result_ring_bytes)
+                      arrow_payloads=True, shm_result_ring_bytes=shm_result_ring_bytes,
+                      profiling=pool_profiling)
     return Reader(store, stored_schema,
                   schema_fields=schema_fields,
                   worker_class=ArrowWorker,
@@ -385,6 +482,37 @@ class Reader(object):
     def batched_output(self):
         return self._results_queue_reader.batched_output
 
+    def enable_row_granular_checkpoint(self):
+        """Defer checkpoint row accounting to :meth:`rows_consumed` calls.
+
+        By default the batched (tensor/arrow) paths count a whole chunk as
+        consumed when it leaves the reader, so rows buffered downstream at
+        checkpoint time are lost to a finite-epoch resumed run. A loader
+        that consumes rows strictly in delivery order (e.g. ``JaxLoader``
+        without a shuffling buffer) calls this once, then reports actual
+        consumption with ``rows_consumed(n)`` — checkpoints taken mid-stream
+        then resume without losing buffered rows. Returns False when the
+        results-queue reader doesn't support deferral (per-row readers are
+        already row-granular)."""
+        fn = getattr(self._results_queue_reader, 'enable_deferred_rows', None)
+        if fn is None:
+            return False
+        fn()
+        return True
+
+    def rows_consumed(self, n):
+        """Attribute ``n`` delivered rows (see
+        :meth:`enable_row_granular_checkpoint`)."""
+        fn = getattr(self._results_queue_reader, 'rows_consumed', None)
+        if fn is not None:
+            fn(n)
+
+    @property
+    def stage_timings(self):
+        """Aggregated per-stage worker timings (read/decode/cache seconds),
+        when the results-queue reader collects them (tensor path)."""
+        return getattr(self._results_queue_reader, 'stage_timings', {})
+
     @property
     def transformed_schema(self):
         """The schema of yielded rows (after any TransformSpec)."""
@@ -394,15 +522,17 @@ class Reader(object):
         """JSON-safe consumption state for mid-epoch resume.
 
         Pass the returned dict as ``resume_state=`` to a new
-        ``make_reader``/``make_batch_reader`` call with the **same
-        configuration** to continue where this reader stopped: no row is
-        delivered twice within an epoch across the two sessions (order may
-        differ — worker interleaving is not part of the contract). The
-        batched (Arrow) path counts a whole chunk as consumed when it leaves
-        the reader, so rows still buffered downstream (e.g. in a JaxLoader
-        prefetch/shuffle queue) at checkpoint time are treated as consumed:
-        with ``num_epochs=None`` they simply recur on a later epoch, but with
-        a finite epoch count they will not be re-delivered after resume. See
+        ``make_reader``/``make_batch_reader``/``make_tensor_reader`` call
+        with the **same configuration** to continue where this reader
+        stopped: no row is delivered twice within an epoch across the two
+        sessions (order may differ — worker interleaving is not part of the
+        contract). By default the batched (tensor/Arrow) paths count a whole
+        chunk as consumed when it leaves the reader; a downstream loader
+        that consumes rows in delivery order can call
+        :meth:`enable_row_granular_checkpoint` + :meth:`rows_consumed`
+        (``JaxLoader`` does this automatically when no shuffling buffer is
+        configured), after which rows buffered beyond delivered batches
+        re-deliver on resume instead of being counted consumed. See
         ``petastorm_tpu/checkpoint.py`` for the full semantics.
         """
         state = self._tracker.state_dict()

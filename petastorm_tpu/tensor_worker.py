@@ -1,0 +1,354 @@
+"""Decoded-columnar row-group worker: the TPU hot path.
+
+The reference offers two mutually exclusive read modes: per-row decoded
+(``py_dict_reader_worker.py`` — codecs run, but every sample crosses the
+pool as a Python dict) and columnar raw (``arrow_reader_worker.py:39-79`` —
+zero-copy-ish, but codec cells stay encoded). Neither can feed an
+accelerator decoded tensors without per-row Python costs. This worker is
+the missing third mode: it decodes every codec column *inside the worker*
+straight into one contiguous ``[N, ...field.shape]`` numpy block per field
+(images via the native C++ batch decoder with the GIL released,
+``native/src/image_codec.cc``), and publishes a small dict of big arrays —
+O(fields) Python objects per row-group instead of O(rows).
+
+Downstream, ``jax_loader.iter_numpy_batches`` slices these blocks into
+fixed-size batches with one memcpy per batch and stages them with
+``jax.device_put`` / ``make_array_from_process_local_data`` — decoded
+tensors cross zero per-row Python boundaries end to end.
+
+Requires every non-scalar field to have a fully static shape (XLA needs
+static shapes anyway); ``make_tensor_reader`` validates this up front.
+"""
+
+import logging
+import time
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.checkpoint import DeferredRowAccounting, chunk_key
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec,
+                                  NdarrayCodec, ScalarCodec, _fast_npy_decode,
+                                  _native_image)
+from petastorm_tpu.errors import DecodeFieldError
+from petastorm_tpu.workers.rowgroup_worker_base import (RowGroupWorkerBase,
+                                                        compute_row_slice)
+
+logger = logging.getLogger(__name__)
+
+
+def validate_tensor_schema(schema):
+    """Raise unless every field can decode into a fixed-shape dense block."""
+    for name, field in schema.fields.items():
+        codec = field.resolved_codec()
+        if isinstance(codec, ScalarCodec) or (codec is None and field.shape == ()):
+            continue
+        if field.shape and any(dim is None for dim in field.shape):
+            raise ValueError(
+                'make_tensor_reader requires static shapes, but field {!r} has '
+                'shape {} (None = variable dim). Re-materialize with a fixed '
+                'shape, or use make_reader with a shape policy in the '
+                'JaxLoader.'.format(name, field.shape))
+        if codec is None and field.shape:
+            raise ValueError(
+                'make_tensor_reader requires a codec on tensor field {!r} '
+                '(plain Parquet stores: use make_batch_reader)'.format(name))
+
+
+class TensorWorker(RowGroupWorkerBase):
+    """Same args dict as PyDictWorker/ArrowWorker (see PyDictWorker docstring).
+
+    Publishes ``{'__pst_tensor_chunk__': 1, 'key': str, 'cols': {name: np
+    block}, 'timings': {...}}`` per row-group. The per-stage timings feed the
+    bench's read/decode/transport/assemble/stage profile (VERDICT r2 #1).
+    """
+
+    def process(self, piece_index, worker_predicate=None, shuffle_row_drop_partition=None):
+        piece = self.args['row_groups'][piece_index]
+        schema = self.args['schema']
+        timings = {}
+
+        def load():
+            t0 = time.perf_counter()
+            table = self._load_table(piece, worker_predicate)
+            timings['read_s'] = time.perf_counter() - t0
+            if table is None or table.num_rows == 0:
+                return None
+            t0 = time.perf_counter()
+            cols = decode_table_to_blocks(table, schema,
+                                          self.args.get('decode_threads'))
+            timings['decode_s'] = time.perf_counter() - t0
+            return cols
+
+        from petastorm_tpu.cache import NullCache
+        cached = not isinstance(self.args['cache'], NullCache)
+        if worker_predicate is None:
+            import hashlib
+            cache_key = 'tensor:{}:{}:{}:{}'.format(
+                self.args['dataset_path_hash'], piece.path, piece.row_group,
+                hashlib.md5(','.join(sorted(schema.fields)).encode()).hexdigest()[:8])
+            t0 = time.perf_counter()
+            cols = self.args['cache'].get(cache_key, load)
+            # Cache bookkeeping only: the miss's read/decode seconds are
+            # reported under their own keys, not double-counted here.
+            timings['cache_s'] = (time.perf_counter() - t0
+                                  - timings.get('read_s', 0.0)
+                                  - timings.get('decode_s', 0.0))
+        else:
+            cols = load()
+        if cols is None:
+            return
+        n_rows = len(next(iter(cols.values())))
+
+        row_slice = compute_row_slice(n_rows, shuffle_row_drop_partition)
+        if row_slice is not None:
+            start, stop = row_slice
+            if stop <= start:
+                return
+            cols = {k: v[start:stop] for k, v in cols.items()}
+            n_rows = stop - start
+
+        transform_spec = self.args.get('transform_spec')
+        if transform_spec is not None and transform_spec.func is not None:
+            # Tensor-mode transforms operate on the dict of column blocks
+            # (numpy in, numpy out) — the vectorized analog of the reference's
+            # pandas TransformSpec (``arrow_reader_worker.py:163-178``).
+            # Cached blocks are shared by reference across epochs; in-place
+            # user transforms (a common idiom) must see private copies or
+            # epoch 2's cache hit would serve already-transformed data.
+            if cached:
+                cols = {k: np.array(v, copy=True) for k, v in cols.items()}
+            out = transform_spec.func(dict(cols))
+            for name in transform_spec.removed_fields:
+                out.pop(name, None)
+            keep = self.args['transformed_schema'].fields
+            cols = {k: np.asarray(v) for k, v in out.items() if k in keep}
+            if not cols:
+                return
+            n_rows = len(next(iter(cols.values())))
+
+        if n_rows:
+            self.publish_func({'__pst_tensor_chunk__': 1,
+                               'key': chunk_key(piece_index, shuffle_row_drop_partition),
+                               'cols': cols,
+                               'timings': timings})
+
+    # --- loading ------------------------------------------------------
+
+    def _load_table(self, piece, worker_predicate):
+        schema = self.args['schema']
+        field_names = list(schema.fields)
+        partition_names = set(self.args['partition_names'])
+        physical = [n for n in field_names if n not in partition_names]
+
+        if worker_predicate is not None:
+            table = self._load_with_predicate(piece, physical, field_names,
+                                              worker_predicate)
+            if table is None:
+                return None
+        else:
+            table = self._read_row_group(piece, physical)
+        for name, value in piece.partition_values.items():
+            if name in field_names and name not in table.column_names:
+                table = table.append_column(name, pa.array([value] * table.num_rows))
+        return table
+
+    def _load_with_predicate(self, piece, physical, field_names, predicate):
+        """Two-phase predicate read on *decoded* values.
+
+        Unlike the Arrow worker (which evaluates predicates on raw cells),
+        tensor-mode predicates see what ``make_reader`` predicates see:
+        decoded scalars. Tensor fields in predicates are rejected by
+        ``make_tensor_reader``.
+        """
+        predicate_fields = sorted(predicate.get_fields())
+        full_schema = self.args['full_schema']
+        unknown = set(predicate_fields) - set(full_schema.fields)
+        if unknown:
+            raise ValueError('Predicate uses unknown fields: {}'.format(sorted(unknown)))
+        partition_names = set(self.args['partition_names'])
+        pred_physical = [n for n in predicate_fields if n not in partition_names]
+        pred_table = (self._read_row_group(piece, pred_physical) if pred_physical
+                      else None)
+        n = pred_table.num_rows if pred_table is not None else None
+        pred_cols = {}
+        if pred_table is not None:
+            pred_schema = full_schema.create_schema_view(
+                [f for f in predicate_fields if f in full_schema.fields and f in pred_physical])
+            pred_cols = decode_table_to_blocks(pred_table, pred_schema,
+                                               self.args.get('decode_threads'))
+        for name in predicate_fields:
+            if name in piece.partition_values:
+                if n is None:
+                    raise ValueError('Predicate on partition values only should '
+                                     'have been pruned before ventilation')
+                pred_cols[name] = np.asarray([piece.partition_values[name]] * n)
+        mask = np.asarray([predicate.do_include({f: pred_cols[f][i] for f in predicate_fields})
+                           for i in range(n)], dtype=bool)
+        if not mask.any():
+            return None
+        table = self._read_row_group(piece, physical)
+        return table.take(pa.array(np.flatnonzero(mask)))
+
+
+class TensorResultsQueueReader(DeferredRowAccounting):
+    """Consumer side: one decoded chunk -> namedtuple of numpy blocks.
+
+    Checkpoint accounting is chunk-level by default, row-granular after
+    ``enable_deferred_rows`` (see ``checkpoint.DeferredRowAccounting``).
+    """
+
+    def __init__(self):
+        self._timings = {'read_s': 0.0, 'decode_s': 0.0, 'cache_s': 0.0,
+                         'chunks': 0}
+
+    @property
+    def batched_output(self):
+        return True
+
+    @property
+    def stage_timings(self):
+        return dict(self._timings)
+
+    def read_next(self, pool, schema, ngram):
+        if ngram is not None:
+            raise NotImplementedError('NGram is not supported with tensor readers')
+        while True:
+            chunk = pool.get_results()
+            cols, key = chunk['cols'], chunk['key']
+            t = chunk.get('timings') or {}
+            for k in ('read_s', 'decode_s', 'cache_s'):
+                if k in t:
+                    self._timings[k] += t[k]
+            self._timings['chunks'] += 1
+            n_rows = len(next(iter(cols.values())))
+            if self._tracker is not None:
+                skip = self._tracker.on_chunk(key, n_rows)
+                if skip:
+                    cols = {k: v[skip:] for k, v in cols.items()}
+                    n_rows -= skip
+                if n_rows <= 0:
+                    continue
+                self._record_chunk(key, n_rows)
+            break
+        names = [n for n in schema.fields if n in cols]
+        return schema.make_namedtuple(**{n: cols[n] for n in names})
+
+
+# --------------------------------------------------------------------------
+# columnar decode
+# --------------------------------------------------------------------------
+
+def decode_table_to_blocks(table, schema, decode_threads=None):
+    """Arrow table -> dict of contiguous per-field numpy blocks, decoded."""
+    cols = {}
+    for name in schema.fields:
+        if name not in table.column_names:
+            continue
+        field = schema.fields[name]
+        column = table.column(name).combine_chunks()
+        if column.null_count:
+            raise DecodeFieldError(
+                'Field {!r} contains nulls; the tensor path requires dense '
+                'columns (fill them with a TransformSpec or use make_reader)'
+                .format(name))
+        codec = field.resolved_codec()
+        try:
+            if isinstance(codec, CompressedImageCodec):
+                cols[name] = _decode_image_column(column, field, decode_threads)
+            elif isinstance(codec, (NdarrayCodec, CompressedNdarrayCodec)):
+                cols[name] = _decode_ndarray_column(column, field, codec)
+            else:  # scalars (incl. partition-value columns)
+                cols[name] = _scalar_column_to_numpy(column, field)
+        except DecodeFieldError:
+            raise
+        except Exception as e:
+            raise DecodeFieldError('Unable to decode field {!r}: {}'.format(name, e)) from e
+    return cols
+
+
+def _binary_column_view(column):
+    """(base_address + offsets, lengths) pointer math over a BinaryArray —
+    no per-cell ``bytes`` objects."""
+    buffers = column.buffers()
+    # [validity, offsets, data]; offset dtype depends on binary vs large_binary
+    off_dtype = np.int64 if pa.types.is_large_binary(column.type) else np.int32
+    offsets = np.frombuffer(buffers[1], dtype=off_dtype,
+                            count=len(column) + column.offset + 1)
+    offsets = offsets[column.offset:column.offset + len(column) + 1].astype(np.int64)
+    base = buffers[2].address
+    return base + offsets[:-1], np.diff(offsets)
+
+
+def _decode_image_column(column, field, decode_threads):
+    n = len(column)
+    dtype = np.dtype(field.numpy_dtype)
+    out = np.empty((n,) + tuple(field.shape), dtype=dtype)
+    native = _native_image()
+    codec = field.resolved_codec()
+    if native is not None and dtype == np.uint8:
+        ptrs, lens = _binary_column_view(column)
+        results, chs, hs, ws = native.decode_batch_into(
+            ptrs, lens, out, num_threads=decode_threads)
+        want_ch = field.shape[2] if len(field.shape) == 3 else 1
+        want_h, want_w = field.shape[0], field.shape[1]
+        for i in range(n):
+            if results[i] != 0:
+                # Slot decode failed — commonly an RGBA/16-bit stream whose
+                # native layout exceeds the RGB-capacity slot ('buffer too
+                # small' fires before the channel count is knowable). The
+                # codec path decodes unconstrained and conforms channels;
+                # it raises its own DecodeFieldError-able error if the
+                # stream is truly corrupt.
+                try:
+                    out[i] = codec.decode(field, column[i].as_py())
+                except Exception as e:
+                    raise DecodeFieldError(
+                        'Image {} of field {!r}: batch decode failed ({}) and '
+                        'per-cell fallback failed: {}'.format(
+                            i, field.name, native.decode_error_message(results[i]),
+                            e)) from e
+                continue
+            if hs[i] != want_h or ws[i] != want_w:
+                raise DecodeFieldError(
+                    'Image {} of field {!r} decodes to {}x{}, declared {}x{}'
+                    .format(i, field.name, hs[i], ws[i], want_h, want_w))
+            if chs[i] != want_ch:
+                # Gray stream inside an RGB field: the slot holds a partial
+                # channel layout; conform from a clean per-cell decode.
+                out[i] = CompressedImageCodec.conform_channels(
+                    native.decode_image(column[i].as_py()), field)
+        return out
+    # Fallback: per-cell codec decode (cv2/PIL), still into one block.
+    for i, cell in enumerate(column):
+        out[i] = codec.decode(field, cell.as_py())
+    return out
+
+
+def _decode_ndarray_column(column, field, codec):
+    n = len(column)
+    out = np.empty((n,) + tuple(field.shape), dtype=field.numpy_dtype)
+    if isinstance(codec, NdarrayCodec):
+        for i, cell in enumerate(column):
+            arr = _fast_npy_decode(cell.as_py())
+            if arr is None:
+                arr = codec.decode(field, cell.as_py())
+            out[i] = arr
+    else:
+        for i, cell in enumerate(column):
+            out[i] = codec.decode(field, cell.as_py())
+    return out
+
+
+def _scalar_column_to_numpy(column, field):
+    np_dtype = np.dtype(field.numpy_dtype)
+    if np_dtype.kind in ('O', 'S', 'U'):
+        return np.asarray(column.to_pylist(), dtype=object)
+    if np_dtype.kind == 'M':
+        return column.to_numpy(zero_copy_only=False).astype('datetime64[ns]')
+    arr = column.to_numpy(zero_copy_only=False)
+    if arr.dtype != np_dtype:
+        arr = arr.astype(np_dtype)
+    # Blocks may be sliced + concatenated downstream; ensure ownership so the
+    # chunk's Arrow table can be dropped.
+    return np.ascontiguousarray(arr)

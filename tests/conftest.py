@@ -164,3 +164,33 @@ def timeseries_dataset(tmp_path_factory):
     ds.url = url
     ds.data = rows
     return ds
+
+
+@pytest.fixture(scope='session')
+def many_columns_dataset(tmp_path_factory):
+    """1000-column plain Parquet store (no unischema metadata).
+
+    Parity: reference ``tests/test_common.py:248-294``
+    (``many_columns_non_petastorm_dataset``) — exercises namedtuple codegen
+    and column pruning at schema width.
+    """
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = tmp_path_factory.mktemp('many_columns') / 'dataset'
+    os.makedirs(path, exist_ok=True)
+    n_cols, n_rows = 1000, 30
+    data = {'col_{}'.format(c): np.arange(c, c + n_rows, dtype=np.int64)
+            for c in range(n_cols)}
+    table = pa.table(data)
+    pq.write_table(table, str(path / 'data.parquet'), row_group_size=10)
+
+    class _Dataset:
+        pass
+
+    ds = _Dataset()
+    ds.url = 'file://' + str(path)
+    ds.path = str(path)
+    ds.n_cols = n_cols
+    ds.n_rows = n_rows
+    return ds

@@ -183,3 +183,63 @@ def test_jax_loader_state_dict(synthetic_dataset):
     # exactly-once: nothing from the delivered batch reappears; loader-buffered
     # rows count as consumed (documented trade).
     assert not (set(seen) & set(rest))
+
+
+def test_tensor_loader_row_granular_resume(synthetic_dataset):
+    """VERDICT r2 #5: a checkpoint taken mid-row-group with num_epochs=1 must
+    resume without losing rows still buffered in the loader — consumption is
+    counted when batches are DELIVERED, not when chunks leave the reader."""
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    all_ids = sorted(r['id'] for r in synthetic_dataset.data)
+    seen = []
+    # batch 7 < rows_per_row_group 10, prefetch deliberately large so several
+    # decoded chunks sit buffered beyond the delivered batches.
+    with make_tensor_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                            reader_pool_type='thread', workers_count=2,
+                            num_epochs=1, shuffle_row_groups=False) as reader:
+        with JaxLoader(reader, 7, prefetch=4, last_batch='drop') as loader:
+            for _ in range(3):
+                seen.extend(np.asarray(next(loader).id).tolist())
+            state = loader.state_dict()
+
+    state = json.loads(json.dumps(state))
+    with make_tensor_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                            reader_pool_type='thread', workers_count=2,
+                            num_epochs=1, shuffle_row_groups=False,
+                            resume_state=state) as reader:
+        rest = []
+        for chunk in reader:
+            rest.extend(np.asarray(chunk.id).tolist())
+
+    # 21 delivered + complement on resume = the whole epoch, no overlap, no loss
+    assert len(seen) == 21
+    assert not (set(seen) & set(rest))
+    assert sorted(seen + rest) == all_ids
+
+
+def test_arrow_loader_row_granular_resume(scalar_dataset):
+    """Same contract on the make_batch_reader (arrow) path."""
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    with make_batch_reader(scalar_dataset.url, schema_fields=['id', 'int_fixed'],
+                           reader_pool_type='thread', workers_count=2,
+                           num_epochs=1, shuffle_row_groups=False) as reader:
+        with JaxLoader(reader, 7, prefetch=4, last_batch='drop') as loader:
+            seen = []
+            for _ in range(3):
+                seen.extend(np.asarray(next(loader).id).tolist())
+            state = loader.state_dict()
+
+    state = json.loads(json.dumps(state))
+    with make_batch_reader(scalar_dataset.url, schema_fields=['id', 'int_fixed'],
+                           reader_pool_type='thread', workers_count=2,
+                           num_epochs=1, shuffle_row_groups=False,
+                           resume_state=state) as reader:
+        rest = []
+        for chunk in reader:
+            rest.extend(np.asarray(chunk.id).tolist())
+
+    assert not (set(seen) & set(rest))
+    assert sorted(seen + rest) == sorted(range(100))

@@ -96,7 +96,9 @@ def test_multi_leaf_struct_declines_leaf_mapping(tmp_path):
 
 def test_reader_uses_native_path(synthetic_dataset, monkeypatch):
     """The worker fast path must actually fire for local stores — and produce
-    identical rows to the pyarrow path."""
+    identical rows to the pyarrow path. In 'auto' mode the per-row dict
+    worker prefers pyarrow (its to-rows conversion profiles faster there);
+    the columnar tensor worker prefers native; env '1' forces it anywhere."""
     calls = []
     real = native_pq.NativeParquetFile.read_row_group
 
@@ -105,18 +107,35 @@ def test_reader_uses_native_path(synthetic_dataset, monkeypatch):
         return real(self, *args, **kwargs)
 
     monkeypatch.setattr(native_pq.NativeParquetFile, 'read_row_group', counting)
-    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
-                     shuffle_row_groups=False, schema_fields=['id', 'matrix']) as r:
-        native_rows = {row.id: row.matrix for row in r}
-    assert calls, 'native fast path never fired'
 
-    monkeypatch.setenv('PETASTORM_TPU_NATIVE_PARQUET', '0')
+    # auto: the columnar (tensor) worker rides the native reader
+    from petastorm_tpu import make_tensor_reader
+    with make_tensor_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                            shuffle_row_groups=False,
+                            schema_fields=['id', 'matrix']) as r:
+        tensor_native = {}
+        for chunk in r:
+            for i in range(len(chunk.id)):
+                tensor_native[int(chunk.id[i])] = chunk.matrix[i]
+    assert calls, 'native fast path never fired for the tensor worker'
+
+    # auto: the per-row dict worker stays on pyarrow
+    calls.clear()
     with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
                      shuffle_row_groups=False, schema_fields=['id', 'matrix']) as r:
         py_rows = {row.id: row.matrix for row in r}
+    assert not calls, 'dict worker should prefer pyarrow in auto mode'
+
+    # forced native: the dict worker must fire it and match pyarrow rows
+    monkeypatch.setenv('PETASTORM_TPU_NATIVE_PARQUET', '1')
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     shuffle_row_groups=False, schema_fields=['id', 'matrix']) as r:
+        native_rows = {row.id: row.matrix for row in r}
+    assert calls, 'native fast path never fired when forced'
     assert native_rows.keys() == py_rows.keys()
     for k in native_rows:
         np.testing.assert_array_equal(native_rows[k], py_rows[k])
+        np.testing.assert_array_equal(tensor_native[k], py_rows[k])
 
 
 def test_env_disable(synthetic_dataset, monkeypatch):

@@ -1,0 +1,57 @@
+"""Wide-schema (1000-column) stress tests.
+
+Parity: reference ``tests/test_common.py:248-294`` builds a 1000-column
+non-petastorm store to exercise namedtuple codegen and column pruning at
+width; these are the equivalent assertions against ``make_batch_reader``.
+"""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+
+
+def test_full_width_read(many_columns_dataset):
+    with make_batch_reader(many_columns_dataset.url, reader_pool_type='dummy',
+                           shuffle_row_groups=False) as reader:
+        chunk = next(iter(reader))
+    assert len(chunk._fields) == many_columns_dataset.n_cols
+    np.testing.assert_array_equal(chunk.col_0[:3], [0, 1, 2])
+    np.testing.assert_array_equal(chunk.col_999[:3], [999, 1000, 1001])
+
+
+def test_column_pruning(many_columns_dataset):
+    wanted = ['col_1', 'col_500', 'col_999']
+    with make_batch_reader(many_columns_dataset.url, schema_fields=wanted,
+                           reader_pool_type='dummy',
+                           shuffle_row_groups=False) as reader:
+        total = 0
+        for chunk in reader:
+            assert sorted(chunk._fields) == wanted
+            total += len(chunk.col_1)
+    assert total == many_columns_dataset.n_rows
+
+
+def test_regex_pruning(many_columns_dataset):
+    with make_batch_reader(many_columns_dataset.url, schema_fields=['col_99\\d$'],
+                           reader_pool_type='dummy',
+                           shuffle_row_groups=False) as reader:
+        chunk = next(iter(reader))
+    assert len(chunk._fields) == 10  # col_990..col_999
+
+
+def test_namedtuple_cache_at_width(many_columns_dataset):
+    """Two readers over the same wide schema share one generated namedtuple
+    class (the reference's ``_NamedtupleCache`` behavior,
+    ``unischema.py:83-103``) — codegen at 1000 fields is paid once."""
+    types = []
+    for _ in range(2):
+        with make_batch_reader(many_columns_dataset.url, reader_pool_type='dummy',
+                               shuffle_row_groups=False) as reader:
+            types.append(type(next(iter(reader))))
+    assert types[0] is types[1]
+
+
+def test_make_reader_rejects_wide_plain_store(many_columns_dataset):
+    with pytest.raises(RuntimeError, match='make_batch_reader'):
+        make_reader(many_columns_dataset.url)
