@@ -1,0 +1,116 @@
+"""Decoder-only Transformer LM with pluggable attention backends.
+
+The long-context flagship: the same flax module runs with
+
+* ``attention='dense'`` — reference XLA attention (small inputs, tests),
+* ``attention='flash'`` — the Pallas blocked kernel
+  (:mod:`petastorm_tpu.ops.flash_attention`), no ``[T, T]`` materialization,
+* ``attention='ring'`` — sequence parallelism: q/k/v sharded over a mesh
+  axis, kv blocks rotating over ICI
+  (:mod:`petastorm_tpu.models.attention`), for contexts longer than one
+  device's HBM.
+
+TPU-first choices: bfloat16 activations with float32 params, pre-LN
+residual blocks, static shapes throughout, and the sequence axis is the
+only thing that changes between single-chip and pod runs — the module code
+is identical (mesh + shardings, XLA inserts the collectives).
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    attention: str = 'dense'            # dense | flash | ring
+    causal: bool = True
+    mesh: Any = None                    # required for 'ring'
+    seq_axis: Optional[str] = None      # mesh axis name for 'ring'
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        if d_model % self.num_heads:
+            raise ValueError('d_model {} not divisible by num_heads {}'.format(
+                d_model, self.num_heads))
+        head_dim = d_model // self.num_heads
+
+        def proj(name):
+            return nn.DenseGeneral((self.num_heads, head_dim), axis=-1,
+                                   dtype=self.dtype, name=name)(x)
+
+        q, k, v = proj('query'), proj('key'), proj('value')   # [B, T, H, Dh]
+
+        if self.attention == 'ring':
+            if self.mesh is None or self.seq_axis is None:
+                raise ValueError("attention='ring' needs mesh= and seq_axis=")
+            from petastorm_tpu.models.attention import ring_self_attention
+            out = ring_self_attention(q, k, v, self.mesh, self.seq_axis,
+                                      causal=self.causal)
+        elif self.attention == 'flash':
+            from petastorm_tpu.ops.flash_attention import flash_attention
+            out = flash_attention(q, k, v, causal=self.causal)
+        elif self.attention == 'dense':
+            from petastorm_tpu.models.attention import dense_attention
+            out = dense_attention(q, k, v, causal=self.causal)
+        else:
+            raise ValueError('unknown attention {!r}'.format(self.attention))
+
+        out = out.astype(self.dtype)
+        return nn.DenseGeneral(d_model, axis=(-2, -1), dtype=self.dtype,
+                               name='out')(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    attention: str = 'dense'
+    mesh: Any = None
+    seq_axis: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = MultiHeadAttention(self.num_heads, attention=self.attention,
+                               mesh=self.mesh, seq_axis=self.seq_axis,
+                               dtype=self.dtype, name='attn')(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(d_model * self.mlp_ratio, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(d_model, dtype=self.dtype)(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    """``[B, T] int32 tokens -> [B, T, vocab] float32 logits`` (causal)."""
+
+    vocab_size: int
+    d_model: int = 256
+    num_heads: int = 4
+    num_layers: int = 2
+    max_len: int = 2048
+    attention: str = 'dense'
+    mesh: Any = None
+    seq_axis: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens, train=True):
+        b, t = tokens.shape
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype)(tokens)
+        pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
+                       name='pos_embed')(jnp.arange(t)[None, :])
+        x = x + pos
+        for i in range(self.num_layers):
+            x = Block(self.num_heads, attention=self.attention, mesh=self.mesh,
+                      seq_axis=self.seq_axis, dtype=self.dtype,
+                      name='block_{}'.format(i))(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype, name='head')(x)
+        return logits.astype(jnp.float32)

@@ -81,7 +81,10 @@ class TensorWorker(RowGroupWorkerBase):
             return cols
 
         from petastorm_tpu.cache import NullCache
-        cached = not isinstance(self.args['cache'], NullCache)
+        # The predicate path bypasses the cache entirely, so its chunks are
+        # always private — no defensive copy needed before transforms.
+        cached = (worker_predicate is None
+                  and not isinstance(self.args['cache'], NullCache))
         if worker_predicate is None:
             import hashlib
             cache_key = 'tensor:{}:{}:{}:{}'.format(

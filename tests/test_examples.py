@@ -58,3 +58,18 @@ def test_run_in_subprocess():
 
     pid = run_in_subprocess(os.getpid)
     assert pid != os.getpid()
+
+
+def test_long_context_lm_example(tmp_path):
+    """Sequence-parallel LM: generate tokens, train a few ring-attention
+    steps on the 8-device mesh, loss finite and decreasing-ish."""
+    from examples.long_context.generate_lm_dataset import generate
+    from examples.long_context.train_lm_example import train
+
+    url = 'file://' + str(tmp_path / 'lm')
+    generate(url, num_docs=32, seq_len=64, vocab_size=512, rows_per_row_group=8)
+    params, loss = train(url, vocab_size=512, global_batch=4, steps=4,
+                         d_model=32, num_heads=2, num_layers=1,
+                         seq_parallel=8, log_every=1)
+    assert params is not None
+    assert np.isfinite(loss)
