@@ -265,12 +265,13 @@ def _child_imagenet(url, workers):
                                (1, _IMAGE_SIZE, _IMAGE_SIZE, 3),
                                mesh=mesh, learning_rate=0.1)
 
-    # Through the axon tunnel each h2d transfer event costs far more than its
-    # bytes/bandwidth share when interleaved with compute (round-3 profile:
-    # 12 ms standalone -> ~200 ms interleaved). Amortize: the loader delivers
-    # a K-batch superbatch, one device_put, and lax.scan runs the K
-    # sequential SGD steps in a single compiled program — one transfer and
-    # one dispatch per K steps. K=1 degrades to the plain per-step trainer.
+    # Per-step Python dispatch and per-step h2d events interleaved with
+    # compute carry large fixed costs through the device tunnel (round-3
+    # profile: a 12 ms standalone transfer costs ~200 ms mid-training-loop).
+    # Amortize: fetch K loader batches, concatenate ON DEVICE (transfer
+    # events stay at the known-safe ~19 MB size — large single transfers can
+    # wedge the tunnel), and lax.scan runs the K sequential SGD steps in one
+    # compiled program. K=1 degrades to the plain per-step trainer.
     scan_k = max(1, int(os.environ.get('BENCH_IMAGENET_SCAN_K', '8')))
 
     def normalize(images_u8):
@@ -295,6 +296,7 @@ def _child_imagenet(url, workers):
     superbatch = batch * scan_k
     warmup_iters = max(1, -(-warmup_steps // scan_k))
     measure_iters = max(1, -(-measure_steps // scan_k))
+
     config = {
         'reader': 'make_tensor_reader',
         'reader_pool': 'thread',
@@ -304,7 +306,7 @@ def _child_imagenet(url, workers):
         'global_batch': batch,
         'scan_microbatches': scan_k,
         'superbatch': superbatch,
-        'prefetch': 2,
+        'prefetch': max(2, scan_k),
         'model': os.environ.get('BENCH_IMAGENET_MODEL', 'resnet50'),
         'warmup_steps': warmup_iters * scan_k,
         'measure_steps': measure_iters * scan_k,
@@ -317,8 +319,9 @@ def _child_imagenet(url, workers):
                                 cache_type='memory')
 
     with reader:
-        with JaxLoader(reader, superbatch, mesh=mesh, prefetch=2) as loader:
-            it = iter(loader)
+        with JaxLoader(reader, batch, mesh=mesh,
+                       prefetch=max(2, scan_k)) as loader:
+            it = loader.superbatches(scan_k)
             for _ in range(warmup_iters):
                 b = next(it)
                 state, metrics = train_step(state, b.image, b.label)

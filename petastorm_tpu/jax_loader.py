@@ -476,6 +476,7 @@ class JaxLoader(object):
         # delivery (rows sitting in the prefetch queue at checkpoint time are
         # NOT counted consumed and re-deliver on resume).
         self._row_granular_ckpt = False
+        self._defer_rows_consumed = False   # superbatches() group accounting
         if not shuffling_queue_capacity and hasattr(reader, 'enable_row_granular_checkpoint'):
             self._row_granular_ckpt = reader.enable_row_granular_checkpoint()
 
@@ -588,12 +589,48 @@ class JaxLoader(object):
             nt = namedtuple('JaxBatch', names)
             self._namedtuple_cache[names] = nt
         self._batches_delivered += 1
-        if self._row_granular_ckpt:
+        if self._row_granular_ckpt and not self._defer_rows_consumed:
             # A padded final batch over-reports by the pad amount; the
             # attribution FIFO simply drains empty, which is correct (the
             # padded copies duplicate rows already attributed).
             self._reader.rows_consumed(self._local_batch)
         return nt(**{k: item[k] for k in names})
+
+    def superbatches(self, k):
+        """Yield ``k``-batch on-device concatenations (for scan training).
+
+        Pairs with ``models.train.make_scan_train_step(microbatches=k)``:
+        transfers stay at the per-batch size (large single h2d events can be
+        pathological on some interconnects) while the training loop pays one
+        Python dispatch per ``k`` optimizer steps. The final incomplete
+        group (fewer than ``k`` batches at end of data) is dropped — sizes
+        stay static for XLA. Checkpoint row accounting happens per *yielded
+        group*, so a dropped partial group's rows are NOT counted consumed
+        and re-deliver on resume (exactly-once holds here too).
+        """
+        if k <= 1:
+            yield from self
+            return
+        jax = self._jax
+        import jax.numpy as jnp
+        concat = jax.jit(lambda *xs: jnp.concatenate(xs))
+        it = iter(self)
+        self._defer_rows_consumed = True
+        try:
+            while True:
+                parts = []
+                try:
+                    for _ in range(k):
+                        parts.append(next(it))
+                except StopIteration:
+                    return
+                if self._row_granular_ckpt:
+                    self._reader.rows_consumed(k * self._local_batch)
+                yield parts[0]._replace(
+                    **{f: concat(*[getattr(p, f) for p in parts])
+                       for f in parts[0]._fields})
+        finally:
+            self._defer_rows_consumed = False
 
     def reset_stats(self):
         """Zero the stall counters — call after warmup so ``stats`` reflects

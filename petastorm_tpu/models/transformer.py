@@ -103,6 +103,11 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, train=True):
         b, t = tokens.shape
+        if t > self.max_len:
+            # XLA's gather would silently clamp out-of-range positions to the
+            # last positional embedding — fail loudly instead (t is static).
+            raise ValueError('sequence length {} exceeds max_len {}'.format(
+                t, self.max_len))
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype)(tokens)
         pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
                        name='pos_embed')(jnp.arange(t)[None, :])

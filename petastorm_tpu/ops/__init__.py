@@ -1,5 +1,8 @@
 """On-device input-path ops (Pallas TPU kernels with XLA fallbacks)."""
 
+from petastorm_tpu.ops.augment import (random_crop,  # noqa: F401
+                                       random_flip, train_augment)
+from petastorm_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from petastorm_tpu.ops.image_ops import (normalize_images,  # noqa: F401
                                          normalize_images_reference,
                                          random_flip_and_normalize)

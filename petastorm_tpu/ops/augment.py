@@ -1,0 +1,66 @@
+"""On-device image augmentation (random crop / horizontal flip).
+
+The reference pipelines augment on the host inside a ``TransformSpec``
+(pandas/numpy per row-group) — host CPU pays for every augmented byte and
+the h2d transfer carries the augmented float tensors. TPU-first inversion:
+ship the *raw* uint8 batch and augment inside the jitted step — XLA fuses
+the gather/flip/normalize into the first conv's input pipeline, the host
+does nothing, and determinism comes from ``jax.random`` keys (splittable,
+reproducible across pod hosts) instead of per-worker RNG state.
+
+All functions are shape-static and vmap/vectorized (no data-dependent
+control flow), so they compile once and shard over the batch axis like any
+other per-sample op.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def random_crop(images, key, crop_h, crop_w):
+    """Per-sample random spatial crop: ``[N, H, W, C] -> [N, crop_h, crop_w, C]``.
+
+    Offsets are uniform over the valid range, drawn per sample from ``key``.
+    """
+    n, h, w, _ = images.shape
+    if crop_h > h or crop_w > w:
+        raise ValueError('crop {}x{} exceeds image {}x{}'.format(
+            crop_h, crop_w, h, w))
+    key_y, key_x = jax.random.split(key)
+    ys = jax.random.randint(key_y, (n,), 0, h - crop_h + 1)
+    xs = jax.random.randint(key_x, (n,), 0, w - crop_w + 1)
+
+    def crop_one(img, y, x):
+        return jax.lax.dynamic_slice(
+            img, (y, x, 0), (crop_h, crop_w, img.shape[-1]))
+
+    return jax.vmap(crop_one)(images, ys, xs)
+
+
+def random_flip(images, key):
+    """Per-sample horizontal flip with probability 0.5: ``[N, H, W, C]``."""
+    flips = jax.random.bernoulli(key, 0.5, (images.shape[0],))
+    flipped = images[:, :, ::-1, :]
+    return jnp.where(flips[:, None, None, None], flipped, images)
+
+
+def train_augment(images_u8, key, crop_h, crop_w, flip=True,
+                  normalize=True, dtype=jnp.bfloat16):
+    """The standard ImageNet train-time augmentation, fused on device.
+
+    uint8 ``[N, H, W, C]`` -> augmented ``dtype`` ``[N, crop_h, crop_w, C]``:
+    random crop -> random horizontal flip -> (x/255 - mean)/std. Call inside
+    the jitted train step with a per-step ``jax.random.fold_in`` key.
+    """
+    key_crop, key_flip = jax.random.split(key)
+    out = random_crop(images_u8, key_crop, crop_h, crop_w)
+    if flip and normalize:
+        # Fused flip+normalize (rides the Pallas normalize kernel on TPU).
+        from petastorm_tpu.ops.image_ops import random_flip_and_normalize
+        return random_flip_and_normalize(key_flip, out, dtype=dtype)
+    if flip:
+        out = random_flip(out, key_flip)
+    if normalize:
+        from petastorm_tpu.ops.image_ops import normalize_images
+        return normalize_images(out, dtype=dtype)
+    return out.astype(dtype)

@@ -1,12 +1,14 @@
 """Blocked (flash) attention as a Pallas TPU kernel.
 
 Single-device exact attention without materializing the ``[T, T]`` score
-matrix: the kernel walks key/value blocks with a numerically-stable online
-softmax (running max / normalizer), keeping every intermediate in VMEM and
-the two matmuls per block on the MXU. Role parity: the attention compute
-the reference's training stacks get from fused CUDA kernels — rebuilt here
-the TPU way (Pallas grid over (batch*heads, q-blocks), ``fori_loop`` over
-kv blocks, (8, 128)-aligned tiles).
+matrix: a 3-D grid ``(batch*heads, q_blocks, kv_blocks)`` streams one
+``[block_q, d]`` query tile and one ``[block_k, d]`` kv tile into VMEM per
+step — VMEM use is O(block) regardless of sequence length, so context is
+bounded by HBM, not VMEM. The online softmax (running max / normalizer)
+lives in VMEM scratch that persists across the kv-block axis (TPU grids
+execute sequentially, innermost axis fastest), and both matmuls per step
+run on the MXU. Role parity: the attention compute the reference's training
+stacks get from fused CUDA kernels — rebuilt the TPU way.
 
 Composes with :mod:`petastorm_tpu.models.attention`: ring attention shards
 the sequence across a mesh axis and rotates kv blocks over ICI; within a
@@ -24,80 +26,94 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30  # large-finite: -inf breaks the running-max rescale at init
 
+_LANES = 128     # VPU lane width: scratch vectors live broadcast over lanes
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal,
-                  scale, block_q):
-    """One grid step: a (block_q, d) query tile against every kv block.
 
-    q_ref/o_ref are ``[block_q, d]`` VMEM tiles; k_ref/v_ref hold this
-    (batch, head)'s full padded ``[t_pad, d]`` so the kv loop slices tiles
-    with a static bound. Padded tail positions are masked off via
-    ``seq_len``.
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  block_q, block_k, seq_len, causal, scale):
+    """One grid step: one (block_q, d) query tile x one (block_k, d) kv tile.
+
+    acc/m/l scratch persists across the kv axis (axis 2, innermost): init at
+    ki == 0, accumulate every step, normalize + store to ``o_ref`` at the
+    last ki. m/l are kept lane-broadcast ``[block_q, _LANES]`` to respect
+    TPU vector tiling.
     """
     import jax.experimental.pallas as pl
 
-    q_block = pl.program_id(1)
-    q = q_ref[...].astype(jnp.float32) * scale
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    t_pad = k_ref.shape[0]
-    num_k_blocks = t_pad // block_k
-    q_pos = q_block * block_q + jax.lax.iota(jnp.int32, block_q)
-    if causal:
-        # kv blocks strictly above the causal diagonal contribute nothing;
-        # shrink the loop bound instead of masking them.
-        last_q = (q_block + 1) * block_q - 1
-        num_k_blocks = jnp.minimum(num_k_blocks,
-                                   last_q // jnp.int32(block_k) + 1)
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    acc0 = jnp.zeros(o_ref.shape, jnp.float32)
-    m0 = jnp.full((o_ref.shape[0],), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((o_ref.shape[0],), jnp.float32)
+    # Causal: kv blocks wholly above the diagonal contribute nothing — skip
+    # their matmuls entirely (the diagonal block still needs the mask).
+    needed = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
 
-    def body(ki, carry):
-        acc, m, l = carry
-        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(needed)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k_blk = k_ref[...].astype(jnp.float32)
+        v_blk = v_ref[...].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         k_pos = ki * block_k + jax.lax.iota(jnp.int32, block_k)
         mask = k_pos[None, :] < seq_len                   # padded kv tail
         if causal:
+            q_pos = qi * block_q + jax.lax.iota(jnp.int32, block_q)
             mask = mask & (q_pos[:, None] >= k_pos[None, :])
         s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        correction = jnp.exp(m - m_new)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        correction = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
         p = jnp.where(mask, p, 0.0)
-        l = l * correction + p.sum(axis=-1)
-        acc = acc * correction[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return acc, m_new, l
+        l_new = l_ref[:, 0] * correction + p.sum(axis=-1)
+        acc_ref[...] = (acc_ref[...] * correction[:, None]
+                        + jax.lax.dot_general(
+                            p, v_blk, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
-    acc, _, l = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
-    l = jnp.where(l == 0.0, 1.0, l)                       # fully masked rows
-    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_ref[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)                   # fully masked rows
+        o_ref[...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
 
 
 def _flash_bhtd(q, k, v, seq_len, causal, block_q, block_k, interpret):
     """q/k/v ``[BH, T_pad, D]`` (T_pad divisible by both blocks) -> same."""
     import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     bh, t_pad, d = q.shape
     scale = 1.0 / math.sqrt(d)
-    grid = (bh, t_pad // block_q)
-    kernel = functools.partial(_flash_kernel, block_k=block_k, seq_len=seq_len,
-                               causal=causal, scale=scale, block_q=block_q)
+    grid = (bh, t_pad // block_q, t_pad // block_k)
+    kernel = functools.partial(_flash_kernel, block_q=block_q, block_k=block_k,
+                               seq_len=seq_len, causal=causal, scale=scale)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, t_pad, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, t_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        # o block ignores ki: it is revisited across the kv axis and written
+        # once at the last ki.
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t_pad, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),       # acc
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom
+        ],
         interpret=interpret,
     )(q, k, v)
 

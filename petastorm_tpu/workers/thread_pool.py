@@ -156,7 +156,10 @@ class ThreadPool(object):
         self._workers = []
 
     def _print_profiles(self):
-        profiles = [t.profile for t in self._workers if t.profile is not None]
+        # A worker that never got ventilated work has an empty profile, which
+        # pstats.Stats() rejects with TypeError — skip those.
+        profiles = [t.profile for t in self._workers
+                    if t.profile is not None and t.profile.getstats()]
         if not profiles:
             return
         stats = None

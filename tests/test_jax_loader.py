@@ -230,3 +230,21 @@ def test_jax_loader_strict_fields_propagates(never_null_dataset):
         with pytest.raises(ValueError, match='strict_fields'):
             with JaxLoader(reader, 4, strict_fields=True) as loader:
                 next(loader)
+
+
+def test_superbatches(synthetic_dataset):
+    """k-batch on-device concatenation for scan training steps."""
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    with make_tensor_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                            reader_pool_type='dummy',
+                            shuffle_row_groups=False) as reader:
+        with JaxLoader(reader, 5, last_batch='drop') as loader:
+            supers = list(loader.superbatches(3))
+    # 50 rows -> 10 batches of 5 -> 3 full groups of 3 (last lone batch dropped)
+    assert len(supers) == 3
+    assert supers[0].id.shape == (15,)
+    assert supers[0].matrix.shape == (15, 4, 5)
+    ids = np.concatenate([np.asarray(s.id) for s in supers])
+    assert sorted(ids.tolist()) == list(range(45))

@@ -1,5 +1,6 @@
 """On-device op tests: Pallas kernel (interpret mode on CPU) vs XLA oracle."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -51,3 +52,62 @@ def test_random_flip_and_normalize():
         sample = np.asarray(out[i])
         assert (np.allclose(sample, np.asarray(ref)[i], atol=1e-5)
                 or np.allclose(sample, flipped_ref[i], atol=1e-5))
+
+
+class TestAugment:
+    def _images(self, n=4, h=12, w=10, c=3, seed=0):
+        rng = np.random.default_rng(seed)
+        return jnp.asarray(rng.integers(0, 255, (n, h, w, c), dtype=np.uint8))
+
+    def test_random_crop_shapes_and_content(self):
+        from petastorm_tpu.ops.augment import random_crop
+        imgs = self._images()
+        out = random_crop(imgs, jax.random.PRNGKey(0), 8, 6)
+        assert out.shape == (4, 8, 6, 3)
+        # every crop is a contiguous window of its source image
+        src = np.asarray(imgs)
+        for i, crop in enumerate(np.asarray(out)):
+            found = any(
+                np.array_equal(src[i, y:y + 8, x:x + 6], crop)
+                for y in range(5) for x in range(5))
+            assert found, 'crop {} is not a window of its source'.format(i)
+
+    def test_random_crop_deterministic(self):
+        from petastorm_tpu.ops.augment import random_crop
+        imgs = self._images()
+        a = random_crop(imgs, jax.random.PRNGKey(7), 8, 6)
+        b = random_crop(imgs, jax.random.PRNGKey(7), 8, 6)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_random_flip_is_flip_or_identity(self):
+        from petastorm_tpu.ops.augment import random_flip
+        imgs = self._images(n=16)
+        out = np.asarray(random_flip(imgs, jax.random.PRNGKey(3)))
+        src = np.asarray(imgs)
+        kinds = set()
+        for i in range(16):
+            if np.array_equal(out[i], src[i]):
+                kinds.add('id')
+            elif np.array_equal(out[i], src[i][:, ::-1]):
+                kinds.add('flip')
+            else:
+                raise AssertionError('sample {} is neither flipped nor identity'.format(i))
+        assert kinds == {'id', 'flip'}  # p=0.5 over 16 samples: both occur
+
+    def test_train_augment_jits_and_normalizes(self):
+        from petastorm_tpu.ops.augment import train_augment
+        imgs = self._images()
+
+        @jax.jit
+        def step(x, key):
+            return train_augment(x, key, 8, 6)
+
+        out = step(imgs, jax.random.PRNGKey(0))
+        assert out.shape == (4, 8, 6, 3)
+        assert out.dtype == jnp.bfloat16
+        assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+    def test_crop_too_large_raises(self):
+        from petastorm_tpu.ops.augment import random_crop
+        with pytest.raises(ValueError, match='exceeds'):
+            random_crop(self._images(), jax.random.PRNGKey(0), 20, 6)

@@ -92,3 +92,20 @@ def test_ngram_graph_mode(synthetic_dataset):
             with tf.compat.v1.Session() as sess:
                 w = sess.run(window)
     assert int(w[1].id) == int(w[0].id) + 1
+
+
+def test_make_petastorm_dataset_over_tensor_reader(synthetic_dataset):
+    """Decoded-columnar chunks feed tf.data unchanged (batched shapes)."""
+    tf = pytest.importorskip('tensorflow')
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+    with make_tensor_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                            reader_pool_type='dummy',
+                            shuffle_row_groups=False) as reader:
+        ds = make_petastorm_dataset(reader)
+        ids = []
+        for chunk in ds.as_numpy_iterator():
+            assert chunk.matrix.shape[1:] == (4, 5)
+            ids.extend(chunk.id.tolist())
+    assert sorted(ids) == list(range(50))

@@ -112,3 +112,31 @@ def test_throughput_cli(synthetic_dataset, capsys):
     from petastorm_tpu.benchmark.cli import main
     assert main([synthetic_dataset.url, '-w', '5', '-m', '20', '-p', 'dummy']) == 0
     assert 'samples/sec' in capsys.readouterr().out
+
+
+def test_benchmark_tensor_read_path(synthetic_dataset):
+    result = reader_throughput(
+        synthetic_dataset.url, field_regex=['id', 'matrix'],
+        warmup_cycles_count=10, measure_cycles_count=30,
+        pool_type='dummy', read_method='tensor')
+    assert result.samples_per_second > 0
+
+
+def test_benchmark_profile_threads(synthetic_dataset, capsys):
+    """--profile-threads parity: per-worker cProfile aggregated on join."""
+    result = reader_throughput(
+        synthetic_dataset.url, field_regex=['id'], warmup_cycles_count=5,
+        measure_cycles_count=20, pool_type='thread', loaders_count=2,
+        read_method='python', profile_threads=True)
+    assert result.samples_per_second > 0
+    out = capsys.readouterr().out
+    assert 'cumulative' in out  # pstats table printed on pool join
+
+
+def test_benchmark_tf_read_path(synthetic_dataset):
+    pytest.importorskip('tensorflow')
+    result = reader_throughput(
+        synthetic_dataset.url, field_regex=['id', 'matrix'],
+        warmup_cycles_count=5, measure_cycles_count=20,
+        pool_type='dummy', read_method='tf')
+    assert result.samples_per_second > 0
