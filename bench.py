@@ -273,6 +273,13 @@ def _child_imagenet(url, workers):
     # wedge the tunnel), and lax.scan runs the K sequential SGD steps in one
     # compiled program. K=1 degrades to the plain per-step trainer.
     scan_k = max(1, int(os.environ.get('BENCH_IMAGENET_SCAN_K', '8')))
+    # prefetch=0 stages in the consumer thread (no transfers during compute);
+    # >0 overlaps staging with compute via the background thread. Which wins
+    # depends on whether the interconnect can overlap at all.
+    prefetch = int(os.environ.get('BENCH_IMAGENET_PREFETCH', str(max(2, scan_k))))
+    # fence=1 blocks on the loss (d2h) after each scan group, serializing
+    # compute and the next group's transfers.
+    fence = os.environ.get('BENCH_IMAGENET_FENCE') == '1'
 
     def normalize(images_u8):
         # uint8 -> float inside the compiled body: transfers ride h2d as
@@ -306,7 +313,8 @@ def _child_imagenet(url, workers):
         'global_batch': batch,
         'scan_microbatches': scan_k,
         'superbatch': superbatch,
-        'prefetch': max(2, scan_k),
+        'prefetch': prefetch,
+        'fence_per_group': fence,
         'model': os.environ.get('BENCH_IMAGENET_MODEL', 'resnet50'),
         'warmup_steps': warmup_iters * scan_k,
         'measure_steps': measure_iters * scan_k,
@@ -319,8 +327,7 @@ def _child_imagenet(url, workers):
                                 cache_type='memory')
 
     with reader:
-        with JaxLoader(reader, batch, mesh=mesh,
-                       prefetch=max(2, scan_k)) as loader:
+        with JaxLoader(reader, batch, mesh=mesh, prefetch=prefetch) as loader:
             it = loader.superbatches(scan_k)
             for _ in range(warmup_iters):
                 b = next(it)
@@ -332,6 +339,8 @@ def _child_imagenet(url, workers):
             for _ in range(measure_iters):
                 b = next(it)
                 state, metrics = train_step(state, b.image, b.label)
+                if fence:
+                    float(metrics['loss'])
             float(metrics['loss'])   # d2h fence (block_until_ready can lie
                                      # through the tunnel; bytes cannot)
             elapsed = time.perf_counter() - start
@@ -383,12 +392,18 @@ def _run_child(name, args, timeout_s):
 
 
 def _jax_backend_responsive(timeout_s):
-    """Probe JAX backend init in a subprocess — a wedged TPU tunnel hangs
-    rather than erroring, and must not take the whole benchmark down."""
+    """Probe JAX backend init AND a real transfer round-trip in a subprocess.
+
+    A wedged TPU tunnel hangs rather than erroring — and one observed wedge
+    mode passes ``jax.devices()`` while every ``device_put`` hangs, so the
+    probe must move actual bytes (h2d + d2h) to certify the device usable.
+    """
+    probe = ('import jax, numpy as np; jax.devices(); '
+             'x = jax.device_put(np.ones((1 << 20,), np.uint8)); '
+             'assert int(x.sum()) == (1 << 20); print("ok")')
     try:
-        proc = subprocess.run(
-            [sys.executable, '-c', 'import jax; jax.devices(); print("ok")'],
-            timeout=timeout_s, capture_output=True)
+        proc = subprocess.run([sys.executable, '-c', probe],
+                              timeout=timeout_s, capture_output=True)
         return proc.returncode == 0
     except subprocess.TimeoutExpired:
         return False

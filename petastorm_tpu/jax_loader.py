@@ -437,16 +437,27 @@ class JaxLoader(object):
         axis (override via ``sharding``).
     :param sharding: explicit ``NamedSharding`` (or dict field->sharding).
     :param prefetch: device batches staged ahead (double-buffering default 2).
+        ``0`` disables the background staging thread entirely: host batches
+        are assembled ahead by the reader's worker pool as usual, but the
+        ``device_put`` happens inline in the consumer thread. Use on
+        interconnects where background transfers interleaved with compute
+        are pathological (see docs/troubleshoot.rst).
     :param shape_policies: dict field -> ShapePolicy for ragged fields.
     :param last_batch: 'drop' (pod-safe default) | 'pad' | 'partial'.
     :param strict_fields: raise (instead of warn-and-drop) when a selected
         field cannot batch — e.g. declared nullable but never actually null.
+    :param echo: data echoing (Choi et al., "Faster Neural Network Training
+        with Data Echoing"): deliver each staged batch ``echo`` times. When
+        the pipeline is input-bound (``input_stall_frac`` high) echoed
+        repeats trade statistical efficiency for step throughput — the chip
+        trains instead of idling. Epoch/checkpoint accounting counts source
+        rows once; ``stats['batches']`` counts echoed deliveries.
     """
 
     def __init__(self, reader, batch_size, mesh=None, sharding=None,
                  batch_axis='data', prefetch=2, shape_policies=None,
                  shuffling_queue_capacity=0, min_after_dequeue=None, seed=None,
-                 last_batch='drop', strict_fields=False):
+                 last_batch='drop', strict_fields=False, echo=1):
         import jax
 
         self._reader = reader
@@ -477,6 +488,8 @@ class JaxLoader(object):
         # NOT counted consumed and re-deliver on resume).
         self._row_granular_ckpt = False
         self._defer_rows_consumed = False   # superbatches() group accounting
+        self._pending_fresh_rows = 0        # fresh rows fetched but not yet
+                                            # attributed (deferred mode)
         if not shuffling_queue_capacity and hasattr(reader, 'enable_row_granular_checkpoint'):
             self._row_granular_ckpt = reader.enable_row_granular_checkpoint()
 
@@ -486,6 +499,12 @@ class JaxLoader(object):
             min_after_dequeue=min_after_dequeue, seed=seed,
             last_batch=last_batch, x64=x64, strict_fields=strict_fields)
 
+        if echo < 1:
+            raise ValueError('echo must be >= 1, got {}'.format(echo))
+        self._echo = int(echo)
+        self._echo_left = 0
+        self._echo_item = None
+        self._consumer_staging = prefetch == 0
         self._queue = queue.Queue(maxsize=max(1, prefetch))
         self._stop = threading.Event()
         self._exhausted = False
@@ -504,8 +523,11 @@ class JaxLoader(object):
         except Exception:  # noqa: BLE001 - backend probe must not kill init
             self._dlpack_staging = False
         # Start the stager LAST: it touches the state above immediately.
-        self._thread = threading.Thread(target=self._stage_loop, daemon=True)
-        self._thread.start()
+        if self._consumer_staging:
+            self._thread = None
+        else:
+            self._thread = threading.Thread(target=self._stage_loop, daemon=True)
+            self._thread.start()
 
     # -- staging thread --------------------------------------------------
 
@@ -575,7 +597,24 @@ class JaxLoader(object):
         t0 = time.perf_counter()
         if self._first_get_t is None:
             self._first_get_t = t0
-        item = self._queue.get()
+        fresh = True
+        if self._echo_left > 0:
+            self._echo_left -= 1
+            item = self._echo_item
+            fresh = False   # source rows already counted on first delivery
+        else:
+            if self._consumer_staging:
+                try:
+                    item = self._stage(next(self._host_iter))
+                except StopIteration:
+                    item = _END
+                except Exception as e:  # noqa: BLE001 - match staged path
+                    item = e
+            else:
+                item = self._queue.get()
+            if self._echo > 1 and isinstance(item, dict):
+                self._echo_item = item
+                self._echo_left = self._echo - 1
         self._wait_s += time.perf_counter() - t0
         if item is _END:
             self._exhausted = True
@@ -589,11 +628,17 @@ class JaxLoader(object):
             nt = namedtuple('JaxBatch', names)
             self._namedtuple_cache[names] = nt
         self._batches_delivered += 1
-        if self._row_granular_ckpt and not self._defer_rows_consumed:
+        if self._row_granular_ckpt and fresh:
             # A padded final batch over-reports by the pad amount; the
             # attribution FIFO simply drains empty, which is correct (the
-            # padded copies duplicate rows already attributed).
-            self._reader.rows_consumed(self._local_batch)
+            # padded copies duplicate rows already attributed). Echoed
+            # re-deliveries are not fresh source rows and are never counted.
+            if self._defer_rows_consumed:
+                # superbatches(): attribution happens when the full group is
+                # yielded, and only for the fresh rows actually in it.
+                self._pending_fresh_rows += self._local_batch
+            else:
+                self._reader.rows_consumed(self._local_batch)
         return nt(**{k: item[k] for k in names})
 
     def superbatches(self, k):
@@ -615,22 +660,32 @@ class JaxLoader(object):
         import jax.numpy as jnp
         concat = jax.jit(lambda *xs: jnp.concatenate(xs))
         it = iter(self)
-        self._defer_rows_consumed = True
-        try:
-            while True:
-                parts = []
-                try:
-                    for _ in range(k):
-                        parts.append(next(it))
-                except StopIteration:
-                    return
-                if self._row_granular_ckpt:
-                    self._reader.rows_consumed(k * self._local_batch)
-                yield parts[0]._replace(
-                    **{f: concat(*[getattr(p, f) for p in parts])
-                       for f in parts[0]._fields})
-        finally:
-            self._defer_rows_consumed = False
+
+        def fetch():
+            # Deferral is scoped to this call alone, so interleaved direct
+            # loader iteration (or an abandoned generator) keeps normal
+            # immediate accounting.
+            self._defer_rows_consumed = True
+            try:
+                return next(it)
+            finally:
+                self._defer_rows_consumed = False
+
+        while True:
+            parts = []
+            try:
+                for _ in range(k):
+                    parts.append(fetch())
+            except StopIteration:
+                # Partial tail group: dropped, and its fresh rows stay
+                # unattributed — they re-deliver on resume.
+                return
+            if self._row_granular_ckpt and self._pending_fresh_rows:
+                self._reader.rows_consumed(self._pending_fresh_rows)
+                self._pending_fresh_rows = 0
+            yield parts[0]._replace(
+                **{f: concat(*[getattr(p, f) for p in parts])
+                   for f in parts[0]._fields})
 
     def reset_stats(self):
         """Zero the stall counters — call after warmup so ``stats`` reflects
@@ -696,7 +751,8 @@ class JaxLoader(object):
                 self._queue.get_nowait()
         except queue.Empty:
             pass
-        self._thread.join(timeout=10)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
         self._reader.stop()
         self._reader.join()
 

@@ -248,3 +248,55 @@ def test_superbatches(synthetic_dataset):
     assert supers[0].matrix.shape == (15, 4, 5)
     ids = np.concatenate([np.asarray(s.id) for s in supers])
     assert sorted(ids.tolist()) == list(range(45))
+
+
+def test_prefetch_zero_consumer_staging(synthetic_dataset):
+    """prefetch=0: no staging thread; device_put happens in the consumer."""
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    with make_tensor_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                            reader_pool_type='dummy',
+                            shuffle_row_groups=False) as reader:
+        with JaxLoader(reader, 10, prefetch=0, last_batch='drop') as loader:
+            assert loader._thread is None
+            ids = []
+            for b in loader:
+                ids.append(np.asarray(b.id))
+    assert sorted(np.concatenate(ids).tolist()) == list(range(50))
+
+
+def test_data_echoing(synthetic_dataset):
+    """echo=2 delivers every staged batch twice; source rows counted once."""
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    with make_tensor_reader(synthetic_dataset.url, schema_fields=['id'],
+                            reader_pool_type='dummy', num_epochs=1,
+                            shuffle_row_groups=False) as reader:
+        with JaxLoader(reader, 10, echo=2, last_batch='drop') as loader:
+            batches = [np.asarray(b.id) for b in loader]
+            state = loader.state_dict()
+    assert len(batches) == 10  # 5 source batches x 2 echoes
+    for i in range(0, 10, 2):
+        np.testing.assert_array_equal(batches[i], batches[i + 1])
+    # all 50 source rows delivered exactly once (echo aside)
+    unique = np.unique(np.concatenate(batches))
+    assert sorted(unique.tolist()) == list(range(50))
+    # checkpoint counted each source row once: epoch complete
+    assert all(e['done'] == 1 for e in state['keys'].values())
+
+
+def test_echo_with_superbatches(synthetic_dataset):
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    with make_tensor_reader(synthetic_dataset.url, schema_fields=['id'],
+                            reader_pool_type='dummy', num_epochs=1,
+                            shuffle_row_groups=False) as reader:
+        with JaxLoader(reader, 5, echo=2, prefetch=0,
+                       last_batch='drop') as loader:
+            groups = [np.asarray(g.id) for g in loader.superbatches(2)]
+    # 10 source batches x2 echoes = 20 deliveries -> 10 groups of 2
+    assert len(groups) == 10
+    assert all(g.shape == (10,) for g in groups)

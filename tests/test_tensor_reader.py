@@ -253,3 +253,42 @@ def test_cached_transform_does_not_corrupt_cache(synthetic_dataset):
         assert len(values) == 3
         for v in values:
             np.testing.assert_allclose(v, by_id[i]['matrix'] * 2.0, rtol=1e-6)
+
+
+def test_local_disk_cache(synthetic_dataset, tmp_path):
+    """Decoded chunks round-trip through the NVMe cache tier (pickle of the
+    block dict); second epoch is served from disk."""
+    cache_dir = str(tmp_path / 'cache')
+    ids = []
+    with make_tensor_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                            reader_pool_type='dummy', num_epochs=2,
+                            cache_type='local-disk', cache_location=cache_dir,
+                            shuffle_row_groups=False) as r:
+        for chunk in r:
+            ids.extend(chunk.id.tolist())
+    assert sorted(ids) == sorted(list(range(50)) * 2)
+    import os
+    assert any(f.endswith('.pkl') for f in os.listdir(cache_dir))
+
+
+def test_weighted_sampling_over_tensor_readers(synthetic_dataset):
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+    r1 = make_tensor_reader(synthetic_dataset.url, schema_fields=['id'],
+                            reader_pool_type='dummy', num_epochs=None, seed=0)
+    r2 = make_tensor_reader(synthetic_dataset.url, schema_fields=['id'],
+                            reader_pool_type='dummy', num_epochs=None, seed=1)
+    with WeightedSamplingReader([r1, r2], [0.5, 0.5]) as mixed:
+        assert mixed.batched_output
+        chunks = [next(mixed) for _ in range(6)]
+    assert all(len(c.id) for c in chunks)
+
+
+def test_reset_after_epoch(synthetic_dataset):
+    with make_tensor_reader(synthetic_dataset.url, schema_fields=['id'],
+                            reader_pool_type='dummy', num_epochs=1,
+                            shuffle_row_groups=False) as r:
+        first = [i for chunk in r for i in chunk.id.tolist()]
+        r.reset()
+        second = [i for chunk in r for i in chunk.id.tolist()]
+    assert sorted(first) == sorted(second) == list(range(50))
