@@ -1,4 +1,4 @@
-"""Blocked (flash) attention as a Pallas TPU kernel.
+"""Blocked (flash) attention as Pallas TPU kernels (forward + backward).
 
 Single-device exact attention without materializing the ``[T, T]`` score
 matrix: a 3-D grid ``(batch*heads, q_blocks, kv_blocks)`` streams one
@@ -6,15 +6,18 @@ matrix: a 3-D grid ``(batch*heads, q_blocks, kv_blocks)`` streams one
 step — VMEM use is O(block) regardless of sequence length, so context is
 bounded by HBM, not VMEM. The online softmax (running max / normalizer)
 lives in VMEM scratch that persists across the kv-block axis (TPU grids
-execute sequentially, innermost axis fastest), and both matmuls per step
-run on the MXU. Role parity: the attention compute the reference's training
+execute sequentially, innermost axis fastest), and every matmul runs on the
+MXU. The backward is two more Pallas passes (dq over kv blocks; dk+dv over
+q blocks) that reconstruct ``P = exp(S - lse)`` tile by tile from the
+logsumexp rows the training forward saves — O(block) memory in both
+directions. Role parity: the attention compute the reference's training
 stacks get from fused CUDA kernels — rebuilt the TPU way.
 
 Composes with :mod:`petastorm_tpu.models.attention`: ring attention shards
 the sequence across a mesh axis and rotates kv blocks over ICI; within a
 device, this kernel is the block compute. On non-TPU backends
 ``flash_attention`` falls back to the pure-XLA reference; ``interpret=True``
-runs the Pallas interpreter instead — how the tests validate the kernel
+runs the Pallas interpreter instead — how the tests validate the kernels
 without TPU hardware.
 """
 
@@ -26,19 +29,63 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30  # large-finite: -inf breaks the running-max rescale at init
 
-_LANES = 128     # VPU lane width: scratch vectors live broadcast over lanes
+_LANES = 128     # VPU lane width: in-kernel scratch vectors are lane-broadcast
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  block_q, block_k, seq_len, causal, scale):
+def _block_mask(qi, ki, block_q, block_k, seq_len, causal):
+    """[block_q, block_k] validity mask: kv tail padding + causal triangle."""
+    k_pos = ki * block_k + jax.lax.iota(jnp.int32, block_k)
+    mask = k_pos[None, :] < seq_len
+    if causal:
+        q_pos = qi * block_q + jax.lax.iota(jnp.int32, block_q)
+        mask = mask & (q_pos[:, None] >= k_pos[None, :])
+    return mask
+
+
+def _recompute_p(q_scaled, k_blk, lse_vec, qi, ki, block_q, block_k, seq_len,
+                 causal):
+    """Rebuild this tile's probabilities ``P = exp(S - lse)`` (backward)."""
+    s = jax.lax.dot_general(q_scaled, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    mask = _block_mask(qi, ki, block_q, block_k, seq_len, causal)
+    return jnp.where(mask, jnp.exp(s - lse_vec[:, None]), 0.0)
+
+
+def _to_bhtd(x, t_pad):
+    """[B, T, H, D] -> padded [B*H, T_pad, D]."""
+    b, t, h, d = x.shape
+    x = jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
+    if t_pad != t:
+        x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
+    return x
+
+
+def _pad_plan(t, block_q, block_k):
+    """(block_q, block_k, t_pad) with blocks clamped and t padded to their lcm."""
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    lcm = block_q * block_k // math.gcd(block_q, block_k)
+    return block_q, block_k, -(-t // lcm) * lcm
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q, block_k,
+                  seq_len, causal, scale, emit_lse):
     """One grid step: one (block_q, d) query tile x one (block_k, d) kv tile.
 
     acc/m/l scratch persists across the kv axis (axis 2, innermost): init at
-    ki == 0, accumulate every step, normalize + store to ``o_ref`` at the
-    last ki. m/l are kept lane-broadcast ``[block_q, _LANES]`` to respect
-    TPU vector tiling.
+    ki == 0, accumulate every step, normalize + store at the last ki. m/l
+    are lane-broadcast ``[block_q, _LANES]`` to respect TPU vector tiling.
     """
     import jax.experimental.pallas as pl
+
+    if emit_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        lse_ref, (acc_ref, m_ref, l_ref) = None, rest
 
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -61,11 +108,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         v_blk = v_ref[...].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        k_pos = ki * block_k + jax.lax.iota(jnp.int32, block_k)
-        mask = k_pos[None, :] < seq_len                   # padded kv tail
-        if causal:
-            q_pos = qi * block_q + jax.lax.iota(jnp.int32, block_q)
-            mask = mask & (q_pos[:, None] >= k_pos[None, :])
+        mask = _block_mask(qi, ki, block_q, block_k, seq_len, causal)
         s = jnp.where(mask, s, NEG_INF)
         m_prev = m_ref[:, 0]
         m_new = jnp.maximum(m_prev, s.max(axis=-1))
@@ -85,10 +128,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l = l_ref[:, 0]
         l = jnp.where(l == 0.0, 1.0, l)                   # fully masked rows
         o_ref[...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+        if emit_lse:
+            # logsumexp rows: the backward kernels reconstruct P without
+            # re-running the online softmax.
+            lse_ref[...] = m_ref[:, 0] + jnp.log(l)
 
 
-def _flash_bhtd(q, k, v, seq_len, causal, block_q, block_k, interpret):
-    """q/k/v ``[BH, T_pad, D]`` (T_pad divisible by both blocks) -> same."""
+def _flash_bhtd(q, k, v, seq_len, causal, block_q, block_k, interpret,
+                emit_lse):
+    """Padded ``[BH, T_pad, D]`` -> ``out`` (+ ``lse [BH, T_pad]`` when
+    ``emit_lse`` — the training forward; inference skips the write)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -96,8 +145,16 @@ def _flash_bhtd(q, k, v, seq_len, causal, block_q, block_k, interpret):
     scale = 1.0 / math.sqrt(d)
     grid = (bh, t_pad // block_q, t_pad // block_k)
     kernel = functools.partial(_flash_kernel, block_q=block_q, block_k=block_k,
-                               seq_len=seq_len, causal=causal, scale=scale)
-    return pl.pallas_call(
+                               seq_len=seq_len, causal=causal, scale=scale,
+                               emit_lse=emit_lse)
+    # o/lse blocks ignore ki: revisited across the kv axis, written at the
+    # last ki only.
+    out_specs = [pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0))]
+    out_shape = [jax.ShapeDtypeStruct((bh, t_pad, d), q.dtype)]
+    if emit_lse:
+        out_specs.append(pl.BlockSpec((None, block_q), lambda b, qi, ki: (b, qi)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, t_pad), jnp.float32))
+    out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -105,10 +162,8 @@ def _flash_bhtd(q, k, v, seq_len, causal, block_q, block_k, interpret):
             pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
         ],
-        # o block ignores ki: it is revisited across the kv axis and written
-        # once at the last ki.
-        out_specs=pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),       # acc
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
@@ -116,24 +171,172 @@ def _flash_bhtd(q, k, v, seq_len, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
+    return (out[0], out[1]) if emit_lse else (out[0], None)
 
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
+                     acc_ref, *, block_q, block_k, seq_len, causal, scale):
+    """dQ pass: grid (bh, q_blocks, kv_blocks); dq accumulates across ki.
+
+    dS = P * (dO V^T - D);  dQ = scale * dS K, with D = rowsum(dO * O).
+    """
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    needed = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k_blk = k_ref[...].astype(jnp.float32)
+        v_blk = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        p = _recompute_p(q, k_blk, lse_ref[...], qi, ki, block_q, block_k,
+                         seq_len, causal)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dd_ref[...][:, None])
+        acc_ref[...] += scale * jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[...] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                      dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
+                      block_q, block_k, seq_len, causal, scale):
+    """dK/dV pass: grid (bh, kv_blocks, q_blocks); accumulates across qi.
+
+    dV = P^T dO;  dK = dS^T (scale * Q).
+    """
+    import jax.experimental.pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    needed = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k_blk = k_ref[...].astype(jnp.float32)
+        v_blk = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        p = _recompute_p(q, k_blk, lse_ref[...], qi, ki, block_q, block_k,
+                         seq_len, causal)
+        dv_acc_ref[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dd_ref[...][:, None])
+        dk_acc_ref[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[...] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_bhtd(q, k, v, do, lse, dd, seq_len, causal, block_q, block_k,
+                    interpret):
+    """Backward over padded ``[BH, T_pad, D]`` tensors -> (dq, dk, dv)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t_pad, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, block_q=block_q, block_k=block_k,
+                          seq_len=seq_len, causal=causal, scale=scale),
+        grid=(bh, t_pad // block_q, t_pad // block_k),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((None, block_q), lambda b, qi, ki: (b, qi)),
+            pl.BlockSpec((None, block_q), lambda b, qi, ki: (b, qi)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, dd)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, block_q=block_q, block_k=block_k,
+                          seq_len=seq_len, causal=causal, scale=scale),
+        grid=(bh, t_pad // block_k, t_pad // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((None, block_q), lambda b, ki, qi: (b, qi)),
+            pl.BlockSpec((None, block_q), lambda b, ki, qi: (b, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t_pad, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, dd)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public entry + custom vjp
+# --------------------------------------------------------------------------
 
 def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
                     interpret=None):
     """Exact multi-head attention, ``[B, T, H, D]`` -> ``[B, T, H, D]``.
 
-    On TPU backends this runs the Pallas blocked kernel; on other backends
+    On TPU backends this runs the Pallas blocked kernels; on other backends
     it falls back to the XLA reference unless ``interpret=True`` forces the
     Pallas interpreter. ``block_q``/``block_k`` are clamped to the sequence
     length; sequences are zero-padded up to a block multiple and the pad is
     masked/stripped (padding tolerance is what lets ring attention hand this
     kernel arbitrary per-device slice lengths).
 
-    Differentiable: the backward pass recomputes attention through the XLA
-    reference under ``jax.vjp`` (O(T^2) memory on the backward only). For
-    contexts where that matters, train through ring attention
-    (``models.attention.ring_self_attention``), which is natively
-    differentiable and sequence-sharded.
+    Differentiable end to end in O(block) memory: the training forward saves
+    the logsumexp rows and the backward runs two more Pallas passes (a dq
+    pass over kv blocks and a dk/dv pass over q blocks) that reconstruct
+    ``P = exp(S - lse)`` tile by tile — no ``[T, T]`` materialization in
+    either direction. The inference (non-differentiated) path skips the lse
+    write entirely.
     """
     if interpret is None:
         if jax.devices()[0].platform != 'tpu':
@@ -145,38 +348,48 @@ def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_diff(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_pallas(q, k, v, causal, block_q, block_k, interpret,
+                           emit_lse=False)
+    return out
 
 
 def _flash_diff_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_pallas(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    out, lse = _flash_pallas(q, k, v, causal, block_q, block_k, interpret,
+                             emit_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_diff_bwd(causal, block_q, block_k, interpret, residuals, g):
-    from petastorm_tpu.models.attention import dense_attention
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda a, b, c: dense_attention(a, b, c, causal=causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    b, t, h, d = q.shape
+    block_q, block_k, t_pad = _pad_plan(t, block_q, block_k)
+
+    # D = rowsum(dO * O): cheap elementwise+reduce, left to XLA.
+    dd = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    dd = jnp.moveaxis(dd, 2, 1).reshape(b * h, t)   # [BH, T]
+    if t_pad != t:
+        # lse is already padded (saved at the forward's padded length).
+        dd = jnp.pad(dd, ((0, 0), (0, t_pad - t)))
+
+    dq, dk, dv = _flash_bwd_bhtd(
+        _to_bhtd(q, t_pad), _to_bhtd(k, t_pad), _to_bhtd(v, t_pad),
+        _to_bhtd(g, t_pad), lse, dd, t, causal, block_q, block_k, interpret)
+
+    def from_bhtd(x):
+        return jnp.moveaxis(x[:, :t].reshape(b, h, t, d), 1, 2)
+
+    return from_bhtd(dq), from_bhtd(dk), from_bhtd(dv)
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
-def _flash_pallas(q, k, v, causal, block_q, block_k, interpret):
+def _flash_pallas(q, k, v, causal, block_q, block_k, interpret, emit_lse):
+    """Returns ``(out [B,T,H,D], lse [BH, T_pad] | None)``."""
     b, t, h, d = q.shape
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
-    lcm = block_q * block_k // math.gcd(block_q, block_k)
-    t_pad = -(-t // lcm) * lcm
-
-    def to_bhtd(x):
-        x = jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
-        if t_pad != t:
-            x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
-        return x
-
-    out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), t, causal,
-                      block_q, block_k, interpret)
+    block_q, block_k, t_pad = _pad_plan(t, block_q, block_k)
+    out, lse = _flash_bhtd(_to_bhtd(q, t_pad), _to_bhtd(k, t_pad),
+                           _to_bhtd(v, t_pad), t, causal, block_q, block_k,
+                           interpret, emit_lse)
     out = out[:, :t]
-    return jnp.moveaxis(out.reshape(b, h, t, d), 1, 2)
+    return jnp.moveaxis(out.reshape(b, h, t, d), 1, 2), lse
