@@ -32,9 +32,20 @@ def _param_spec(path, value, mesh):
 
 
 def create_train_state(rng, model, input_shape, mesh=None, learning_rate=1e-3,
-                       momentum=0.9, tx=None):
-    """Initialize (optionally mesh-sharded) training state."""
-    variables = model.init(rng, jnp.ones(input_shape, jnp.float32), train=False)
+                       momentum=0.9, tx=None, param_spec_fn=None,
+                       example_input=None):
+    """Initialize (optionally mesh-sharded) training state.
+
+    :param param_spec_fn: ``(path, value, mesh) -> PartitionSpec`` sharding
+        rule; defaults to :func:`_param_spec` (classifier-head tensor
+        parallelism). Use ``transformer_param_spec`` for Megatron-style TP
+        over a TransformerLM.
+    :param example_input: exact init input (defaults to
+        ``jnp.ones(input_shape, float32)`` — pass int token arrays for LMs).
+    """
+    if example_input is None:
+        example_input = jnp.ones(input_shape, jnp.float32)
+    variables = model.init(rng, example_input, train=False)
     params = variables['params']
     batch_stats = variables.get('batch_stats')
     if tx is None:
@@ -42,10 +53,50 @@ def create_train_state(rng, model, input_shape, mesh=None, learning_rate=1e-3,
     state = TrainState.create(apply_fn=model.apply, params=params, tx=tx,
                               batch_stats=batch_stats)
     if mesh is not None:
+        spec_fn = param_spec_fn or _param_spec
+
         def place(path, leaf):
-            return jax.device_put(leaf, NamedSharding(mesh, _param_spec(path, leaf, mesh)))
+            return jax.device_put(leaf, NamedSharding(mesh, spec_fn(path, leaf, mesh)))
         state = jax.tree_util.tree_map_with_path(place, state)
     return state
+
+
+def transformer_param_spec(path, value, mesh):
+    """Megatron-style tensor parallelism for :class:`TransformerLM`.
+
+    Over the mesh's 'model' axis: attention q/k/v projections shard by head,
+    the attention output projection by its head input, the MLP up-projection
+    by its (4x) output features and the down-projection by its input
+    features, and the vocabulary head by vocab. Everything else (embeddings,
+    norms, biases) replicates. XLA inserts the activation all-reduces from
+    these annotations — the scaling-book recipe, no hand-rolled collectives.
+    """
+    if mesh is None or 'model' not in mesh.axis_names:
+        return PartitionSpec()
+    names = [str(getattr(p, 'key', getattr(p, 'name', ''))) for p in path]
+    joined = '/'.join(names)
+    if names[-1] != 'kernel':
+        return PartitionSpec()
+    n_model = mesh.shape['model']
+
+    def fits(dim):
+        return value.shape[dim] % n_model == 0
+
+    if ('attn/query' in joined or 'attn/key' in joined
+            or 'attn/value' in joined) and value.ndim == 3 and fits(1):
+        return PartitionSpec(None, 'model', None)      # [d_model, H, Dh]
+    if 'attn/out' in joined and value.ndim == 3 and fits(0):
+        return PartitionSpec('model', None, None)      # [H, Dh, d_model]
+    if 'head' in names and value.ndim == 2 and fits(1):
+        return PartitionSpec(None, 'model')            # [d_model, vocab]
+    # The Block MLP pair, matched by path (never by shape, which would
+    # mis-shard unrelated future Dense layers): Dense_0 is the column-
+    # parallel up-projection, Dense_1 the row-parallel down-projection.
+    if 'Dense_0' in names and value.ndim == 2 and fits(1):
+        return PartitionSpec(None, 'model')            # [d, ratio*d]
+    if 'Dense_1' in names and value.ndim == 2 and fits(0):
+        return PartitionSpec('model', None)            # [ratio*d, d]
+    return PartitionSpec()
 
 
 def make_train_step(mesh=None, batch_axis='data'):

@@ -131,12 +131,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q, block_k,
         if emit_lse:
             # logsumexp rows: the backward kernels reconstruct P without
             # re-running the online softmax.
-            lse_ref[...] = m_ref[:, 0] + jnp.log(l)
+            lse_ref[...] = (m_ref[:, 0] + jnp.log(l))[None, :]
 
 
 def _flash_bhtd(q, k, v, seq_len, causal, block_q, block_k, interpret,
                 emit_lse):
-    """Padded ``[BH, T_pad, D]`` -> ``out`` (+ ``lse [BH, T_pad]`` when
+    """Padded ``[BH, T_pad, D]`` -> ``out`` (+ ``lse [BH, nq, block_q]`` when
     ``emit_lse`` — the training forward; inference skips the write)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -152,8 +152,13 @@ def _flash_bhtd(q, k, v, seq_len, causal, block_q, block_k, interpret,
     out_specs = [pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0))]
     out_shape = [jax.ShapeDtypeStruct((bh, t_pad, d), q.dtype)]
     if emit_lse:
-        out_specs.append(pl.BlockSpec((None, block_q), lambda b, qi, ki: (b, qi)))
-        out_shape.append(jax.ShapeDtypeStruct((bh, t_pad), jnp.float32))
+        # [BH, n_qblocks, block_q]: same bytes as [BH, T_pad], but each block
+        # is rank-2 with block_q on the lane axis — layouts Mosaic tiles
+        # natively (a rank-1 (block_q,) block is interpreter-only territory).
+        out_specs.append(pl.BlockSpec((None, 1, block_q),
+                                      lambda b, qi, ki: (b, qi, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, t_pad // block_q, block_q),
+                                              jnp.float32))
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -202,11 +207,11 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
         k_blk = k_ref[...].astype(jnp.float32)
         v_blk = v_ref[...].astype(jnp.float32)
         do = do_ref[...].astype(jnp.float32)
-        p = _recompute_p(q, k_blk, lse_ref[...], qi, ki, block_q, block_k,
+        p = _recompute_p(q, k_blk, lse_ref[0], qi, ki, block_q, block_k,
                          seq_len, causal)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - dd_ref[...][:, None])
+        ds = p * (dp - dd_ref[0][:, None])
         acc_ref[...] += scale * jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -242,14 +247,14 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         k_blk = k_ref[...].astype(jnp.float32)
         v_blk = v_ref[...].astype(jnp.float32)
         do = do_ref[...].astype(jnp.float32)
-        p = _recompute_p(q, k_blk, lse_ref[...], qi, ki, block_q, block_k,
+        p = _recompute_p(q, k_blk, lse_ref[0], qi, ki, block_q, block_k,
                          seq_len, causal)
         dv_acc_ref[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - dd_ref[...][:, None])
+        ds = p * (dp - dd_ref[0][:, None])
         dk_acc_ref[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -278,8 +283,8 @@ def _flash_bwd_bhtd(q, k, v, do, lse, dd, seq_len, causal, block_q, block_k,
             pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda b, qi, ki: (b, qi)),
-            pl.BlockSpec((None, block_q), lambda b, qi, ki: (b, qi)),
+            pl.BlockSpec((None, 1, block_q), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t_pad, d), q.dtype),
@@ -296,8 +301,8 @@ def _flash_bwd_bhtd(q, k, v, do, lse, dd, seq_len, causal, block_q, block_k,
             pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
             pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
             pl.BlockSpec((None, block_q, d), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda b, ki, qi: (b, qi)),
-            pl.BlockSpec((None, block_q), lambda b, ki, qi: (b, qi)),
+            pl.BlockSpec((None, 1, block_q), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda b, ki, qi: (b, qi, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
@@ -370,6 +375,7 @@ def _flash_diff_bwd(causal, block_q, block_k, interpret, residuals, g):
     if t_pad != t:
         # lse is already padded (saved at the forward's padded length).
         dd = jnp.pad(dd, ((0, 0), (0, t_pad - t)))
+    dd = dd.reshape(b * h, t_pad // block_q, block_q)
 
     dq, dk, dv = _flash_bwd_bhtd(
         _to_bhtd(q, t_pad), _to_bhtd(k, t_pad), _to_bhtd(v, t_pad),
@@ -385,7 +391,7 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
 def _flash_pallas(q, k, v, causal, block_q, block_k, interpret, emit_lse):
-    """Returns ``(out [B,T,H,D], lse [BH, T_pad] | None)``."""
+    """Returns ``(out [B,T,H,D], lse [BH, n_qblocks, block_q] | None)``."""
     b, t, h, d = q.shape
     block_q, block_k, t_pad = _pad_plan(t, block_q, block_k)
     out, lse = _flash_bhtd(_to_bhtd(q, t_pad), _to_bhtd(k, t_pad),
