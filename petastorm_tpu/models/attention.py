@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
 
-def _ring_attention_local(q, k, v, axis_name, causal):
+def _ring_attention_local(q, k, v, axis_name, causal, varying_axes):
     """Per-device ring attention body.
 
     q, k, v: ``[B, T_local, H, D]`` — this device's sequence slice.
@@ -66,9 +66,11 @@ def _ring_attention_local(q, k, v, axis_name, causal):
     max0 = jnp.full((q.shape[0], q.shape[1], q.shape[2]), -jnp.inf)  # [B,Tq,H]
     denom0 = jnp.zeros_like(max0)
     # The scan carry must be device-varying from step 0: the accumulators are
-    # built from constants, but each step mixes in ppermuted (varying) blocks,
-    # so shard_map's vma check requires the initial carry be cast to varying.
-    out0, max0, denom0 = (jax.lax.pcast(x, axis_name, to='varying')
+    # built from constants, but each step mixes in the (varying) kv blocks,
+    # so shard_map's vma check requires the initial carry be cast varying
+    # over every mesh axis the inputs are mapped over (seq + any batch/head
+    # axes), not just the ring axis.
+    out0, max0, denom0 = (jax.lax.pcast(x, varying_axes, to='varying')
                           for x in (out0, max0, denom0))
     carry = (k, v, my_index, out0, max0, denom0)
     (_, _, _, out, _, denom), _ = jax.lax.scan(step, carry, None,
@@ -77,7 +79,8 @@ def _ring_attention_local(q, k, v, axis_name, causal):
     return (out / denom[..., None]).astype(q.dtype)
 
 
-def ring_self_attention(q, k, v, mesh, seq_axis, causal=False):
+def ring_self_attention(q, k, v, mesh, seq_axis, causal=False,
+                        batch_axis=None, head_axis=None):
     """Exact multi-head attention with q/k/v sequence-sharded over
     ``mesh[seq_axis]``.
 
@@ -85,10 +88,17 @@ def ring_self_attention(q, k, v, mesh, seq_axis, causal=False):
         be sharded (or shardable) over ``seq_axis``.
     :param causal: apply a causal mask using *global* positions, so the
         result matches dense causal attention on the unsharded arrays.
+    :param batch_axis, head_axis: optional mesh axes carrying the batch /
+        head dims. Attention is elementwise over both, so naming them keeps
+        each shard local — leaving them ``None`` on a multi-axis mesh makes
+        shard_map replicate (all-gather) those dims onto every device,
+        re-introducing the full-batch score memory dp/tp exist to divide.
     """
-    spec = PartitionSpec(None, seq_axis, None, None)
+    spec = PartitionSpec(batch_axis, seq_axis, head_axis, None)
+    varying = tuple(a for a in (batch_axis, seq_axis, head_axis)
+                    if a is not None)
     fn = jax.shard_map(partial(_ring_attention_local, axis_name=seq_axis,
-                               causal=causal),
+                               causal=causal, varying_axes=varying),
                        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 

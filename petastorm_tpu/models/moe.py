@@ -1,0 +1,122 @@
+"""Switch-style Mixture-of-Experts MLP with expert parallelism.
+
+The GShard/Switch formulation — the original TPU MoE design: top-1 routing
+becomes dense one-hot dispatch/combine einsums (no gather/scatter, every op
+a static-shaped matmul the MXU likes), and expert parallelism is nothing
+but sharding the expert dimension of the dispatched activations and expert
+weights over a mesh axis — XLA turns the dispatch einsums into all-to-alls
+across that axis. Routing is computed **per group** (one group per batch
+row), so with the batch sharded over 'data' every routing tensor shards
+with it — no cross-data-shard cumsum (GShard's groups exist for exactly
+this). Capacity is static (``capacity_factor``): overflow tokens drop
+(their combine weight is zero; the surrounding residual carries them).
+
+The standard Switch load-balance auxiliary loss is sown under
+``intermediates/aux_loss`` — add it to the training loss (scaled ~1e-2) or
+top-1 routing collapses onto few experts::
+
+    logits, mods = model.apply(vars, x, mutable=['intermediates'])
+    aux = sum(jax.tree_util.tree_leaves(mods['intermediates']))
+
+Role: completes the parallelism families (dp/tp/sp/ep) for the model
+stand-ins; ``expert_param_spec`` composes with
+``models.train.create_train_state``.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+
+class SwitchMoE(nn.Module):
+    """Top-1 routed expert MLP: ``[B, T, d] -> [B, T, d]``.
+
+    :param num_experts: E. Shard over the mesh 'expert' axis via
+        :func:`expert_param_spec` for expert parallelism.
+    :param capacity_factor: per-expert slots per group =
+        ``ceil(T/E * factor)``; overflow tokens pass through with a zero
+        expert contribution (standard Switch behavior).
+    :param expert_axis: optional mesh axis name to constrain the dispatched
+        activations over (pure annotation — XLA places the all-to-alls).
+    """
+
+    num_experts: int
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    mesh: Any = None
+    expert_axis: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        g, s, d = x.shape            # groups (batch rows) x tokens x features
+        e = self.num_experts
+        capacity = max(1, int(-(-s * self.capacity_factor // e)))
+
+        # --- router (float32 for numerics, standard practice) -------------
+        logits = nn.Dense(e, dtype=jnp.float32, name='router')(
+            x.astype(jnp.float32))                          # [G, S, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)             # [G, S]
+        expert_prob = jnp.max(probs, axis=-1)
+        expert_mask = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+
+        # Switch load-balance loss: E * mean_e(frac_tokens_e * mean_prob_e),
+        # minimized at uniform routing. Consumers pull it from
+        # intermediates and add ~1e-2 * aux to the training loss.
+        frac = expert_mask.mean(axis=(0, 1))                # [E]
+        mean_prob = probs.mean(axis=(0, 1))                 # [E]
+        self.sow('intermediates', 'aux_loss', e * jnp.sum(frac * mean_prob))
+
+        # Slot within each (group, expert) capacity buffer — cumsum runs
+        # over the group-local token axis only, so routing math shards with
+        # the batch.
+        position_in_expert = (jnp.cumsum(expert_mask, axis=1) - 1.0) * expert_mask
+        in_capacity = position_in_expert < capacity
+        expert_mask = expert_mask * in_capacity
+        gate = expert_prob[..., None] * expert_mask         # [G, S, E]
+
+        pos = jnp.sum(position_in_expert, axis=-1).astype(jnp.int32)  # [G, S]
+        slot_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
+        dispatch = expert_mask[..., None] * slot_onehot[:, :, None, :]  # [G,S,E,C]
+        combine = gate[..., None] * slot_onehot[:, :, None, :]
+
+        expert_in = jnp.einsum('gsec,gsd->egcd', dispatch,
+                               x.astype(jnp.float32)).astype(self.dtype)
+        if self.mesh is not None and self.expert_axis is not None:
+            expert_in = jax.lax.with_sharding_constraint(
+                expert_in,
+                jax.sharding.NamedSharding(
+                    self.mesh,
+                    PartitionSpec(self.expert_axis, None, None, None)))
+
+        # --- experts: one fused [E, ...] weight pair -----------------------
+        hidden = self.mlp_ratio * d
+        w_up = self.param('w_up', nn.initializers.lecun_normal(),
+                          (e, d, hidden), jnp.float32).astype(self.dtype)
+        w_down = self.param('w_down', nn.initializers.lecun_normal(),
+                            (e, hidden, d), jnp.float32).astype(self.dtype)
+        h = jnp.einsum('egcd,edh->egch', expert_in, w_up)
+        h = nn.gelu(h)
+        expert_out = jnp.einsum('egch,ehd->egcd', h, w_down)
+
+        out = jnp.einsum('gsec,egcd->gsd', combine,
+                         expert_out.astype(jnp.float32))
+        return out.astype(self.dtype)
+
+
+def expert_param_spec(path, value, mesh):
+    """Sharding rule: expert-stacked weights shard over 'expert'; composes
+    with ``transformer_param_spec`` by falling back to it for non-MoE
+    params."""
+    from petastorm_tpu.models.train import transformer_param_spec
+    if mesh is None or 'expert' not in mesh.axis_names:
+        return transformer_param_spec(path, value, mesh)
+    names = [str(getattr(p, 'key', getattr(p, 'name', ''))) for p in path]
+    if names and names[-1] in ('w_up', 'w_down') \
+            and value.shape[0] % mesh.shape['expert'] == 0:
+        return PartitionSpec('expert', None, None)
+    return transformer_param_spec(path, value, mesh)

@@ -48,8 +48,21 @@ class MultiHeadAttention(nn.Module):
             if self.mesh is None or self.seq_axis is None:
                 raise ValueError("attention='ring' needs mesh= and seq_axis=")
             from petastorm_tpu.models.attention import ring_self_attention
+            # Keep batch/head shards local inside the shard_map: 'data'
+            # carries the batch; 'model' carries heads — each only when it
+            # evenly divides the (static) dim, so e.g. an init trace with
+            # batch 1 falls back to replication for that trace alone.
+            axes = set(self.mesh.axis_names)
+            batch_axis = ('data' if 'data' in axes
+                          and q.shape[0] % self.mesh.shape['data'] == 0
+                          else None)
+            head_axis = ('model' if 'model' in axes
+                         and self.num_heads % self.mesh.shape['model'] == 0
+                         else None)
             out = ring_self_attention(q, k, v, self.mesh, self.seq_axis,
-                                      causal=self.causal)
+                                      causal=self.causal,
+                                      batch_axis=batch_axis,
+                                      head_axis=head_axis)
         elif self.attention == 'flash':
             from petastorm_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=self.causal)
@@ -70,6 +83,8 @@ class Block(nn.Module):
     attention: str = 'dense'
     mesh: Any = None
     seq_axis: Optional[str] = None
+    moe_experts: int = 0                # >0: SwitchMoE replaces the MLP
+    expert_axis: Optional[str] = None
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -81,9 +96,16 @@ class Block(nn.Module):
                                dtype=self.dtype, name='attn')(y)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        y = nn.Dense(d_model * self.mlp_ratio, dtype=self.dtype)(y)
-        y = nn.gelu(y)
-        y = nn.Dense(d_model, dtype=self.dtype)(y)
+        if self.moe_experts > 0:
+            from petastorm_tpu.models.moe import SwitchMoE
+            y = SwitchMoE(num_experts=self.moe_experts,
+                          mlp_ratio=self.mlp_ratio, mesh=self.mesh,
+                          expert_axis=self.expert_axis, dtype=self.dtype,
+                          name='moe')(y)
+        else:
+            y = nn.Dense(d_model * self.mlp_ratio, dtype=self.dtype)(y)
+            y = nn.gelu(y)
+            y = nn.Dense(d_model, dtype=self.dtype)(y)
         return x + y
 
 
@@ -98,6 +120,8 @@ class TransformerLM(nn.Module):
     attention: str = 'dense'
     mesh: Any = None
     seq_axis: Optional[str] = None
+    moe_experts: int = 0                # >0: Switch MoE MLPs (expert parallel
+    expert_axis: Optional[str] = None   # over this mesh axis)
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -114,7 +138,8 @@ class TransformerLM(nn.Module):
         x = x + pos
         for i in range(self.num_layers):
             x = Block(self.num_heads, attention=self.attention, mesh=self.mesh,
-                      seq_axis=self.seq_axis, dtype=self.dtype,
+                      seq_axis=self.seq_axis, moe_experts=self.moe_experts,
+                      expert_axis=self.expert_axis, dtype=self.dtype,
                       name='block_{}'.format(i))(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=self.dtype, name='head')(x)
