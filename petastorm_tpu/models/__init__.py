@@ -8,4 +8,5 @@ equivalents: flax models consumed through ``jax_loader`` with mesh sharding.
 from petastorm_tpu.models.mlp import MLP  # noqa: F401
 from petastorm_tpu.models.resnet import ResNet, ResNet18, ResNet50  # noqa: F401
 from petastorm_tpu.models.moe import SwitchMoE  # noqa: F401
+from petastorm_tpu.models.pipeline import pipeline_apply  # noqa: F401
 from petastorm_tpu.models.transformer import TransformerLM  # noqa: F401

@@ -94,10 +94,16 @@ class SwitchMoE(nn.Module):
                     PartitionSpec(self.expert_axis, None, None, None)))
 
         # --- experts: one fused [E, ...] weight pair -----------------------
+        # batch_axis=0: the expert dim is a batch of independent matrices,
+        # NOT receptive field — plain lecun_normal on [E, d, h] would scale
+        # by fan_in = E*d and under-initialize every expert by sqrt(E).
+        expert_init = nn.initializers.variance_scaling(
+            1.0, 'fan_in', 'truncated_normal', in_axis=-2, out_axis=-1,
+            batch_axis=(0,))
         hidden = self.mlp_ratio * d
-        w_up = self.param('w_up', nn.initializers.lecun_normal(),
+        w_up = self.param('w_up', expert_init,
                           (e, d, hidden), jnp.float32).astype(self.dtype)
-        w_down = self.param('w_down', nn.initializers.lecun_normal(),
+        w_down = self.param('w_down', expert_init,
                             (e, hidden, d), jnp.float32).astype(self.dtype)
         h = jnp.einsum('egcd,edh->egch', expert_in, w_up)
         h = nn.gelu(h)

@@ -28,6 +28,8 @@ class MultiHeadAttention(nn.Module):
     causal: bool = True
     mesh: Any = None                    # required for 'ring'
     seq_axis: Optional[str] = None      # mesh axis name for 'ring'
+    batch_axis: Optional[str] = 'data'  # mesh axis carrying the batch (ring)
+    head_axis: Optional[str] = 'model'  # mesh axis carrying the heads (ring)
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -48,17 +50,18 @@ class MultiHeadAttention(nn.Module):
             if self.mesh is None or self.seq_axis is None:
                 raise ValueError("attention='ring' needs mesh= and seq_axis=")
             from petastorm_tpu.models.attention import ring_self_attention
-            # Keep batch/head shards local inside the shard_map: 'data'
-            # carries the batch; 'model' carries heads — each only when it
+            # Keep batch/head shards local inside the shard_map — each
+            # configured axis is used only when present in the mesh AND it
             # evenly divides the (static) dim, so e.g. an init trace with
             # batch 1 falls back to replication for that trace alone.
             axes = set(self.mesh.axis_names)
-            batch_axis = ('data' if 'data' in axes
-                          and q.shape[0] % self.mesh.shape['data'] == 0
-                          else None)
-            head_axis = ('model' if 'model' in axes
-                         and self.num_heads % self.mesh.shape['model'] == 0
-                         else None)
+
+            def usable(axis, dim):
+                return (axis if axis in axes
+                        and dim % self.mesh.shape[axis] == 0 else None)
+
+            batch_axis = usable(self.batch_axis, q.shape[0])
+            head_axis = usable(self.head_axis, self.num_heads)
             out = ring_self_attention(q, k, v, self.mesh, self.seq_axis,
                                       causal=self.causal,
                                       batch_axis=batch_axis,
@@ -83,6 +86,8 @@ class Block(nn.Module):
     attention: str = 'dense'
     mesh: Any = None
     seq_axis: Optional[str] = None
+    batch_axis: Optional[str] = 'data'
+    head_axis: Optional[str] = 'model'
     moe_experts: int = 0                # >0: SwitchMoE replaces the MLP
     expert_axis: Optional[str] = None
     dtype: Any = jnp.bfloat16
@@ -93,6 +98,8 @@ class Block(nn.Module):
         y = nn.LayerNorm(dtype=self.dtype)(x)
         y = MultiHeadAttention(self.num_heads, attention=self.attention,
                                mesh=self.mesh, seq_axis=self.seq_axis,
+                               batch_axis=self.batch_axis,
+                               head_axis=self.head_axis,
                                dtype=self.dtype, name='attn')(y)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
@@ -120,6 +127,8 @@ class TransformerLM(nn.Module):
     attention: str = 'dense'
     mesh: Any = None
     seq_axis: Optional[str] = None
+    batch_axis: Optional[str] = 'data'  # mesh axes carrying batch / heads
+    head_axis: Optional[str] = 'model'  # (ring attention shard locality)
     moe_experts: int = 0                # >0: Switch MoE MLPs (expert parallel
     expert_axis: Optional[str] = None   # over this mesh axis)
     dtype: Any = jnp.bfloat16
@@ -138,7 +147,8 @@ class TransformerLM(nn.Module):
         x = x + pos
         for i in range(self.num_layers):
             x = Block(self.num_heads, attention=self.attention, mesh=self.mesh,
-                      seq_axis=self.seq_axis, moe_experts=self.moe_experts,
+                      seq_axis=self.seq_axis, batch_axis=self.batch_axis,
+                      head_axis=self.head_axis, moe_experts=self.moe_experts,
                       expert_axis=self.expert_axis, dtype=self.dtype,
                       name='block_{}'.format(i))(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
