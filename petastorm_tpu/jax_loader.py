@@ -457,8 +457,13 @@ class JaxLoader(object):
     def __init__(self, reader, batch_size, mesh=None, sharding=None,
                  batch_axis='data', prefetch=2, shape_policies=None,
                  shuffling_queue_capacity=0, min_after_dequeue=None, seed=None,
-                 last_batch='drop', strict_fields=False, echo=1):
+                 last_batch='drop', strict_fields=False, echo=1, tracer=None):
         import jax
+
+        if tracer is None:
+            from petastorm_tpu.trace import NullTracer
+            tracer = NullTracer()
+        self._tracer = tracer
 
         self._reader = reader
         self._mesh = mesh
@@ -544,23 +549,25 @@ class JaxLoader(object):
         out = {}
         t0 = time.perf_counter()
         nbytes = 0
-        for name, array in host_batch.items():
-            nbytes += array.nbytes
-            if self._mesh is not None or self._sharding is not None:
-                sharding = self._field_sharding(name)
-                out[name] = jax.make_array_from_process_local_data(sharding, array)
-            elif self._dlpack_staging:
-                # CPU backend: import the host buffer zero-copy via DLPack
-                # (batch buffers are freshly assembled, never mutated after
-                # staging, so aliasing is safe). TPU backends need the real
-                # h2d transfer and take the device_put branch.
-                try:
-                    out[name] = jax.dlpack.from_dlpack(array)
-                except (TypeError, BufferError, RuntimeError):
-                    self._dlpack_staging = False
+        with self._tracer.span('stage', 'device'):
+            for name, array in host_batch.items():
+                nbytes += array.nbytes
+                if self._mesh is not None or self._sharding is not None:
+                    sharding = self._field_sharding(name)
+                    out[name] = jax.make_array_from_process_local_data(sharding, array)
+                elif self._dlpack_staging:
+                    # CPU backend: import the host buffer zero-copy via
+                    # DLPack (batch buffers are freshly assembled, never
+                    # mutated after staging, so aliasing is safe). TPU
+                    # backends need the real h2d transfer and take the
+                    # device_put branch.
+                    try:
+                        out[name] = jax.dlpack.from_dlpack(array)
+                    except (TypeError, BufferError, RuntimeError):
+                        self._dlpack_staging = False
+                        out[name] = jax.device_put(array)
+                else:
                     out[name] = jax.device_put(array)
-            else:
-                out[name] = jax.device_put(array)
         # Dispatch time only (device_put is async); the transfer itself
         # overlaps the consumer's step. Block-to-measure lives in bench.py.
         with self._stats_lock:
@@ -568,9 +575,17 @@ class JaxLoader(object):
             self._staged_bytes += nbytes
         return out
 
+    def _next_host_batch(self):
+        with self._tracer.span('assemble', 'host'):
+            return next(self._host_iter)
+
     def _stage_loop(self):
         try:
-            for host_batch in self._host_iter:
+            while True:
+                try:
+                    host_batch = self._next_host_batch()
+                except StopIteration:
+                    break
                 if self._stop.is_set():
                     return
                 staged = self._stage(host_batch)
@@ -605,13 +620,14 @@ class JaxLoader(object):
         else:
             if self._consumer_staging:
                 try:
-                    item = self._stage(next(self._host_iter))
+                    item = self._stage(self._next_host_batch())
                 except StopIteration:
                     item = _END
                 except Exception as e:  # noqa: BLE001 - match staged path
                     item = e
             else:
-                item = self._queue.get()
+                with self._tracer.span('wait', 'consumer'):
+                    item = self._queue.get()
             if self._echo > 1 and isinstance(item, dict):
                 self._echo_item = item
                 self._echo_left = self._echo - 1

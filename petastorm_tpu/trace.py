@@ -1,0 +1,100 @@
+"""Input-pipeline tracing: chrome://tracing timelines for the loader.
+
+The reference's observability stops at per-thread cProfile aggregates
+(SURVEY §5.1 — "No distributed tracing"). This records *spans* — named,
+timestamped durations per thread — and exports the Chrome trace-event JSON
+that chrome://tracing / Perfetto render as a timeline, which is how you SEE
+an input stall: the consumer's ``wait`` spans grow exactly when the staging
+thread's ``device_put`` spans (or the workers' decode) stretch.
+
+Usage::
+
+    tracer = Tracer()
+    with make_tensor_reader(url) as reader:
+        with JaxLoader(reader, 1024, tracer=tracer) as loader:
+            for batch in loader: ...
+    tracer.export_chrome_trace('/tmp/input_pipeline.json')
+
+Pure stdlib, thread-safe, bounded (drops oldest beyond ``max_events``).
+"""
+
+import json
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+
+class Tracer(object):
+    """Thread-safe span recorder with Chrome trace-event export."""
+
+    def __init__(self, max_events=100000):
+        # deque(maxlen=...): O(1) drop-oldest — a full list.pop(0) buffer
+        # would shift max_events pointers inside the hot-path lock.
+        self._events = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    @contextmanager
+    def span(self, name, cat='pipeline'):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            end = time.perf_counter()
+            with self._lock:
+                self._events.append({
+                    'name': name, 'cat': cat, 'ph': 'X',
+                    'ts': (start - self._t0) * 1e6,      # microseconds
+                    'dur': (end - start) * 1e6,
+                    'pid': 0, 'tid': threading.get_ident(),
+                })
+
+    def instant(self, name, cat='pipeline'):
+        """A zero-duration marker event."""
+        with self._lock:
+            self._events.append({
+                'name': name, 'cat': cat, 'ph': 'i', 's': 't',
+                'ts': (time.perf_counter() - self._t0) * 1e6,
+                'pid': 0, 'tid': threading.get_ident(),
+            })
+
+    @property
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def summary(self):
+        """Total seconds per span name (quick text view of the timeline)."""
+        totals = {}
+        for e in self.events:
+            if e['ph'] == 'X':
+                totals[e['name']] = totals.get(e['name'], 0.0) + e['dur'] / 1e6
+        return {k: round(v, 4) for k, v in sorted(totals.items())}
+
+    def export_chrome_trace(self, path):
+        """Write the Chrome trace-event JSON (open in chrome://tracing)."""
+        with open(path, 'w') as f:
+            json.dump({'traceEvents': self.events,
+                       'displayTimeUnit': 'ms'}, f)
+        return path
+
+
+class _NullSpan(object):
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class NullTracer(object):
+    """No-op stand-in so call sites never branch."""
+
+    _SPAN = _NullSpan()
+
+    def span(self, name, cat='pipeline'):
+        return self._SPAN
+
+    def instant(self, name, cat='pipeline'):
+        pass
