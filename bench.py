@@ -412,7 +412,10 @@ def _jax_backend_responsive(timeout_s):
 def main():
     _repo_on_path()
     import psutil
-    workers = min(10, (psutil.cpu_count(logical=True) or 4))
+    # Floor at 4 even on tiny hosts: parquet reads and the C++ batch decode
+    # release the GIL, so extra worker threads overlap I/O with decode even
+    # on a single core (1 worker serializes the whole pipeline).
+    workers = max(4, min(10, (psutil.cpu_count(logical=True) or 4)))
 
     if len(sys.argv) >= 3 and sys.argv[1] == '--_child':
         name = sys.argv[2]
@@ -425,8 +428,15 @@ def main():
         return
 
     hello_url = _ensure_hello_dataset()
-    reader_rate = _measure_reader(hello_url, workers)
-    cached_rate = _measure_reader(hello_url, workers, cache_type='memory')
+    # Auto-tune the hello worker count: on a 1-CPU host a single worker beats
+    # several (thread switching costs more than the lost overlap — measured
+    # 2650 vs 1930 samples/s), while multi-CPU hosts want the full pool. The
+    # sweep only CHOOSES the count; the reported rate is a fresh single run at
+    # that count (a max over noisy runs would bias the headline upward).
+    swept = sorted({1, 2, workers})
+    hello_workers = max(swept, key=lambda w: _measure_reader(hello_url, w))
+    reader_rate = _measure_reader(hello_url, hello_workers)
+    cached_rate = _measure_reader(hello_url, hello_workers, cache_type='memory')
 
     result = {
         'metric': 'hello_world_samples_per_sec',
@@ -436,7 +446,8 @@ def main():
         # Decoded-row RAM cache (cache_type='memory'): the multi-epoch
         # steady state. Reference-parity headline above stays uncached.
         'hello_world_cached_samples_per_sec': round(cached_rate, 2),
-        'hello_config': {'reader_pool': 'thread', 'workers_count': workers,
+        'hello_config': {'reader_pool': 'thread', 'workers_count': hello_workers,
+                         'workers_swept': swept,
                          'rows': _ROWS, 'warmup': _WARMUP_SAMPLES,
                          'measure': _MEASURE_SAMPLES},
     }
@@ -452,7 +463,10 @@ def main():
 
     imagenet_url = _ensure_imagenet_dataset()
 
-    staging, err = _run_child('staging', [hello_url, str(workers)], timeout_s=600)
+    # The staging child rides the same per-row make_reader path the sweep
+    # just tuned — reuse its winner rather than the decode-pool floor.
+    staging, err = _run_child('staging', [hello_url, str(hello_workers)],
+                              timeout_s=600)
     if staging:
         result.update(staging)
     else:

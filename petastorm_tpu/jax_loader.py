@@ -446,6 +446,8 @@ class JaxLoader(object):
     :param last_batch: 'drop' (pod-safe default) | 'pad' | 'partial'.
     :param strict_fields: raise (instead of warn-and-drop) when a selected
         field cannot batch — e.g. declared nullable but never actually null.
+    :param tracer: a ``trace.Tracer`` to record assemble/stage/wait spans
+        into a chrome://tracing timeline (default ``NullTracer``, no-op).
     :param echo: data echoing (Choi et al., "Faster Neural Network Training
         with Data Echoing"): deliver each staged batch ``echo`` times. When
         the pipeline is input-bound (``input_stall_frac`` high) echoed

@@ -36,7 +36,13 @@ class WorkerThread(threading.Thread):
         if self._profiling_enabled:
             import cProfile
             self.profile = cProfile.Profile()
-            self.profile.enable()
+            try:
+                self.profile.enable()
+            except ValueError:
+                # Python 3.12 allows one active profiler per thread; another
+                # tool (e.g. an outer profiler on a reused thread) wins —
+                # degrade to unprofiled rather than kill the worker.
+                self.profile = None
         try:
             self._worker.initialize()
             while not self._pool._stop_event.is_set():
