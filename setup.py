@@ -21,7 +21,7 @@ setup(
         'dill',
     ],
     extras_require={
-        'jax': ['jax', 'flax', 'optax'],
+        'jax': ['jax', 'flax', 'optax', 'orbax-checkpoint'],
         'process-pool': ['pyzmq'],
         'images': ['opencv-python'],
         'torch': ['torch'],
