@@ -1,0 +1,142 @@
+"""Device-resident dataset cache (``petastorm_tpu/device_cache.py``):
+epoch 0 streams-and-caches, later epochs run from device memory with a
+jitted on-device reshuffle.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from petastorm_tpu import make_tensor_reader
+from petastorm_tpu.device_cache import DeviceCacheOverflow, DeviceDatasetCache
+from petastorm_tpu.jax_loader import JaxLoader
+from petastorm_tpu.parallel import make_mesh
+
+N_ROWS = 48
+BATCH = 8
+
+
+@pytest.fixture(scope='module')
+def cache_dataset(tmp_path_factory):
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('Cache', [
+        UnischemaField('vec', np.float32, (3,), NdarrayCodec(), False),
+        UnischemaField('sid', np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    rng = np.random.default_rng(11)
+    url = 'file://' + str(tmp_path_factory.mktemp('ds') / 'store')
+    write_dataset(url, schema,
+                  ({'vec': rng.standard_normal(3).astype(np.float32),
+                    'sid': i} for i in range(N_ROWS)),
+                  rows_per_row_group=8)
+    return url
+
+
+def _epoch_ids(batches):
+    return [int(i) for b in batches for i in np.asarray(b.sid)]
+
+
+def _make_cache(url, mesh=None, **kwargs):
+    reader = make_tensor_reader(url, num_epochs=1, seed=0,
+                                reader_pool_type='thread', workers_count=2)
+    loader = JaxLoader(reader, BATCH, mesh=mesh, last_batch='drop')
+    return reader, loader, DeviceDatasetCache(loader, **kwargs)
+
+
+def test_stream_then_cached_epochs_multiset_equal(cache_dataset):
+    reader, loader, cache = _make_cache(cache_dataset, shuffle=True, seed=3)
+    with reader, loader:
+        e0 = list(cache.epoch(0))
+    assert cache.materialized and cache.nbytes > 0
+    e1 = list(cache.epoch(1))
+    e2 = list(cache.epoch(2))
+
+    ids0, ids1, ids2 = _epoch_ids(e0), _epoch_ids(e1), _epoch_ids(e2)
+    # Same multiset of rows every epoch; batch shapes static.
+    assert sorted(ids0) == sorted(ids1) == sorted(ids2)
+    assert all(b.vec.shape == (BATCH, 3) for b in e1)
+    # Shuffle actually shuffles, differently per epoch.
+    assert ids1 != ids0 and ids2 != ids1
+    # Rows keep their field pairing through the on-device gather.
+    by_id = {int(i): v for b in e0
+             for i, v in zip(np.asarray(b.sid), np.asarray(b.vec))}
+    for b in e2:
+        for i, v in zip(np.asarray(b.sid), np.asarray(b.vec)):
+            np.testing.assert_array_equal(v, by_id[int(i)])
+
+
+def test_epoch_shuffle_is_reproducible(cache_dataset):
+    reader, loader, cache = _make_cache(cache_dataset, shuffle=True, seed=7)
+    with reader, loader:
+        list(cache.epoch(0))
+    once = _epoch_ids(cache.epoch(5))
+    again = _epoch_ids(cache.epoch(5))
+    assert once == again
+    # A cache rebuilt from the same (seeded) pipeline replays the same epochs.
+    reader2, loader2, cache2 = _make_cache(cache_dataset, shuffle=True, seed=7)
+    with reader2, loader2:
+        list(cache2.epoch(0))
+    assert _epoch_ids(cache2.epoch(5)) == once
+
+
+def test_no_shuffle_replays_cache_order(cache_dataset):
+    reader, loader, cache = _make_cache(cache_dataset, shuffle=False)
+    with reader, loader:
+        order0 = _epoch_ids(cache.epoch(0))
+    assert _epoch_ids(cache.epoch(1)) == order0
+    assert _epoch_ids(cache.epoch(2)) == order0
+
+
+def test_mesh_sharded_cache_keeps_sharding(cache_dataset):
+    mesh = make_mesh({'data': 8})
+    reader, loader, cache = _make_cache(cache_dataset, mesh=mesh, shuffle=True)
+    with reader, loader:
+        e0 = list(cache.epoch(0))
+    e1 = list(cache.epoch(1))
+    assert sorted(_epoch_ids(e0)) == sorted(_epoch_ids(e1))
+    for b in e1:
+        assert b.vec.shape == (BATCH, 3)
+        assert len(b.vec.sharding.device_set) == 8
+
+
+def test_overflow_raises(cache_dataset):
+    reader, loader, cache = _make_cache(cache_dataset, shuffle=True,
+                                        max_bytes=100)
+    with reader, loader:
+        with pytest.raises(DeviceCacheOverflow, match='budget'):
+            list(cache.epoch(0))
+
+
+def test_clear_frees_and_refuses(cache_dataset):
+    reader, loader, cache = _make_cache(cache_dataset)
+    with reader, loader:
+        list(cache.epoch(0))
+    assert cache.materialized
+    cache.clear()
+    assert not cache.materialized and cache.nbytes == 0
+    with pytest.raises(RuntimeError, match='cleared'):
+        next(iter(cache.epoch(1)))
+
+
+def test_abandoned_caching_epoch_refuses_restart(cache_dataset):
+    reader, loader, cache = _make_cache(cache_dataset)
+    with reader, loader:
+        it = cache.epoch(0)
+        next(it)  # abandon mid-stream
+        with pytest.raises(RuntimeError, match='abandoned mid-stream'):
+            next(iter(cache.epoch(1)))
+
+
+def test_ragged_final_batch_rejected(cache_dataset):
+    reader = make_tensor_reader(cache_dataset, num_epochs=1, seed=0,
+                                reader_pool_type='thread', workers_count=2)
+    # 48 rows / batch 9 -> 5 full + one 3-row tail under 'partial'.
+    loader = JaxLoader(reader, 9, last_batch='partial')
+    cache = DeviceDatasetCache(loader)
+    with reader, loader:
+        with pytest.raises(ValueError, match='equal-size batches'):
+            list(cache.epoch(0))
