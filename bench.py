@@ -143,9 +143,20 @@ def _measure_reader(url, workers, cache_type='null'):
 # TPU children (each prints ONE json line; parent runs them with a timeout)
 # --------------------------------------------------------------------------
 
+def _force_cpu_if_requested(jax):
+    """A TPU plugin registered from sitecustomize may pin jax_platforms,
+    which beats the JAX_PLATFORMS env var — honor an explicit cpu-FIRST
+    request (CI smokes) the way ``__graft_entry__.dryrun_multichip`` does.
+    ``JAX_PLATFORMS='tpu,cpu'`` (tpu with cpu fallback) must NOT pin cpu."""
+    if os.environ.get('JAX_PLATFORMS', '').split(',')[0].strip() == 'cpu':
+        jax.config.update('jax_platforms', 'cpu')
+
+
 def _child_staging(url, workers):
     """hello_world batches staged to the default JAX device."""
     import jax
+
+    _force_cpu_if_requested(jax)
 
     from petastorm_tpu import make_reader
     from petastorm_tpu.jax_loader import JaxLoader, PadTo
@@ -228,6 +239,8 @@ def _child_imagenet(url, workers):
     from functools import partial
 
     import jax
+
+    _force_cpu_if_requested(jax)
     import jax.numpy as jnp
 
     from petastorm_tpu import make_tensor_reader
@@ -345,6 +358,19 @@ def _child_imagenet(url, workers):
                                      # through the tunnel; bytes cannot)
             elapsed = time.perf_counter() - start
             stats = loader.stats
+    # Device-resident steady state (device_cache.py): the decoded dataset
+    # lives in HBM, epochs reshuffle on device — zero h2d during training.
+    # Reported as its own metric: the headline stays the honest streaming
+    # pipeline (real ImageNet does not fit in HBM; this bench's 2048-row
+    # stand-in does, which is exactly the feature's use case).
+    hbm_cached = None
+    if os.environ.get('BENCH_IMAGENET_DEVICE_CACHE', '1') == '1':
+        try:
+            hbm_cached = _measure_device_cache(
+                jax, url, workers, batch, scan_k, mesh, train_step, state)
+        except Exception as e:  # noqa: BLE001 - auxiliary metric, stay loud
+            hbm_cached = 'skipped: {}'.format(e)
+
     # Per-stage profile over the measure window (VERDICT r2 #1): worker read/
     # decode/cache seconds are cumulative, so delta from the warmup snapshot.
     t_read = stats.get('worker_stage_timings', {})
@@ -367,7 +393,78 @@ def _child_imagenet(url, workers):
         'bench_config': config,
     }
     out.update(h2d)
+    if hbm_cached is not None:
+        if isinstance(hbm_cached, dict):
+            out.update(hbm_cached)
+        else:
+            out['imagenet_hbm_cached'] = hbm_cached
     print(json.dumps(out))
+
+
+def _measure_device_cache(jax, url, workers, batch, scan_k, mesh, train_step,
+                          state, epochs=6):
+    """Steady-state img/s with the decoded dataset resident in HBM
+    (``DeviceDatasetCache``): epoch 0 streams-and-caches, measured epochs
+    run entirely on device (per-epoch on-device reshuffle, zero h2d)."""
+    import jax.numpy as jnp
+
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.device_cache import DeviceDatasetCache
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    reader = make_tensor_reader(url, schema_fields=['image', 'label'],
+                                reader_pool_type='thread',
+                                workers_count=workers, num_epochs=1, seed=0,
+                                cache_type='memory')
+    with reader:
+        with JaxLoader(reader, batch, mesh=mesh, last_batch='drop') as loader:
+            cache = DeviceDatasetCache(loader, shuffle=True, seed=0)
+            for _ in cache.epoch(0):
+                pass
+
+    concat = jax.jit(lambda *xs: jnp.concatenate(xs))
+
+    def superbatches(first_epoch, n_epochs):
+        # Groups carry across epoch boundaries: with few batches per epoch
+        # (multi-chip scales the global batch up) one epoch may hold fewer
+        # than scan_k batches, and the scan step's superbatch shape must
+        # stay fixed regardless.
+        group = []
+        for ep in range(first_epoch, first_epoch + n_epochs):
+            for b in cache.epoch(ep):
+                group.append(b)
+                if len(group) == scan_k:
+                    if scan_k == 1:
+                        yield group[0]
+                    else:
+                        yield group[0]._replace(
+                            **{f: concat(*[getattr(p, f) for p in group])
+                               for f in group[0]._fields})
+                    group = []
+
+    # Warmup compiles the gather/concat path; then measure. ``metrics`` can
+    # only be unbound if the cache is empty, which _first_epoch rejects.
+    metrics = None
+    for sb in superbatches(1, max(1, scan_k)):
+        state, metrics = train_step(state, sb.image, sb.label)
+        break
+    if metrics is None:
+        raise RuntimeError('device cache produced no superbatch')
+    float(metrics['loss'])
+    steps = 0
+    t0 = time.perf_counter()
+    for sb in superbatches(2, epochs):
+        state, metrics = train_step(state, sb.image, sb.label)
+        steps += scan_k
+    float(metrics['loss'])   # d2h fence
+    elapsed = time.perf_counter() - t0
+    if not steps:
+        raise RuntimeError('device cache produced no measured superbatches')
+    n_devices = jax.device_count()
+    return {'imagenet_hbm_cached_img_per_sec_per_chip':
+                round(batch * steps / elapsed / n_devices, 2),
+            'hbm_cached_GB': round(cache.nbytes / 1e9, 3),
+            'hbm_cached_epochs_measured': epochs}
 
 
 def _run_child(name, args, timeout_s):

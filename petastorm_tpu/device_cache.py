@@ -56,9 +56,14 @@ class DeviceDatasetCache(object):
     :param shuffle: reshuffle rows across the whole cached set each epoch.
         ``False`` replays cache order (batch boundaries preserved).
     :param seed: base of the per-epoch permutation key (the epoch index is
-        folded in: every epoch differs, the sequence is reproducible).
-    :param max_bytes: staging budget; ``None`` = 40% of the first device's
-        reported HBM (no limit when the backend reports no stats).
+        folded in: every epoch differs, the permutation sequence is
+        reproducible). Note the permutation acts on *cache order* — for
+        bit-identical epoch streams across job restarts the source pipeline
+        must also be deterministic (``workers_count=1`` or a seeded
+        single-reader setup; multi-worker pools interleave chunk arrival).
+    :param max_bytes: **per-device** staging budget (sharded global bytes are
+        normalized by the batch's device count); ``None`` = 40% of the first
+        device's reported HBM (no limit when the backend reports no stats).
     """
 
     def __init__(self, loader, shuffle=True, seed=0, max_bytes=None):
@@ -113,31 +118,45 @@ class DeviceDatasetCache(object):
     def _first_epoch(self):
         self._streaming = True
         self._bytes = 0
+        n_shards = 1
         batches = []
         for batch in self._loader:
+            if not batches:
+                # ``nbytes`` of a mesh-sharded jax.Array is the GLOBAL
+                # logical size; the budget is per-device HBM. Normalize by
+                # the device count the batch is sharded over.
+                first = getattr(batch, batch._fields[0])
+                try:
+                    n_shards = max(1, len(first.sharding.device_set))
+                except AttributeError:
+                    n_shards = 1
             nbytes = sum(getattr(batch, f).nbytes for f in batch._fields)
             self._bytes += nbytes
-            if self._max_bytes and self._bytes > self._max_bytes:
+            if self._max_bytes and self._bytes / n_shards > self._max_bytes:
                 raise DeviceCacheOverflow(
-                    'device cache exceeded {:.2f} GB budget after {} batches '
-                    '({:.2f} GB staged); raise max_bytes or drop the cache '
-                    'for this dataset'.format(self._max_bytes / 1e9,
-                                              len(batches) + 1,
-                                              self._bytes / 1e9))
+                    'device cache exceeded {:.2f} GB per-device budget after '
+                    '{} batches ({:.2f} GB/device staged); raise max_bytes or '
+                    'drop the cache for this dataset'.format(
+                        self._max_bytes / 1e9, len(batches) + 1,
+                        self._bytes / n_shards / 1e9))
             batches.append(batch)
             self._nt_type = type(batch)
             yield batch
         if not batches:
             raise ValueError('source loader yielded no batches to cache')
         self._consolidate(batches)
+        # Free the per-batch device arrays now — the generator frame would
+        # otherwise pin them (alongside the consolidated columns) until the
+        # consumer drops the generator.
+        batches.clear()
         self._streaming = False
 
     def _consolidate(self, batches):
         """Per-field concat of all cached batches into one [N, ...] array.
 
         Transiently holds the dataset twice (inputs + output) — the reason
-        the default budget is 40% of HBM, not 80%. The per-batch arrays are
-        dropped as soon as the concat values are ready.
+        the default budget is 40% of HBM, not 80%. The caller clears its
+        batch list right after this returns to release the inputs.
         """
         import jax.numpy as jnp
         jit_concat = self._jax.jit(lambda *xs: jnp.concatenate(xs, axis=0))

@@ -40,9 +40,9 @@ def _epoch_ids(batches):
     return [int(i) for b in batches for i in np.asarray(b.sid)]
 
 
-def _make_cache(url, mesh=None, **kwargs):
+def _make_cache(url, mesh=None, workers=2, **kwargs):
     reader = make_tensor_reader(url, num_epochs=1, seed=0,
-                                reader_pool_type='thread', workers_count=2)
+                                reader_pool_type='thread', workers_count=workers)
     loader = JaxLoader(reader, BATCH, mesh=mesh, last_batch='drop')
     return reader, loader, DeviceDatasetCache(loader, **kwargs)
 
@@ -76,11 +76,17 @@ def test_epoch_shuffle_is_reproducible(cache_dataset):
     once = _epoch_ids(cache.epoch(5))
     again = _epoch_ids(cache.epoch(5))
     assert once == again
-    # A cache rebuilt from the same (seeded) pipeline replays the same epochs.
-    reader2, loader2, cache2 = _make_cache(cache_dataset, shuffle=True, seed=7)
-    with reader2, loader2:
-        list(cache2.epoch(0))
-    assert _epoch_ids(cache2.epoch(5)) == once
+    # A cache rebuilt from a DETERMINISTIC pipeline (single worker — a
+    # multi-worker pool interleaves chunk arrival and reorders cache
+    # content) replays the same epoch streams.
+    rebuilt = []
+    for _ in range(2):
+        reader2, loader2, cache2 = _make_cache(cache_dataset, workers=1,
+                                               shuffle=True, seed=7)
+        with reader2, loader2:
+            list(cache2.epoch(0))
+        rebuilt.append(_epoch_ids(cache2.epoch(5)))
+    assert rebuilt[0] == rebuilt[1]
 
 
 def test_no_shuffle_replays_cache_order(cache_dataset):
