@@ -408,6 +408,10 @@ def _measure_device_cache(jax, url, workers, batch, scan_k, mesh, train_step,
     run entirely on device (per-epoch on-device reshuffle, zero h2d)."""
     import jax.numpy as jnp
 
+    # Few-batches-per-epoch configs (multi-chip scales the global batch up)
+    # must still accumulate enough batches for >=2 measured superbatches.
+    epochs = max(epochs, 2 * scan_k)
+
     from petastorm_tpu import make_tensor_reader
     from petastorm_tpu.device_cache import DeviceDatasetCache
     from petastorm_tpu.jax_loader import JaxLoader

@@ -118,27 +118,18 @@ class DeviceDatasetCache(object):
     def _first_epoch(self):
         self._streaming = True
         self._bytes = 0
-        n_shards = 1
+        per_dev_bytes = 0
         batches = []
         for batch in self._loader:
-            if not batches:
-                # ``nbytes`` of a mesh-sharded jax.Array is the GLOBAL
-                # logical size; the budget is per-device HBM. Normalize by
-                # the device count the batch is sharded over.
-                first = getattr(batch, batch._fields[0])
-                try:
-                    n_shards = max(1, len(first.sharding.device_set))
-                except AttributeError:
-                    n_shards = 1
-            nbytes = sum(getattr(batch, f).nbytes for f in batch._fields)
-            self._bytes += nbytes
-            if self._max_bytes and self._bytes / n_shards > self._max_bytes:
+            self._bytes += sum(getattr(batch, f).nbytes for f in batch._fields)
+            per_dev_bytes += _per_device_nbytes(batch)
+            if self._max_bytes and per_dev_bytes > self._max_bytes:
                 raise DeviceCacheOverflow(
                     'device cache exceeded {:.2f} GB per-device budget after '
                     '{} batches ({:.2f} GB/device staged); raise max_bytes or '
                     'drop the cache for this dataset'.format(
                         self._max_bytes / 1e9, len(batches) + 1,
-                        self._bytes / n_shards / 1e9))
+                        per_dev_bytes / 1e9))
             batches.append(batch)
             self._nt_type = type(batch)
             yield batch
@@ -213,6 +204,24 @@ class DeviceDatasetCache(object):
         self._bytes = 0
         self._take = None
         self._cleared = True
+
+
+def _per_device_nbytes(batch):
+    """Bytes one device holds for this batch.
+
+    ``jax.Array.nbytes`` is the GLOBAL logical size, and dividing it by
+    ``len(sharding.device_set)`` counts replicas as shards (a batch sharded
+    over 'data' but replicated over 'model' would undercount 2x). The
+    addressable-shard buffer size is the ground truth per device.
+    """
+    total = 0
+    for name in batch._fields:
+        arr = getattr(batch, name)
+        try:
+            total += arr.addressable_shards[0].data.nbytes
+        except (AttributeError, IndexError):
+            total += arr.nbytes
+    return total
 
 
 def _default_budget(jax):

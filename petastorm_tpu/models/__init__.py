@@ -10,3 +10,4 @@ from petastorm_tpu.models.resnet import ResNet, ResNet18, ResNet50  # noqa: F401
 from petastorm_tpu.models.moe import SwitchMoE  # noqa: F401
 from petastorm_tpu.models.pipeline import pipeline_apply  # noqa: F401
 from petastorm_tpu.models.transformer import TransformerLM  # noqa: F401
+from petastorm_tpu.models.vit import ViT, ViTTiny  # noqa: F401

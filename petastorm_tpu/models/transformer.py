@@ -84,6 +84,7 @@ class Block(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     attention: str = 'dense'
+    causal: bool = True                 # False: bidirectional (e.g. ViT)
     mesh: Any = None
     seq_axis: Optional[str] = None
     batch_axis: Optional[str] = 'data'
@@ -97,6 +98,7 @@ class Block(nn.Module):
         d_model = x.shape[-1]
         y = nn.LayerNorm(dtype=self.dtype)(x)
         y = MultiHeadAttention(self.num_heads, attention=self.attention,
+                               causal=self.causal,
                                mesh=self.mesh, seq_axis=self.seq_axis,
                                batch_axis=self.batch_axis,
                                head_axis=self.head_axis,

@@ -1,0 +1,88 @@
+"""Vision Transformer (ViT) — the second image-classification family.
+
+Reuses the LM stack's :class:`~petastorm_tpu.models.transformer.Block`
+(pre-LN residual blocks, pluggable dense/flash attention, optional Switch
+MoE MLPs) with non-causal attention over a patch sequence. Together with
+ResNet this covers both conv-heavy and attention-heavy input-pipeline
+consumers of the reader (the reference exercises its readers with exactly
+such downstream trainers, e.g. ``examples/imagenet`` /
+``examples/mnist/pytorch_example.py``; model choice there is torch's, here
+it is TPU-first flax).
+
+TPU-first choices: patchify is one strided conv (an MXU matmul, no
+host-side reshape gymnastics); bfloat16 activations / float32 params;
+learned positional embeddings + a CLS token; static shapes throughout.
+``transformer_param_spec`` applies unchanged for Megatron-style tensor
+parallelism over the blocks (q/k/v by head, MLP pair column/row-parallel).
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from petastorm_tpu.models.transformer import Block
+
+
+class ViT(nn.Module):
+    """``[B, H, W, C] float32 images -> [B, num_classes] float32 logits``.
+
+    :param patch_size: square patch edge; H and W must divide by it.
+    :param attention: 'dense' (default) or 'flash' (Pallas kernel; useful
+        from ~1k patches up — e.g. 384² images at patch 8).
+    """
+
+    num_classes: int
+    patch_size: int = 16
+    d_model: int = 384
+    num_heads: int = 6
+    num_layers: int = 8
+    mlp_ratio: int = 4
+    attention: str = 'dense'
+    mesh: Any = None
+    moe_experts: int = 0
+    expert_axis: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images, train=True):
+        b, h, w, _ = images.shape
+        p = self.patch_size
+        if h % p or w % p:
+            raise ValueError('image {}x{} not divisible by patch_size {}'
+                             .format(h, w, p))
+        x = images.astype(self.dtype)
+        # Patchify = one strided conv: [B, H/p, W/p, d_model], pure MXU work.
+        x = nn.Conv(self.d_model, kernel_size=(p, p), strides=(p, p),
+                    dtype=self.dtype, name='patch_embed')(x)
+        x = x.reshape(b, -1, self.d_model)                     # [B, T, D]
+        t = x.shape[1]
+
+        cls = self.param('cls', nn.initializers.zeros, (1, 1, self.d_model))
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.d_model))
+                             .astype(self.dtype), x], axis=1)  # [B, T+1, D]
+        pos = self.param('pos_embed',
+                         nn.initializers.normal(stddev=0.02),
+                         (1, t + 1, self.d_model))
+        x = x + pos.astype(self.dtype)
+
+        for i in range(self.num_layers):
+            # Non-causal: every patch attends to every patch.
+            x = Block(self.num_heads, mlp_ratio=self.mlp_ratio,
+                      attention=self.attention, causal=False, mesh=self.mesh,
+                      moe_experts=self.moe_experts,
+                      expert_axis=self.expert_axis, dtype=self.dtype,
+                      name='block_{}'.format(i))(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype, name='head')(
+            x[:, 0])                                           # CLS readout
+        return logits.astype(jnp.float32)
+
+
+class ViTTiny(ViT):
+    """Test/dry-run scale ViT (runs a forward pass in milliseconds on CPU)."""
+
+    patch_size: int = 4
+    d_model: int = 32
+    num_heads: int = 2
+    num_layers: int = 2
