@@ -10,8 +10,16 @@ the rotation overlaps compute, which is exactly the TPU ICI topology's sweet
 spot (SURVEY §7 / scaling-book recipe: mesh + collectives, no hand-rolled
 NCCL — role parity with the reference's distributed attention path).
 
-Everything here is functional and shard_map-based: ``ring_self_attention``
-is the public entry; ``_ring_attention_local`` is the per-device program.
+Two sequence-parallel schemes are provided, both exact:
+
+* ``ring_self_attention`` — kv blocks rotate around the ring (n-1 ppermute
+  hops), O(T/n) activations, no constraint on head count;
+* ``a2a_self_attention`` — Ulysses-style: two ``all_to_all``s re-shard
+  sequence<->heads so each device runs full-sequence attention on ``H/n``
+  heads (cheapest in collective count when heads are plentiful).
+
+Everything here is functional and shard_map-based: the ``*_self_attention``
+functions are the public entries; ``_*_local`` are the per-device programs.
 """
 
 import math
@@ -99,6 +107,57 @@ def ring_self_attention(q, k, v, mesh, seq_axis, causal=False,
                     if a is not None)
     fn = jax.shard_map(partial(_ring_attention_local, axis_name=seq_axis,
                                causal=causal, varying_axes=varying),
+                       mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def _a2a_attention_local(q, k, v, axis_name, causal):
+    """Per-device Ulysses body: trade sequence shards for head shards.
+
+    In: ``[B, T/n, H_local, D]`` (sequence-sharded). Two ``all_to_all``s
+    bracket an ordinary exact attention over the FULL sequence on a subset
+    of heads — attention is elementwise over heads, so the math is identical
+    to the unsharded computation.
+    """
+    n = jax.lax.psum(1, axis_name)
+    if q.shape[2] % n:
+        raise ValueError('a2a sequence parallelism needs heads ({}) divisible '
+                         'by the mesh axis size ({})'.format(q.shape[2], n))
+
+    # One collective each way: q/k/v stacked -> [3, B, T/n, H, D], heads
+    # split / sequence concatenated -> [3, B, T, H/n, D].
+    qkv = jax.lax.all_to_all(jnp.stack((q, k, v)), axis_name,
+                             split_axis=3, concat_axis=2, tiled=True)
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    # Full sequence locally: the Pallas flash kernel gives O(T) memory on
+    # TPU (off-TPU it falls back to dense — fine for tests); the causal mask
+    # needs no global-position bookkeeping because T is whole here.
+    from petastorm_tpu.ops.flash_attention import flash_attention
+    out = flash_attention(q, k, v, causal=causal)
+    # [B, T, H/n, D] -> [B, T/n, H, D]
+    return jax.lax.all_to_all(out.astype(q.dtype), axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+
+def a2a_self_attention(q, k, v, mesh, seq_axis, causal=False,
+                       batch_axis=None, head_axis=None):
+    """Ulysses-style sequence parallelism: all-to-all over ``mesh[seq_axis]``
+    re-shards sequence<->heads so each device runs exact attention on the
+    full sequence for ``H/n`` heads, then shards the sequence back.
+
+    Complements :func:`ring_self_attention`: two all-to-alls total (vs n-1
+    ppermute hops) — cheaper in collective count when heads are plentiful,
+    while ring has no ``heads % n`` constraint and keeps peak activation at
+    ``O(T/n)``. Same signature; the module layer exposes both as
+    ``attention='a2a' | 'ring'``.
+
+    :param q, k, v: ``[B, T, H, D]`` global arrays, sequence-shardable over
+        ``seq_axis``. Heads (per ``head_axis`` shard, if tensor parallelism
+        is also active) must divide by ``mesh.shape[seq_axis]``.
+    """
+    spec = PartitionSpec(batch_axis, seq_axis, head_axis, None)
+    fn = jax.shard_map(partial(_a2a_attention_local, axis_name=seq_axis,
+                               causal=causal),
                        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 

@@ -8,7 +8,11 @@ The long-context flagship: the same flax module runs with
 * ``attention='ring'`` — sequence parallelism: q/k/v sharded over a mesh
   axis, kv blocks rotating over ICI
   (:mod:`petastorm_tpu.models.attention`), for contexts longer than one
-  device's HBM.
+  device's HBM,
+* ``attention='a2a'`` — Ulysses-style sequence parallelism: two
+  ``all_to_all``s re-shard sequence<->heads around full-sequence local
+  attention (fewest collectives when heads are plentiful; needs
+  ``heads % mesh[seq_axis] == 0``).
 
 TPU-first choices: bfloat16 activations with float32 params, pre-LN
 residual blocks, static shapes throughout, and the sequence axis is the
@@ -24,12 +28,12 @@ import jax.numpy as jnp
 
 class MultiHeadAttention(nn.Module):
     num_heads: int
-    attention: str = 'dense'            # dense | flash | ring
+    attention: str = 'dense'            # dense | flash | ring | a2a
     causal: bool = True
-    mesh: Any = None                    # required for 'ring'
-    seq_axis: Optional[str] = None      # mesh axis name for 'ring'
-    batch_axis: Optional[str] = 'data'  # mesh axis carrying the batch (ring)
-    head_axis: Optional[str] = 'model'  # mesh axis carrying the heads (ring)
+    mesh: Any = None                    # required for 'ring' / 'a2a'
+    seq_axis: Optional[str] = None      # mesh axis name for 'ring' / 'a2a'
+    batch_axis: Optional[str] = 'data'  # mesh axis carrying the batch (sp)
+    head_axis: Optional[str] = 'model'  # mesh axis carrying the heads (sp)
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -46,10 +50,12 @@ class MultiHeadAttention(nn.Module):
 
         q, k, v = proj('query'), proj('key'), proj('value')   # [B, T, H, Dh]
 
-        if self.attention == 'ring':
+        if self.attention in ('ring', 'a2a'):
             if self.mesh is None or self.seq_axis is None:
-                raise ValueError("attention='ring' needs mesh= and seq_axis=")
-            from petastorm_tpu.models.attention import ring_self_attention
+                raise ValueError("attention={!r} needs mesh= and seq_axis="
+                                 .format(self.attention))
+            from petastorm_tpu.models.attention import (a2a_self_attention,
+                                                        ring_self_attention)
             # Keep batch/head shards local inside the shard_map — each
             # configured axis is used only when present in the mesh AND it
             # evenly divides the (static) dim, so e.g. an init trace with
@@ -62,10 +68,11 @@ class MultiHeadAttention(nn.Module):
 
             batch_axis = usable(self.batch_axis, q.shape[0])
             head_axis = usable(self.head_axis, self.num_heads)
-            out = ring_self_attention(q, k, v, self.mesh, self.seq_axis,
-                                      causal=self.causal,
-                                      batch_axis=batch_axis,
-                                      head_axis=head_axis)
+            sp_attention = (ring_self_attention if self.attention == 'ring'
+                            else a2a_self_attention)
+            out = sp_attention(q, k, v, self.mesh, self.seq_axis,
+                               causal=self.causal, batch_axis=batch_axis,
+                               head_axis=head_axis)
         elif self.attention == 'flash':
             from petastorm_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=self.causal)

@@ -102,7 +102,7 @@ def test_flash_kernel_handles_vit_sequence_length():
 
     rng = np.random.default_rng(2)
     t = (32 // 4) * (32 // 4) + 1   # 65: ViT 32x32 / patch 4 + CLS
-    shape = (2, 2, t, 16)
+    shape = (2, t, 2, 16)           # [B, T, H, D] — T must be the 65
     q = jnp.asarray(rng.standard_normal(shape), jnp.float32)
     k = jnp.asarray(rng.standard_normal(shape), jnp.float32)
     v = jnp.asarray(rng.standard_normal(shape), jnp.float32)
