@@ -1,0 +1,130 @@
+"""Preemptible training: the whole job survives a kill mid-epoch.
+
+TPU pods get preempted routinely; the reference's answer is "restart the
+epoch" (it has no reader or trainer checkpointing — SURVEY §5.4). This
+example shows the petastorm_tpu answer end to end:
+
+* the **tensor reader** streams decoded batches with exactly-once row
+  accounting (``resume_state=``),
+* the **JobCheckpointer** saves params + optimizer + the reader's row
+  position as ONE atomic orbax artifact every ``ckpt_every`` steps,
+* ``run()`` simulates a preemption by tearing the whole pipeline down
+  mid-epoch, then resuming from the latest checkpoint in a fresh pipeline —
+  with bit-exact parameters and no replayed/lost rows (modulo the final
+  partial batch dropped for static shapes).
+
+Run: ``python examples/preemptible/train_resume_example.py`` (any JAX
+backend; on a pod each host passes its ``jax.process_index()`` shard).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..')))
+
+import argparse
+import tempfile
+
+import numpy as np
+
+
+def _build_pipeline(url, batch, resume_state=None):
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.jax_loader import JaxLoader
+    from petastorm_tpu.parallel import process_shard
+
+    cur_shard, shard_count = process_shard()
+    reader = make_tensor_reader(url, reader_pool_type='thread',
+                                workers_count=2, num_epochs=1, seed=0,
+                                cur_shard=cur_shard, shard_count=shard_count,
+                                resume_state=resume_state)
+    loader = JaxLoader(reader, batch, last_batch='drop')
+    return reader, loader
+
+
+def run(dataset_url=None, ckpt_dir=None, batch=16, preempt_after=3,
+        ckpt_every=1, n_rows=128):
+    """Train, die mid-epoch, resume. Returns (losses, seen_ids, restored_step)."""
+    import jax
+
+    from petastorm_tpu import JobCheckpointer
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.models.mlp import MLP
+    from petastorm_tpu.models.train import create_train_state, make_train_step
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    if dataset_url is None:
+        dataset_url = 'file://' + tempfile.mkdtemp(prefix='preemptible_ds_')
+    marker = dataset_url.replace('file://', '', 1) + '/_common_metadata'
+    if not os.path.exists(marker):
+        rng = np.random.default_rng(0)
+        schema = Unischema('Preemptible', [
+            UnischemaField('x', np.float32, (8,), NdarrayCodec(), False),
+            UnischemaField('label', np.int64, (), ScalarCodec(np.int64), False),
+            UnischemaField('sample_id', np.int64, (), ScalarCodec(np.int64), False),
+        ])
+        write_dataset(dataset_url, schema,
+                      ({'x': rng.standard_normal(8).astype(np.float32),
+                        'label': int(i % 4), 'sample_id': i}
+                       for i in range(n_rows)),
+                      rows_per_row_group=16)
+    ckpt_dir = ckpt_dir or tempfile.mkdtemp(prefix='preemptible_ckpt_')
+
+    model = MLP(features=(16, 4))
+    train_step = make_train_step()
+    losses, seen = [], []
+
+    # ---- session 1: train until the "preemption" ------------------------
+    state = create_train_state(jax.random.PRNGKey(0), model, (1, 8))
+    with JobCheckpointer(ckpt_dir, max_to_keep=2) as ckpt:
+        reader, loader = _build_pipeline(dataset_url, batch)
+        with reader, loader:
+            for step_i, b in enumerate(loader):
+                state, metrics = train_step(state, b.x, b.label)
+                losses.append(float(metrics['loss']))
+                seen.extend(np.asarray(b.sample_id).tolist())
+                if step_i % ckpt_every == 0:
+                    # loader state is captured synchronously with the params.
+                    ckpt.save(step_i, state, loader=loader,
+                              extra={'epoch': 0})
+                if step_i + 1 >= preempt_after:
+                    break   # <- the preemption: pipeline torn down mid-epoch
+    del state, reader, loader
+
+    # ---- session 2: a fresh process would start exactly like this -------
+    template = create_train_state(jax.random.PRNGKey(0), model, (1, 8))
+    with JobCheckpointer(ckpt_dir) as ckpt:
+        job = ckpt.restore(template)
+    assert job is not None, 'no checkpoint found to resume from'
+    state = job.state
+    reader, loader = _build_pipeline(dataset_url, batch,
+                                     resume_state=job.loader_state)
+    with reader, loader:
+        for b in loader:
+            state, metrics = train_step(state, b.x, b.label)
+            losses.append(float(metrics['loss']))
+            seen.extend(np.asarray(b.sample_id).tolist())
+
+    # Exactly-once across the kill: rows delivered after the checkpoint in
+    # session 1 were not yet recorded consumed, so they re-deliver — dedupe
+    # is on the *checkpoint boundary*, not the kill boundary.
+    print('preemptible example: {} steps, resumed at step {}, '
+          '{} distinct rows of {}'.format(len(losses), job.step,
+                                          len(set(seen)), n_rows))
+    return losses, seen, job.step
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default=None)
+    parser.add_argument('--ckpt-dir', default=None)
+    parser.add_argument('--batch', type=int, default=16)
+    parser.add_argument('--preempt-after', type=int, default=3)
+    args = parser.parse_args()
+    run(args.dataset_url, args.ckpt_dir, batch=args.batch,
+        preempt_after=args.preempt_after)
+
+
+if __name__ == '__main__':
+    main()

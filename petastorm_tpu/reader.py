@@ -159,7 +159,8 @@ def make_tensor_reader(dataset_url,
                        storage_options=None,
                        shm_result_ring_bytes=None,
                        resume_state=None,
-                       pool_profiling=False):
+                       pool_profiling=False,
+                       shuffle_rows_in_chunk=False):
     """Decoded-columnar reader: the TPU hot path (no reference equivalent).
 
     Like :func:`make_reader` (codecs run, values are decoded) but columnar
@@ -178,6 +179,15 @@ def make_tensor_reader(dataset_url,
     TransformSpec semantics differ: ``func`` receives a dict of column
     blocks (numpy in/numpy out), the vectorized analog of the reference's
     pandas transform (``arrow_reader_worker.py:163-178``).
+
+    ``shuffle_rows_in_chunk=True`` additionally permutes each decoded
+    chunk's rows inside the worker with a permutation derived from
+    ``(seed, row-group identity)`` — it decorrelates storage order within
+    row-groups while keeping the loader's zero-per-row block fast path.
+    The permutation is fixed across epochs (per-epoch variation comes from
+    ``shuffle_row_groups``), which is what keeps mid-epoch checkpoint
+    resume exact; for full row-level decorrelation use the JaxLoader's
+    ``shuffling_queue_capacity`` (which leaves the block path).
     """
     from petastorm_tpu.ngram import NGram
     from petastorm_tpu.tensor_worker import (TensorResultsQueueReader,
@@ -226,7 +236,8 @@ def make_tensor_reader(dataset_url,
                   seed=seed, predicate=predicate, rowgroup_selector=rowgroup_selector,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec,
-                  resume_state=resume_state)
+                  resume_state=resume_state,
+                  shuffle_rows_in_chunk=shuffle_rows_in_chunk)
 
 
 def make_batch_reader(dataset_url,
@@ -308,7 +319,8 @@ class Reader(object):
                  shuffle_row_groups=True, shuffle_row_drop_partitions=1,
                  seed=None, predicate=None, rowgroup_selector=None,
                  num_epochs=1, cur_shard=None, shard_count=None,
-                 cache=None, transform_spec=None, ngram=None, resume_state=None):
+                 cache=None, transform_spec=None, ngram=None, resume_state=None,
+                 shuffle_rows_in_chunk=False):
         self._store = store
         self.stored_schema = stored_schema
         self.ngram = ngram
@@ -360,6 +372,7 @@ class Reader(object):
             'num_epochs': num_epochs,
             'cur_shard': cur_shard, 'shard_count': shard_count,
             'shuffle_row_drop_partitions': shuffle_row_drop_partitions,
+            'shuffle_rows_in_chunk': bool(shuffle_rows_in_chunk),
             'n_row_groups': len(self._row_groups),
             'predicate': _describe_filter(predicate),
             'selector': _describe_filter(rowgroup_selector),
@@ -394,6 +407,8 @@ class Reader(object):
             'dataset_path_hash': hashlib.md5(store.url.encode()).hexdigest()[:12],
             # fair share of host cores for each worker's native decode threads
             'decode_threads': max(1, (os.cpu_count() or 4) // max(1, self._pool_workers_count())),
+            'shuffle_rows_in_chunk': bool(shuffle_rows_in_chunk),
+            'shuffle_seed': seed,
         }
 
         items = []

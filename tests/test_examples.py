@@ -73,3 +73,20 @@ def test_long_context_lm_example(tmp_path):
                          seq_parallel=8, log_every=1)
     assert params is not None
     assert np.isfinite(loss)
+
+
+def test_preemptible_resume_example(tmp_path):
+    from examples.preemptible.train_resume_example import run
+
+    losses, seen, restored_step = run(
+        dataset_url='file://' + str(tmp_path / 'ds'),
+        ckpt_dir=str(tmp_path / 'ckpt'), batch=16, preempt_after=3,
+        n_rows=128)
+    assert all(np.isfinite(l) for l in losses)
+    # The job resumed from the latest checkpoint (step 2 of 0..2).
+    assert restored_step == 2
+    # Rows delivered after that checkpoint re-deliver on resume; every row
+    # of the epoch is seen at least once and duplicates are bounded by the
+    # post-checkpoint window (one batch here: ckpt at step 2, killed at 3).
+    assert set(seen) == set(range(128))
+    assert len(seen) - len(set(seen)) <= 16

@@ -292,3 +292,70 @@ def test_reset_after_epoch(synthetic_dataset):
         r.reset()
         second = [i for chunk in r for i in chunk.id.tolist()]
     assert sorted(first) == sorted(second) == list(range(50))
+
+
+def test_shuffle_rows_in_chunk_multiset_and_pairing(synthetic_dataset):
+    """In-chunk shuffle: same rows, same id<->field pairing, different order."""
+    kwargs = dict(schema_fields=['id', 'matrix'], reader_pool_type='dummy',
+                  num_epochs=1, shuffle_row_groups=False)
+    with make_tensor_reader(synthetic_dataset.url, **kwargs) as plain:
+        plain_chunks = [np.asarray(c.id).tolist() for c in plain]
+    with make_tensor_reader(synthetic_dataset.url, seed=1,
+                            shuffle_rows_in_chunk=True, **kwargs) as shuf:
+        rows = _collect_by_id(shuf)
+        # recompute chunk order in a second pass for order comparison
+    with make_tensor_reader(synthetic_dataset.url, seed=1,
+                            shuffle_rows_in_chunk=True, **kwargs) as shuf2:
+        shuf_chunks = [np.asarray(c.id).tolist() for c in shuf2]
+
+    # Same chunks as multisets; at least one chunk actually reordered.
+    assert [sorted(c) for c in plain_chunks] == [sorted(c) for c in shuf_chunks]
+    assert any(p != s for p, s in zip(plain_chunks, shuf_chunks))
+    # Field pairing survives the permutation.
+    expected = {int(i): r for i, r in
+                _collect_by_id_ref(synthetic_dataset).items()}
+    for i, row in rows.items():
+        np.testing.assert_array_equal(row['matrix'], expected[i])
+
+
+def _collect_by_id_ref(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=['id', 'matrix'],
+                     reader_pool_type='dummy', num_epochs=1) as r:
+        return {row.id: row.matrix for row in r}
+
+
+def test_shuffle_rows_in_chunk_deterministic_across_sessions(synthetic_dataset):
+    kwargs = dict(schema_fields=['id'], reader_pool_type='dummy', num_epochs=1,
+                  shuffle_row_groups=False, seed=3, shuffle_rows_in_chunk=True)
+    streams = []
+    for _ in range(2):
+        with make_tensor_reader(synthetic_dataset.url, **kwargs) as r:
+            streams.append([np.asarray(c.id).tolist() for c in r])
+    assert streams[0] == streams[1]
+
+
+def test_shuffle_rows_in_chunk_resume_exact(synthetic_dataset):
+    """Mid-epoch checkpoint with the in-chunk shuffle on: the resumed session
+    delivers exactly the complement (the permutation is session-stable)."""
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    kwargs = dict(schema_fields=['id'], reader_pool_type='thread',
+                  workers_count=2, num_epochs=1, seed=5,
+                  shuffle_rows_in_chunk=True)
+    seen1 = []
+    with make_tensor_reader(synthetic_dataset.url, **kwargs) as reader:
+        with JaxLoader(reader, 10, last_batch='drop') as loader:
+            it = iter(loader)
+            for _ in range(2):
+                seen1 += np.asarray(next(it).id).tolist()
+            state = loader.state_dict()
+    seen2 = []
+    with make_tensor_reader(synthetic_dataset.url, resume_state=state,
+                            **kwargs) as reader:
+        with JaxLoader(reader, 10, last_batch='drop') as loader:
+            for b in loader:
+                seen2 += np.asarray(b.id).tolist()
+    assert not (set(seen1) & set(seen2))
+    total = len(seen1) + len(seen2)
+    n_rows = len(_collect_by_id_ref(synthetic_dataset))
+    assert n_rows - 10 < total <= n_rows
