@@ -1,0 +1,315 @@
+"""Disaggregated input service: decode on CPU hosts, train on TPU hosts.
+
+The reference couples reading/decoding to the training process — its worker
+pools parallelize within one host (``workers_pool/process_pool.py``), so an
+input-bound trainer can only buy more local cores. On TPU-VM pods the CPU:
+chip ratio is fixed and often wrong for decode-heavy datasets; the
+tf.data-service design (disaggregate input processing onto a separate CPU
+tier, Audibert et al.) is the structural fix. This module is that tier for
+petastorm_tpu, built on the same zmq transport the process pool already
+uses:
+
+* :class:`DataServer` — owns any Reader (typically the decoded-columnar
+  tensor reader) and republishes its chunks over a zmq **PUSH** socket.
+  PUSH fair-queues across connected consumers, so multiple trainer hosts
+  get disjoint chunk streams with no static sharding (dynamic first-come
+  load balancing — a straggler trainer simply takes fewer chunks).
+  A **PUB** control socket broadcasts end-of-data.
+* :class:`RemoteReader` — the trainer side: connects to one or MANY
+  servers (zmq PULL fair-queues across all of them — scale the decode
+  tier horizontally) and exposes the Reader iteration surface JaxLoader
+  consumes (``batched_output``, namedtuple batches, ``stop/join``,
+  ``diagnostics``).
+
+Semantics vs in-process readers:
+
+* Sharding is dynamic (by chunk pull order), so ``cur_shard`` is no longer
+  meaningful on the trainer — run servers unsharded (or shard servers, not
+  trainers).
+* Mid-epoch checkpoint/resume is a per-Reader feature and does not extend
+  across the service boundary; for elastic/preemptible training prefer
+  ``num_epochs=None`` serving where exact row accounting is not required.
+* Payloads are pickled dicts of decoded numpy blocks (protocol 5); for a
+  224x224 uint8 image chunk that is a single ~O(chunk) memcpy per side.
+"""
+
+import logging
+import pickle
+import threading
+import time
+from collections import namedtuple
+
+logger = logging.getLogger(__name__)
+
+_CTRL_END = b'PST_END'
+_CTRL_ERR = b'PST_ERR'
+
+
+class DataServer(object):
+    """Serve a Reader's output stream to remote trainers.
+
+    :param reader: any petastorm_tpu Reader (tensor reader recommended —
+        decoded columnar chunks amortize serialization).
+    :param bind: zmq endpoint for data, e.g. ``'tcp://*:5555'``.
+    :param control_bind: endpoint for the end-of-data broadcast (default:
+        data port + 1 when ``bind`` is tcp with an explicit port).
+    :param sndhwm: per-consumer high-water mark (chunks buffered in zmq
+        before the server blocks — the service's backpressure).
+    """
+
+    def __init__(self, reader, bind, control_bind=None, sndhwm=4):
+        import zmq
+
+        self._reader = reader
+        self._zmq = zmq
+        self._context = zmq.Context.instance()
+        self._data_sock = self._context.socket(zmq.PUSH)
+        self._data_sock.setsockopt(zmq.SNDHWM, sndhwm)
+        self._data_sock.bind(bind)
+        # Resolve wildcard ports ('tcp://127.0.0.1:*') to the actual bind.
+        actual = self._data_sock.getsockopt(zmq.LAST_ENDPOINT).decode()
+        if control_bind is None:
+            control_bind = _next_port_endpoint(actual)
+        self._ctrl_sock = self._context.socket(zmq.PUB)
+        self._ctrl_sock.bind(control_bind)
+        self.data_endpoint = _connectable(actual)
+        self.control_endpoint = _connectable(
+            self._ctrl_sock.getsockopt(zmq.LAST_ENDPOINT).decode())
+        self._thread = None
+        self._stop = threading.Event()
+        self._serving_done = threading.Event()
+        self._served_chunks = 0
+        import uuid
+        # END messages carry the server's identity: a client connected to N
+        # servers must see N DISTINCT ends (one server repeats its broadcast
+        # for slow joiners and must not count N times).
+        self._server_id = uuid.uuid4().bytes
+
+    def serve_forever(self):
+        """Blocking serve loop: pull batches off the reader, push to
+        whichever trainer asks first; broadcast END when the reader ends
+        (or an error marker if it failed — trainers re-raise, they must
+        never mistake a half-served dataset for a clean epoch)."""
+        marker = _CTRL_END + self._server_id
+        try:
+            for sample in self._reader:
+                if self._stop.is_set():
+                    return
+                payload = pickle.dumps(
+                    {name: getattr(sample, name) for name in sample._fields},
+                    protocol=pickle.HIGHEST_PROTOCOL)
+                while not self._stop.is_set():
+                    try:
+                        self._data_sock.send(payload,
+                                             flags=self._zmq.NOBLOCK)
+                        self._served_chunks += 1
+                        break
+                    except self._zmq.Again:
+                        time.sleep(0.005)   # all consumers at HWM
+        except Exception as e:  # noqa: BLE001 - forwarded to trainers
+            logger.exception('data server reader failed')
+            marker = (_CTRL_ERR + self._server_id
+                      + repr(e).encode('utf-8', 'replace')[:512])
+        finally:
+            # Broadcast until stopped: PUB drops messages for slow-JOINING
+            # subscribers, so a client that dials in after the data ended
+            # still learns the stream is over.
+            logger.info('data server done: %d chunks served', self._served_chunks)
+            self._serving_done.set()
+            while not self._stop.is_set():
+                self._ctrl_sock.send(marker)
+                time.sleep(0.05)
+
+    def start(self):
+        """Serve on a background thread (returns immediately)."""
+        if self._thread is not None:
+            raise RuntimeError('server already started')
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def served_chunks(self):
+        return self._served_chunks
+
+    def stop(self):
+        self._stop.set()
+        # Stop the reader FIRST: it unblocks a serve thread parked inside
+        # `for sample in self._reader`. zmq sockets are not thread-safe, so
+        # they may only be closed once the serve thread has provably exited.
+        self._reader.stop()
+        self._reader.join()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self._thread is None or not self._thread.is_alive():
+            self._data_sock.close(linger=0)
+            self._ctrl_sock.close(linger=0)
+        else:
+            logger.warning('serve thread still running after stop(); '
+                           'leaking its zmq sockets rather than closing '
+                           'them from another thread')
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+
+def serve_dataset(dataset_url, bind, reader_factory=None, start=True,
+                  **reader_kwargs):
+    """Convenience: build a tensor reader over ``dataset_url`` and serve it.
+
+    Returns the started :class:`DataServer` (context-manage it). Extra
+    kwargs go to :func:`~petastorm_tpu.reader.make_tensor_reader` (or to
+    ``reader_factory`` if given — use ``make_batch_reader`` for plain
+    stores).
+    """
+    from petastorm_tpu.reader import make_tensor_reader
+
+    factory = reader_factory or make_tensor_reader
+    reader = factory(dataset_url, **reader_kwargs)
+    try:
+        server = DataServer(reader, bind)
+    except Exception:
+        # e.g. bind: address already in use — don't leak the started pool.
+        reader.stop()
+        reader.join()
+        raise
+    return server.start() if start else server
+
+
+class RemoteReader(object):
+    """Trainer-side consumer of one or more :class:`DataServer` streams.
+
+    Implements the Reader surface :class:`~petastorm_tpu.jax_loader.
+    JaxLoader` needs: iterate namedtuples of column blocks
+    (``batched_output=True``), ``stop``/``join``, ``diagnostics``.
+
+    :param endpoints: data endpoint(s), e.g. ``'tcp://host:5555'`` or a
+        list — PULL fair-queues across all connected servers.
+    :param control_endpoints: matching END-broadcast endpoint(s); default
+        derives data port + 1 for each endpoint.
+    :param rcvhwm: chunks buffered locally before backpressuring servers.
+    :param poll_timeout_s: receive poll granularity.
+    """
+
+    batched_output = True
+
+    def __init__(self, endpoints, control_endpoints=None, rcvhwm=4,
+                 poll_timeout_s=0.1):
+        import zmq
+
+        if isinstance(endpoints, str):
+            endpoints = [endpoints]
+        if control_endpoints is None:
+            control_endpoints = [_next_port_endpoint(e) for e in endpoints]
+        elif isinstance(control_endpoints, str):
+            control_endpoints = [control_endpoints]
+        self._zmq = zmq
+        self._context = zmq.Context.instance()
+        self._data_sock = self._context.socket(zmq.PULL)
+        self._data_sock.setsockopt(zmq.RCVHWM, rcvhwm)
+        for endpoint in endpoints:
+            self._data_sock.connect(endpoint)
+        self._ctrl_sock = self._context.socket(zmq.SUB)
+        self._ctrl_sock.setsockopt(zmq.SUBSCRIBE, b'')
+        self._n_servers = len(endpoints)
+        for endpoint in control_endpoints:
+            self._ctrl_sock.connect(endpoint)
+        self._poll_ms = int(poll_timeout_s * 1000)
+        self._ended_server_ids = set()
+        self._server_errors = {}
+        self._stopped = False
+        self._nt_cache = {}
+        self._chunks = 0
+        self.last_row_consumed = False
+
+    def __iter__(self):
+        return self
+
+    def _drain_control(self):
+        zmq = self._zmq
+        try:
+            while True:
+                msg = self._ctrl_sock.recv(flags=zmq.NOBLOCK)
+                if msg.startswith(_CTRL_ERR):
+                    body = msg[len(_CTRL_ERR):]
+                    self._server_errors[body[:16]] = body[16:].decode(
+                        'utf-8', 'replace')
+                    self._ended_server_ids.add(body[:16])
+                elif msg.startswith(_CTRL_END):
+                    self._ended_server_ids.add(msg[len(_CTRL_END):])
+        except zmq.Again:
+            pass
+
+    def __next__(self):
+        zmq = self._zmq
+        while True:
+            if self._stopped:
+                raise StopIteration
+            try:
+                blob = self._data_sock.recv(flags=zmq.NOBLOCK)
+            except zmq.Again:
+                # No data pending: check for END/ERR broadcasts, re-poll.
+                # Only after EVERY connected server has ended (and a grace
+                # poll shows the data socket stayed empty — END rides a
+                # separate socket and can overtake in-flight tail chunks)
+                # is the stream over.
+                self._drain_control()
+                if len(self._ended_server_ids) >= self._n_servers:
+                    if self._data_sock.poll(max(self._poll_ms, 250)):
+                        continue   # tail chunk arrived during grace
+                    if self._server_errors:
+                        self._stopped = True
+                        raise RuntimeError(
+                            'data server(s) failed mid-stream: {}'.format(
+                                sorted(self._server_errors.values())))
+                    self.last_row_consumed = True
+                    raise StopIteration
+                self._data_sock.poll(self._poll_ms)
+                continue
+            cols = pickle.loads(blob)
+            self._chunks += 1
+            names = tuple(sorted(cols))
+            nt = self._nt_cache.get(names)
+            if nt is None:
+                nt = namedtuple('RemoteChunk', names)
+                self._nt_cache[names] = nt
+            return nt(**{n: cols[n] for n in names})
+
+    @property
+    def diagnostics(self):
+        return {'remote_chunks': self._chunks,
+                'servers': self._n_servers,
+                'servers_ended': len(self._ended_server_ids)}
+
+    def stop(self):
+        self._stopped = True
+        self._data_sock.close(linger=0)
+        self._ctrl_sock.close(linger=0)
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+
+def _next_port_endpoint(endpoint):
+    """tcp endpoint with port + 1 (control channel convention)."""
+    if not endpoint.startswith('tcp://') or ':' not in endpoint[6:]:
+        raise ValueError('control endpoint must be given explicitly for '
+                         'non-tcp/portless endpoint {!r}'.format(endpoint))
+    host, port = endpoint[6:].rsplit(':', 1)
+    return 'tcp://{}:{}'.format(host, int(port) + 1)
+
+
+def _connectable(bound_endpoint):
+    """'tcp://*:5555' -> 'tcp://127.0.0.1:5555' (what clients can dial)."""
+    return bound_endpoint.replace('tcp://*:', 'tcp://127.0.0.1:')

@@ -1,0 +1,147 @@
+"""Disaggregated input service (``petastorm_tpu/data_service.py``):
+DataServer republishes a Reader's decoded chunks over zmq; RemoteReader(s)
+consume them with dynamic (pull-order) sharding, including through JaxLoader.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_tensor_reader
+from petastorm_tpu.data_service import DataServer, RemoteReader, serve_dataset
+
+N_ROWS = 64
+
+
+@pytest.fixture(scope='module')
+def service_dataset(tmp_path_factory):
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('Svc', [
+        UnischemaField('vec', np.float32, (4,), NdarrayCodec(), False),
+        UnischemaField('sid', np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    rng = np.random.default_rng(21)
+    url = 'file://' + str(tmp_path_factory.mktemp('svc') / 'store')
+    write_dataset(url, schema,
+                  ({'vec': rng.standard_normal(4).astype(np.float32),
+                    'sid': i} for i in range(N_ROWS)),
+                  rows_per_row_group=8)
+    return url
+
+
+def _drain_ids(reader):
+    out = []
+    for chunk in reader:
+        out.extend(int(i) for i in np.asarray(chunk.sid))
+    return out
+
+
+def test_roundtrip_single_client(service_dataset):
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0) as server:
+        with RemoteReader(server.data_endpoint) as remote:
+            ids = _drain_ids(remote)
+    assert sorted(ids) == list(range(N_ROWS))
+    assert remote.diagnostics['remote_chunks'] == server.served_chunks
+
+
+def test_two_clients_disjoint_union(service_dataset):
+    """PUSH fair-queuing = dynamic sharding: two trainers see disjoint
+    chunks whose union is the dataset."""
+    results = {}
+
+    def consume(name, endpoint):
+        with RemoteReader(endpoint) as remote:
+            results[name] = _drain_ids(remote)
+
+    reader = make_tensor_reader(service_dataset, num_epochs=1, seed=0)
+    with DataServer(reader, 'tcp://127.0.0.1:*') as server:
+        threads = [threading.Thread(
+            target=consume, args=(n, server.data_endpoint))
+            for n in ('a', 'b')]
+        for t in threads:
+            t.start()
+        server.start()
+        for t in threads:
+            t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads)
+    a, b = set(results['a']), set(results['b'])
+    assert not (a & b)
+    assert sorted(a | b) == list(range(N_ROWS))
+
+
+def test_multi_server_fan_in(service_dataset, tmp_path):
+    """One trainer pulling from two servers (horizontal decode scale-out):
+    stream ends only after BOTH servers end; all chunks arrive."""
+    s1 = serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0)
+    s2 = serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=1)
+    with s1, s2:
+        with RemoteReader([s1.data_endpoint, s2.data_endpoint]) as remote:
+            ids = _drain_ids(remote)
+    # Two full passes of the dataset (one per server), dynamically merged.
+    assert len(ids) == 2 * N_ROWS
+    assert sorted(set(ids)) == list(range(N_ROWS))
+
+
+def test_jax_loader_over_remote_reader(service_dataset):
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0) as server:
+        with RemoteReader(server.data_endpoint) as remote:
+            with JaxLoader(remote, 16, last_batch='drop') as loader:
+                ids, shapes = [], set()
+                for batch in loader:
+                    ids.extend(int(i) for i in np.asarray(batch.sid))
+                    shapes.add(batch.vec.shape)
+    assert shapes == {(16, 4)}
+    assert len(ids) == N_ROWS  # 64 % 16 == 0: nothing dropped
+    assert sorted(ids) == list(range(N_ROWS))
+
+
+def test_client_stop_mid_stream(service_dataset):
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=None, seed=0) as server:
+        with RemoteReader(server.data_endpoint) as remote:
+            got = 0
+            for _ in remote:
+                got += 1
+                if got >= 3:
+                    break
+    assert got == 3  # infinite serving; the client just walks away
+
+
+def test_server_error_propagates_to_client(service_dataset):
+    """A mid-stream reader failure must surface on the trainer as an error,
+    never as a clean (half-dataset) end of epoch."""
+    from petastorm_tpu.transform import TransformSpec
+
+    def explode(cols):
+        raise RuntimeError('decode tier exploded')
+
+    reader = make_tensor_reader(service_dataset, num_epochs=1, seed=0,
+                                transform_spec=TransformSpec(explode))
+    with DataServer(reader, 'tcp://127.0.0.1:*') as server:
+        with RemoteReader(server.data_endpoint) as remote:
+            server.start()
+            with pytest.raises(RuntimeError, match='failed mid-stream'):
+                _drain_ids(remote)
+
+
+def test_serve_dataset_cleans_up_reader_on_bind_failure(service_dataset):
+    import zmq
+    blocker = serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                            num_epochs=1, seed=0)
+    with blocker:
+        with pytest.raises(zmq.ZMQError):
+            # Same resolved port: bind fails; the factory's reader pool must
+            # be stopped, not leaked (no assertion hook — the test passing
+            # without hanging at interpreter exit is the check).
+            serve_dataset(service_dataset, blocker.data_endpoint,
+                          num_epochs=1, seed=0)
