@@ -60,6 +60,14 @@ class DataServer(object):
     def __init__(self, reader, bind, control_bind=None, sndhwm=4):
         import zmq
 
+        if not getattr(reader, 'batched_output', False):
+            # RemoteReader presents the stream as batched chunks; a per-row
+            # reader would ship one tiny pickle per ROW and the trainer-side
+            # JaxLoader would mis-treat scalars as columns.
+            raise ValueError(
+                'DataServer requires a batched reader (make_tensor_reader / '
+                'make_batch_reader); got a per-row reader. Per-row decode '
+                'belongs on the trainer for row-granular pipelines.')
         self._reader = reader
         self._zmq = zmq
         self._context = zmq.Context.instance()

@@ -145,3 +145,10 @@ def test_serve_dataset_cleans_up_reader_on_bind_failure(service_dataset):
             # without hanging at interpreter exit is the check).
             serve_dataset(service_dataset, blocker.data_endpoint,
                           num_epochs=1, seed=0)
+
+
+def test_per_row_reader_rejected(service_dataset):
+    from petastorm_tpu import make_reader
+    with make_reader(service_dataset, num_epochs=1) as reader:
+        with pytest.raises(ValueError, match='batched reader'):
+            DataServer(reader, 'tcp://127.0.0.1:*')
