@@ -9,8 +9,9 @@ tier, Audibert et al.) is the structural fix. This module is that tier for
 petastorm_tpu, built on the same zmq transport the process pool already
 uses:
 
-* :class:`DataServer` — owns any Reader (typically the decoded-columnar
-  tensor reader) and republishes its chunks over a zmq **PUSH** socket.
+* :class:`DataServer` — owns a batched Reader (the decoded-columnar tensor
+  reader, or ``make_batch_reader`` for plain stores; per-row readers are
+  rejected) and republishes its chunks over a zmq **PUSH** socket.
   PUSH fair-queues across connected consumers, so multiple trainer hosts
   get disjoint chunk streams with no static sharding (dynamic first-come
   load balancing — a straggler trainer simply takes fewer chunks).
@@ -48,8 +49,9 @@ _CTRL_ERR = b'PST_ERR'
 class DataServer(object):
     """Serve a Reader's output stream to remote trainers.
 
-    :param reader: any petastorm_tpu Reader (tensor reader recommended —
-        decoded columnar chunks amortize serialization).
+    :param reader: a batched petastorm_tpu Reader — ``make_tensor_reader``
+        (recommended: decoded columnar chunks amortize serialization) or
+        ``make_batch_reader``. Per-row readers raise ``ValueError``.
     :param bind: zmq endpoint for data, e.g. ``'tcp://*:5555'``.
     :param control_bind: endpoint for the end-of-data broadcast (default:
         data port + 1 when ``bind`` is tcp with an explicit port).
