@@ -38,7 +38,8 @@ import logging
 import pickle
 import threading
 import time
-from collections import namedtuple
+
+from petastorm_tpu.utils import cached_namedtuple
 
 logger = logging.getLogger(__name__)
 
@@ -282,11 +283,8 @@ class RemoteReader(object):
                 continue
             cols = pickle.loads(blob)
             self._chunks += 1
-            names = tuple(sorted(cols))
-            nt = self._nt_cache.get(names)
-            if nt is None:
-                nt = namedtuple('RemoteChunk', names)
-                self._nt_cache[names] = nt
+                names = tuple(sorted(cols))
+            nt = cached_namedtuple(self._nt_cache, 'RemoteChunk', names)
             return nt(**{n: cols[n] for n in names})
 
     @property

@@ -23,9 +23,10 @@ import queue
 import threading
 import time
 import warnings
-from collections import namedtuple
 
 import numpy as np
+
+from petastorm_tpu.utils import cached_namedtuple
 
 logger = logging.getLogger(__name__)
 
@@ -641,10 +642,7 @@ class JaxLoader(object):
             self._exhausted = True
             raise item
         names = tuple(sorted(item))
-        nt = self._namedtuple_cache.get(names)
-        if nt is None:
-            nt = namedtuple('JaxBatch', names)
-            self._namedtuple_cache[names] = nt
+        nt = cached_namedtuple(self._namedtuple_cache, 'JaxBatch', names)
         self._batches_delivered += 1
         if self._row_granular_ckpt and fresh:
             # A padded final batch over-reports by the pad amount; the

@@ -7,6 +7,22 @@ reference's other utils live elsewhere here: ``decode_row`` ->
 """
 
 
+def cached_namedtuple(cache, type_name, names):
+    """Namedtuple type for ``names``, memoized in the caller's ``cache`` dict.
+
+    Consumers that assemble batches from dict payloads (``JaxLoader``,
+    ``RemoteReader``) must hand out the SAME type per field set — type
+    equality is what lets downstream code (e.g. ``tf.data`` structure
+    checks) treat consecutive batches as one structure.
+    """
+    nt = cache.get(names)
+    if nt is None:
+        from collections import namedtuple
+        nt = namedtuple(type_name, names)
+        cache[names] = nt
+    return nt
+
+
 def run_in_subprocess(func, *args, **kwargs):
     """Run ``func(*args, **kwargs)`` in a one-shot subprocess and return its
     result — isolates memory leaks / library state from the calling process
