@@ -283,7 +283,7 @@ class RemoteReader(object):
                 continue
             cols = pickle.loads(blob)
             self._chunks += 1
-                names = tuple(sorted(cols))
+            names = tuple(sorted(cols))
             nt = cached_namedtuple(self._nt_cache, 'RemoteChunk', names)
             return nt(**{n: cols[n] for n in names})
 
