@@ -19,6 +19,7 @@ import pyarrow as pa
 
 from petastorm_tpu.checkpoint import DeferredRowAccounting, chunk_key
 from petastorm_tpu.workers.rowgroup_worker_base import (RowGroupWorkerBase,
+                                                        chunk_row_permutation,
                                                         compute_row_slice)
 
 
@@ -41,6 +42,16 @@ class ArrowWorker(RowGroupWorkerBase):
         transform_spec = self.args.get('transform_spec')
         if transform_spec is not None and transform_spec.func is not None:
             table = self._apply_transform(table, transform_spec)
+
+        if table.num_rows and self.args.get('shuffle_rows_in_chunk'):
+            # Same session-stable permutation as the tensor path
+            # (chunk_row_permutation): decorrelates storage order within
+            # the chunk, keeps resume row-skips exact.
+            perm = chunk_row_permutation(
+                self.args.get('shuffle_seed'), self.args['dataset_path_hash'],
+                piece.path, piece.row_group, shuffle_row_drop_partition,
+                table.num_rows)
+            table = table.take(pa.array(perm))
 
         if table.num_rows:
             # Ventilation key rides in the schema metadata (survives the Arrow

@@ -256,11 +256,15 @@ def make_batch_reader(dataset_url,
                       storage_options=None,
                       shm_result_ring_bytes=None,
                       resume_state=None,
-                      pool_profiling=False):
+                      pool_profiling=False,
+                      shuffle_rows_in_chunk=False):
     """Columnar batch reader for **any** Parquet store (no codecs needed).
 
     Parity: reference ``petastorm/reader.py:177-289``. Warns when pointed at a
     materialized petastorm_tpu store (``reader.py:242-249``).
+
+    ``shuffle_rows_in_chunk=True`` permutes each chunk's rows inside the
+    worker (session-stable permutation — see ``make_tensor_reader``).
     """
     store = ParquetStore(dataset_url, storage_options)
     try:
@@ -291,7 +295,8 @@ def make_batch_reader(dataset_url,
                   seed=seed, predicate=predicate, rowgroup_selector=rowgroup_selector,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec,
-                  resume_state=resume_state)
+                  resume_state=resume_state,
+                  shuffle_rows_in_chunk=shuffle_rows_in_chunk)
 
 
 def _describe_filter(obj):

@@ -32,6 +32,7 @@ from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec,
                                   _native_image)
 from petastorm_tpu.errors import DecodeFieldError
 from petastorm_tpu.workers.rowgroup_worker_base import (RowGroupWorkerBase,
+                                                        chunk_row_permutation,
                                                         compute_row_slice)
 
 logger = logging.getLogger(__name__)
@@ -246,32 +247,6 @@ class TensorResultsQueueReader(DeferredRowAccounting):
             break
         names = [n for n in schema.fields if n in cols]
         return schema.make_namedtuple(**{n: cols[n] for n in names})
-
-
-def chunk_row_permutation(seed, dataset_hash, piece_path, row_group,
-                          shuffle_row_drop_partition, n_rows):
-    """Stable row permutation for one chunk (``shuffle_rows_in_chunk``).
-
-    Keyed by the row-group's identity, NOT by epoch or arrival order — the
-    same chunk permutes identically in every epoch and every session, which
-    is what keeps checkpoint-resume row skips exact. The permutation is
-    computed by argsorting a splitmix64 hash of each row index (NOT a numpy
-    Generator stream, whose bit-exactness across numpy versions is not
-    guaranteed — a resume under a different numpy must reproduce it).
-    """
-    import hashlib
-    drop_idx = shuffle_row_drop_partition[0] if shuffle_row_drop_partition else 0
-    digest = hashlib.md5('{}:{}:{}:{}:{}'.format(
-        seed, dataset_hash, piece_path, row_group, drop_idx).encode()).digest()
-    base = np.uint64(int.from_bytes(digest[:8], 'little'))
-    z = np.arange(n_rows, dtype=np.uint64) + base
-    # splitmix64 finalizer: well-mixed, pure uint64 arithmetic (wraps mod
-    # 2^64 in numpy), identical on every platform/version.
-    z = (z + np.uint64(0x9E3779B97F4A7C15))
-    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-    z = z ^ (z >> np.uint64(31))
-    return np.argsort(z, kind='stable')
 
 
 # --------------------------------------------------------------------------

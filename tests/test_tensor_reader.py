@@ -359,3 +359,21 @@ def test_shuffle_rows_in_chunk_resume_exact(synthetic_dataset):
     total = len(seen1) + len(seen2)
     n_rows = len(_collect_by_id_ref(synthetic_dataset))
     assert n_rows - 10 < total <= n_rows
+
+
+def test_batch_reader_shuffle_rows_in_chunk(scalar_dataset):
+    """The arrow path shares the tensor path's in-chunk permutation."""
+    from petastorm_tpu import make_batch_reader
+
+    kwargs = dict(schema_fields=['id'], reader_pool_type='dummy',
+                  num_epochs=1, shuffle_row_groups=False)
+    with make_batch_reader(scalar_dataset.url, **kwargs) as plain:
+        plain_chunks = [np.asarray(c.id).tolist() for c in plain]
+    streams = []
+    for _ in range(2):
+        with make_batch_reader(scalar_dataset.url, seed=4,
+                               shuffle_rows_in_chunk=True, **kwargs) as shuf:
+            streams.append([np.asarray(c.id).tolist() for c in shuf])
+    assert streams[0] == streams[1]                       # session-stable
+    assert [sorted(c) for c in streams[0]] == [sorted(c) for c in plain_chunks]
+    assert any(p != s for p, s in zip(plain_chunks, streams[0]))
