@@ -152,3 +152,20 @@ def test_per_row_reader_rejected(service_dataset):
     with make_reader(service_dataset, num_epochs=1) as reader:
         with pytest.raises(ValueError, match='batched reader'):
             DataServer(reader, 'tcp://127.0.0.1:*')
+
+
+def test_remote_reader_mesh_staging(service_dataset):
+    """Remote chunks stage onto an 8-device mesh exactly like local ones."""
+    from petastorm_tpu.jax_loader import JaxLoader
+    from petastorm_tpu.parallel import make_mesh
+
+    mesh = make_mesh({'data': 8})
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0) as server:
+        with RemoteReader(server.data_endpoint) as remote:
+            with JaxLoader(remote, 16, mesh=mesh, last_batch='drop') as loader:
+                ids = []
+                for batch in loader:
+                    assert len(batch.vec.sharding.device_set) == 8
+                    ids.extend(int(i) for i in np.asarray(batch.sid))
+    assert sorted(ids) == list(range(N_ROWS))
