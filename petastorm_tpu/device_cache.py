@@ -82,6 +82,7 @@ class DeviceDatasetCache(object):
                            else _default_budget(jax))
         self._take = None
         self._streaming = False
+        self._overflow_msg = None
         self._cleared = False
 
     # -- introspection -----------------------------------------------------
@@ -104,6 +105,16 @@ class DeviceDatasetCache(object):
             raise RuntimeError('DeviceDatasetCache was cleared; construct a '
                                'new cache over a fresh loader')
         if self._columns is None:
+            if self._overflow_msg is not None:
+                # The caching epoch overflowed the budget — the "abandoned
+                # mid-stream" message below would misleadingly suggest the
+                # stream can be finished; it cannot (the source loader was
+                # part-consumed). Point at the actual failure and the fix.
+                raise DeviceCacheOverflow(
+                    'the caching epoch previously overflowed: {} — this '
+                    'cache cannot be retried; construct a new '
+                    'DeviceDatasetCache (with a larger max_bytes) over a '
+                    'fresh loader'.format(self._overflow_msg))
             if self._streaming:
                 # A partially-consumed epoch-0 generator left the loader
                 # mid-stream; restarting would silently cache a fraction of
@@ -124,12 +135,13 @@ class DeviceDatasetCache(object):
             self._bytes += sum(getattr(batch, f).nbytes for f in batch._fields)
             per_dev_bytes += _per_device_nbytes(batch)
             if self._max_bytes and per_dev_bytes > self._max_bytes:
-                raise DeviceCacheOverflow(
+                self._overflow_msg = (
                     'device cache exceeded {:.2f} GB per-device budget after '
                     '{} batches ({:.2f} GB/device staged); raise max_bytes or '
                     'drop the cache for this dataset'.format(
                         self._max_bytes / 1e9, len(batches) + 1,
                         per_dev_bytes / 1e9))
+                raise DeviceCacheOverflow(self._overflow_msg)
             batches.append(batch)
             self._nt_type = type(batch)
             yield batch

@@ -133,6 +133,12 @@ def partitioned_synthetic_dataset(tmp_path_factory):
 
 def pytest_configure(config):
     config.addinivalue_line('markers', 'processpool: spawns real worker processes (slower)')
+    config.addinivalue_line(
+        'markers',
+        'slow: heavyweight tests (interpret-mode Pallas, transformer/MoE/'
+        'pipeline training, timing gates). The fast CI lane skips them: '
+        'pytest -m "not slow" finishes in minutes; run the full suite '
+        'before shipping.')
 
 
 TimeseriesSchema = Unischema('TimeseriesSchema', [

@@ -51,11 +51,13 @@ def test_roundtrip_single_client(service_dataset):
 
 def test_two_clients_disjoint_union(service_dataset):
     """PUSH fair-queuing = dynamic sharding: two trainers see disjoint
-    chunks whose union is the dataset."""
+    chunks whose union is the dataset. Shared streams opt out of the
+    exact per-consumer chunk accounting (counts are unknowable)."""
     results = {}
 
     def consume(name, endpoint):
-        with RemoteReader(endpoint) as remote:
+        with RemoteReader(endpoint, shared_stream=True,
+                          end_grace_s=1.0) as remote:
             results[name] = _drain_ids(remote)
 
     reader = make_tensor_reader(service_dataset, num_epochs=1, seed=0)
@@ -152,6 +154,182 @@ def test_per_row_reader_rejected(service_dataset):
     with make_reader(service_dataset, num_epochs=1) as reader:
         with pytest.raises(ValueError, match='batched reader'):
             DataServer(reader, 'tcp://127.0.0.1:*')
+
+
+def test_zero_copy_frames_roundtrip():
+    """Wire format: protocol-5 header + per-column out-of-band frames;
+    reconstructed arrays alias the frame memory (no payload copy)."""
+    from petastorm_tpu.data_service import _dump_frames, _load_frames
+
+    cols = {'a': np.arange(32, dtype=np.float32).reshape(8, 4),
+            'b': np.arange(8, dtype=np.int64)}
+    frames = _dump_frames(cols)
+    # One frame per contiguous column + the header.
+    assert len(frames) == 3
+    out = _load_frames(frames)
+    np.testing.assert_array_equal(out['a'], cols['a'])
+    np.testing.assert_array_equal(out['b'], cols['b'])
+
+
+def test_end_accounting_raises_on_lost_tail(service_dataset):
+    """A sole consumer whose received total falls short of the advertised
+    count must fail loudly, not truncate the epoch (a second, never-read
+    consumer socket swallows chunks to simulate the loss)."""
+    import zmq
+
+    reader = make_tensor_reader(service_dataset, num_epochs=1, seed=0)
+    with DataServer(reader, 'tcp://127.0.0.1:*') as server:
+        ctx = zmq.Context.instance()
+        thief = ctx.socket(zmq.PULL)
+        thief.setsockopt(zmq.RCVHWM, 1000)
+        thief.connect(server.data_endpoint)
+        try:
+            with RemoteReader(server.data_endpoint,
+                              end_grace_s=1.0) as remote:
+                server.start()
+                with pytest.raises(RuntimeError, match='advertised chunks'):
+                    _drain_ids(remote)
+        finally:
+            thief.close(linger=0)
+
+
+def test_checkpoint_resume_across_service(service_dataset):
+    """Exactly-once across the service boundary: consume part of the
+    stream, state_dict() (pauses servers, drains in-flight chunks),
+    tear everything down, restart server + reader from the state, and
+    verify the union is exactly the dataset with no duplicates."""
+    ids_before = []
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0, workers_count=1) as server:
+        remote = RemoteReader(server.data_endpoint)
+        with remote:
+            for _ in range(2):
+                chunk = next(remote)
+                ids_before.extend(int(i) for i in np.asarray(chunk.sid))
+            state = remote.state_dict()
+    # Both sides are gone; bring up a fresh pair from the snapshot.
+    assert state['server_states'][0] is not None
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0, workers_count=1,
+                       resume_state=state['server_states'][0]) as server2:
+        with RemoteReader(server2.data_endpoint,
+                          resume_state=state) as remote2:
+            ids_after = _drain_ids(remote2)
+    assert sorted(ids_before + ids_after) == list(range(N_ROWS))
+
+
+def test_jax_loader_checkpoint_over_service(service_dataset):
+    """Exactly-once through the full production stack: JaxLoader (with a
+    prefetch queue) over RemoteReader. Rows sitting in the prefetch queue
+    at checkpoint time must re-deliver on resume — RemoteReader implements
+    the same row-granular accounting protocol as local readers."""
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    ids_before = []
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0, workers_count=1) as server:
+        with RemoteReader(server.data_endpoint) as remote:
+            loader = JaxLoader(remote, 8, last_batch='drop', prefetch=4)
+            it = iter(loader)
+            for _ in range(2):
+                batch = next(it)
+                ids_before.extend(int(i) for i in np.asarray(batch.sid))
+            state = loader.state_dict()
+            loader.stop()
+    assert len(ids_before) == 16
+    ids_after = []
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0, workers_count=1,
+                       resume_state=state['server_states'][0]) as server2:
+        with RemoteReader(server2.data_endpoint,
+                          resume_state=state) as remote2:
+            with JaxLoader(remote2, 8, last_batch='drop') as loader2:
+                for batch in loader2:
+                    ids_after.extend(int(i) for i in np.asarray(batch.sid))
+    assert not (set(ids_before) & set(ids_after)), 'rows delivered twice'
+    assert sorted(ids_before + ids_after) == list(range(N_ROWS)), (
+        'rows lost across the service checkpoint')
+
+
+def test_checkpoint_keeps_serving_after_snapshot(service_dataset):
+    """state_dict() must pause-and-RESUME: the same reader pair finishes
+    the epoch after a mid-stream snapshot."""
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0) as server:
+        with RemoteReader(server.data_endpoint) as remote:
+            first = next(remote)
+            ids = [int(i) for i in np.asarray(first.sid)]
+            state = remote.state_dict()
+            assert isinstance(state['pending'], list)
+            ids.extend(_drain_ids(remote))
+    assert sorted(ids) == list(range(N_ROWS))
+
+
+@pytest.fixture(scope='module')
+def throughput_dataset(tmp_path_factory):
+    """A store big enough to time: 16k rows x 256 floats (~16 MB)."""
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    n = 16384
+    schema = Unischema('Tp', [
+        UnischemaField('vec', np.float32, (256,), NdarrayCodec(), False),
+        UnischemaField('sid', np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    rng = np.random.default_rng(7)
+    url = 'file://' + str(tmp_path_factory.mktemp('tp') / 'store')
+    write_dataset(url, schema,
+                  ({'vec': rng.standard_normal(256).astype(np.float32),
+                    'sid': i} for i in range(n)),
+                  rows_per_row_group=2048)
+    return url, n
+
+
+def _time_rows_per_sec(make_iter, n_rows, repeats=3):
+    import time as _time
+    rates = []
+    for _ in range(repeats):
+        it, closer = make_iter()
+        rows = 0
+        t0 = _time.perf_counter()
+        for chunk in it:
+            rows += len(np.asarray(chunk.sid))
+        dt = _time.perf_counter() - t0
+        closer()
+        assert rows == n_rows
+        rates.append(rows / dt)
+    return max(rates)
+
+
+@pytest.mark.slow
+def test_remote_throughput_vs_local(throughput_dataset):
+    """The zero-copy service transport must not be the bottleneck:
+    RemoteReader over loopback sustains >=80% of the local tensor-reader
+    rate on the same store (VERDICT r3 #6). A timing gate — marked slow
+    so the default lane stays deterministic; best-of-3 per side damps
+    shared-box scheduler noise."""
+    url, n_rows = throughput_dataset
+
+    def local():
+        reader = make_tensor_reader(url, num_epochs=1, workers_count=2)
+        return iter(reader), lambda: (reader.stop(), reader.join())
+
+    def remote():
+        server = serve_dataset(url, 'tcp://127.0.0.1:*', num_epochs=1,
+                               workers_count=2, sndhwm=8)
+        reader = RemoteReader(server.data_endpoint, rcvhwm=8)
+        return iter(reader), lambda: (reader.stop(), reader.join(),
+                                      server.stop())
+
+    local_rate = _time_rows_per_sec(local, n_rows)
+    remote_rate = _time_rows_per_sec(remote, n_rows)
+    print('\nservice throughput: local={:.0f} rows/s remote={:.0f} rows/s '
+          '({:.0%})'.format(local_rate, remote_rate,
+                            remote_rate / local_rate))
+    assert remote_rate >= 0.8 * local_rate, (
+        'remote {:.0f} rows/s < 80% of local {:.0f} rows/s'.format(
+            remote_rate, local_rate))
 
 
 def test_remote_reader_mesh_staging(service_dataset):
