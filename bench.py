@@ -124,10 +124,10 @@ def _ensure_imagenet_dataset():
 # host-CPU reader throughput (the reference's benchmark quantity)
 # --------------------------------------------------------------------------
 
-def _measure_reader(url, workers, cache_type='null'):
+def _measure_reader(url, workers, cache_type='null', pool='thread'):
     from petastorm_tpu import make_reader
 
-    with make_reader(url, reader_pool_type='thread', workers_count=workers,
+    with make_reader(url, reader_pool_type=pool, workers_count=workers,
                      num_epochs=None, shuffle_row_groups=True, seed=0,
                      cache_type=cache_type) as reader:
         for _ in range(_WARMUP_SAMPLES):
@@ -152,7 +152,7 @@ def _force_cpu_if_requested(jax):
         jax.config.update('jax_platforms', 'cpu')
 
 
-def _child_staging(url, workers):
+def _child_staging(url, workers, pool='thread'):
     """hello_world batches staged to the default JAX device."""
     import jax
 
@@ -163,7 +163,7 @@ def _child_staging(url, workers):
 
     batch = 32
     n_batches = 40
-    with make_reader(url, reader_pool_type='thread', workers_count=workers,
+    with make_reader(url, reader_pool_type=pool, workers_count=workers,
                      num_epochs=None, shuffle_row_groups=True, seed=0) as reader:
         with JaxLoader(reader, batch,
                        shape_policies={'array_4d': PadTo((4, 128, 30, 3))}) as loader:
@@ -229,6 +229,24 @@ def _measure_h2d(jax, batch):
     return {'h2d_GBps': round(oneshot_gbps, 2),
             'h2d_sustained_GBps': round(sustained_gbps, 2),
             'h2d_overlap_frac': round(overlap_frac, 3)}
+
+
+def _peak_bf16_flops(device):
+    """Per-chip peak bf16 matmul FLOP/s by device generation, or None when
+    unknown. Public numbers: v4 275, v5e 197, v5p 459, v6e 918 TFLOP/s."""
+    kind = (getattr(device, 'device_kind', '') or '').lower()
+    for marker, peak in (('v5 lite', 197e12), ('v5e', 197e12),
+                         ('v6 lite', 918e12), ('v6e', 918e12),
+                         ('v5p', 459e12), ('v5', 459e12),   # plain v5 = v5p
+                         ('v4', 275e12)):
+        if marker in kind:
+            return peak
+    return None
+
+
+# Forward-pass FLOPs per 224x224x3 image (the standard published counts);
+# train step ~= 3x forward (bwd is ~2x fwd for convnets).
+_MODEL_FWD_FLOPS = {'resnet50': 4.09e9, 'resnet18': 1.82e9}
 
 
 def _child_imagenet(url, workers):
@@ -381,12 +399,37 @@ def _child_imagenet(url, workers):
     stage_profile['wall_s'] = round(elapsed, 4)
     train_steps = measure_iters * scan_k
     rate = superbatch * measure_iters / elapsed
+    # MFU (VERDICT r3 #2): model FLOPs actually retired / chip peak. Uses
+    # the published fwd FLOP count x3 (fwd+bwd) — an analytic lower bound
+    # (ignores batch norm etc.), the standard convention — against the
+    # chip's bf16 peak. Only meaningful on TPU with a known generation and
+    # a known model; otherwise mfu_note says why it is absent.
+    mfu = None
+    mfu_note = None
+    fwd_flops = _MODEL_FWD_FLOPS.get(config['model'])
+    peak = _peak_bf16_flops(jax.devices()[0]) if platform != 'cpu' else None
+    if platform == 'cpu':
+        mfu_note = 'cpu run: no chip peak to normalize against'
+    elif fwd_flops is None:
+        mfu_note = 'no published FLOP count for model {!r}'.format(config['model'])
+    elif peak is None:
+        mfu_note = 'unknown device_kind {!r}'.format(
+            getattr(jax.devices()[0], 'device_kind', ''))
+    else:
+        mfu = 3 * fwd_flops * rate / (peak * n_devices)
     out = {
         'imagenet_img_per_sec_per_chip': round(rate / n_devices, 2),
         'input_stall_frac': stats['input_stall_frac'],
         'step_time_ms': round(1000 * elapsed / train_steps, 2),
         'n_devices': n_devices,
         'platform': platform,
+        'mfu': round(mfu, 4) if mfu is not None else None,
+        'mfu_basis': ({'fwd_flops_per_img': fwd_flops,
+                       'train_multiplier': 3,
+                       'peak_bf16_flops_per_chip': peak,
+                       'device_kind': getattr(jax.devices()[0],
+                                              'device_kind', '')}
+                      if mfu is not None else mfu_note),
         'stage_profile': stage_profile,
         'staged_GB': round(stats['staged_bytes'] / 1e9, 3),
         'final_loss': round(float(metrics['loss']), 4),
@@ -471,12 +514,17 @@ def _measure_device_cache(jax, url, workers, batch, scan_k, mesh, train_step,
             'hbm_cached_epochs_measured': epochs}
 
 
-def _run_child(name, args, timeout_s):
+def _run_child(name, args, timeout_s, extra_env=None):
     """Run ``bench.py --_child <name> ...`` and parse its JSON line. Returns
     (dict, None) on success, (None, loud-reason-string) on failure."""
     cmd = [sys.executable, os.path.abspath(__file__), '--_child', name] + list(args)
+    env = None
+    if extra_env:
+        env = dict(os.environ)
+        env.update(extra_env)
     try:
-        proc = subprocess.run(cmd, timeout=timeout_s, capture_output=True, text=True)
+        proc = subprocess.run(cmd, timeout=timeout_s, capture_output=True,
+                              text=True, env=env)
     except subprocess.TimeoutExpired:
         return None, 'skipped: timed out after {}s (jax backend likely wedged)'.format(timeout_s)
     if proc.returncode != 0:
@@ -492,22 +540,42 @@ def _run_child(name, args, timeout_s):
     return None, 'skipped: child produced no JSON'
 
 
-def _jax_backend_responsive(timeout_s):
+def _probe_backend(timeout_s):
     """Probe JAX backend init AND a real transfer round-trip in a subprocess.
 
     A wedged TPU tunnel hangs rather than erroring — and one observed wedge
     mode passes ``jax.devices()`` while every ``device_put`` hangs, so the
     probe must move actual bytes (h2d + d2h) to certify the device usable.
+
+    Returns a diagnostics dict (VERDICT r3 #1: a failed probe must leave
+    evidence — which wedge mode, what stderr, how long — not a bare
+    boolean): ``{'ok', 'timeout_s', 'elapsed_s', 'rc', 'stderr_tail'}``.
+    Observed failure modes this distinguishes: init hang (rc None, elapsed
+    == timeout), init error (rc 1, stderr carries e.g. "UNAVAILABLE: TPU
+    backend setup/compile error" — seen after 1505s of blocking), transfer
+    hang/corruption (rc 1, assert line in stderr).
     """
-    probe = ('import jax, numpy as np; jax.devices(); '
+    probe = ('import time, jax, numpy as np; t0=time.time(); jax.devices(); '
+             'print("devices_ok %.1fs" % (time.time()-t0), flush=True); '
              'x = jax.device_put(np.ones((1 << 20,), np.uint8)); '
-             'assert int(x.sum()) == (1 << 20); print("ok")')
+             'assert int(x.sum()) == (1 << 20); print("transfer_ok")')
+    start = time.perf_counter()
     try:
         proc = subprocess.run([sys.executable, '-c', probe],
                               timeout=timeout_s, capture_output=True)
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        rc, out, err = None, e.stdout or b'', e.stderr or b''
+    elapsed = time.perf_counter() - start
+    def _tail(b):
+        text = b.decode('utf-8', 'replace').strip()
+        return text[-500:] if text else ''
+    return {'ok': rc == 0,
+            'timeout_s': timeout_s,
+            'elapsed_s': round(elapsed, 1),
+            'rc': rc,
+            'stdout_tail': _tail(out),
+            'stderr_tail': _tail(err)}
 
 
 def main():
@@ -521,7 +589,8 @@ def main():
     if len(sys.argv) >= 3 and sys.argv[1] == '--_child':
         name = sys.argv[2]
         if name == 'staging':
-            _child_staging(sys.argv[3], int(sys.argv[4]))
+            _child_staging(sys.argv[3], int(sys.argv[4]),
+                           sys.argv[5] if len(sys.argv) > 5 else 'thread')
         elif name == 'imagenet':
             _child_imagenet(sys.argv[3], int(sys.argv[4]))
         else:
@@ -529,18 +598,28 @@ def main():
         return
 
     hello_url = _ensure_hello_dataset()
-    # Auto-tune the hello worker count: on a 1-CPU host a single worker beats
-    # several (thread switching costs more than the lost overlap — measured
-    # 2650 vs 1930 samples/s), while multi-CPU hosts want the full pool. The
-    # sweep only CHOOSES the count; the reported rate is the MEDIAN of 3
-    # fresh runs at that count — this box's throughput fluctuates +-15%
-    # (shared VM), a single draw would make cross-round comparisons noise,
-    # and a max over noisy runs would bias the headline upward.
-    swept = sorted({1, 2, workers})
-    hello_workers = max(swept, key=lambda w: _measure_reader(hello_url, w))
-    reps = sorted(_measure_reader(hello_url, hello_workers) for _ in range(3))
+    # Auto-tune the hello pool config. The sweep covers the inline dummy
+    # pool (on a 1-CPU host the feeder thread's GIL ping-pong costs ~25%
+    # of the per-row path — PROFILE_r04.md; inline ventilation removes it)
+    # and thread-pool sizes for multi-core hosts. The sweep only CHOOSES
+    # the config; the reported rate is the MEDIAN of 3 fresh runs at that
+    # config — this box's throughput fluctuates +-15% (shared VM), a
+    # single draw would make cross-round comparisons noise, and a max over
+    # noisy runs would bias the headline upward.
+    swept = [('dummy', 1)] + [('thread', w) for w in sorted({1, 2, workers})]
+    sweep_rates = {cfg: _measure_reader(hello_url, cfg[1], pool=cfg[0])
+                   for cfg in swept}
+    hello_pool, hello_workers = max(sweep_rates, key=sweep_rates.get)
+    reps = sorted(_measure_reader(hello_url, hello_workers, pool=hello_pool)
+                  for _ in range(3))
     reader_rate = reps[1]
-    cached_rate = _measure_reader(hello_url, hello_workers, cache_type='memory')
+    # Single-draw max over every run at the winning config: the r01/r02
+    # methodology (one draw) for cross-round comparability alongside the
+    # noise-robust median headline (VERDICT r3 #7).
+    single_draw_max = max(reps + [sweep_rates[(hello_pool, hello_workers)]])
+    # Decoded-row RAM cache steady state at the same config.
+    cached_rate = _measure_reader(hello_url, hello_workers,
+                                  cache_type='memory', pool=hello_pool)
 
     result = {
         'metric': 'hello_world_samples_per_sec',
@@ -550,27 +629,67 @@ def main():
         # Decoded-row RAM cache (cache_type='memory'): the multi-epoch
         # steady state. Reference-parity headline above stays uncached.
         'hello_world_cached_samples_per_sec': round(cached_rate, 2),
-        'hello_config': {'reader_pool': 'thread', 'workers_count': hello_workers,
-                         'workers_swept': swept,
+        'hello_world_single_draw_max': round(single_draw_max, 2),
+        'hello_config': {'reader_pool': hello_pool,
+                         'workers_count': hello_workers,
+                         'configs_swept': ['{}-{}'.format(p, w)
+                                           for p, w in swept],
+                         'sweep_rates': {'{}-{}'.format(p, w): round(r, 1)
+                                         for (p, w), r in sweep_rates.items()},
                          'rep_rates': [round(r, 1) for r in reps],
                          'rows': _ROWS, 'warmup': _WARMUP_SAMPLES,
                          'measure': _MEASURE_SAMPLES},
     }
 
-    # Probe before launching TPU children (retry once, generously: a live
-    # tunnel can still take minutes to first-connect).
-    responsive = _jax_backend_responsive(180) or _jax_backend_responsive(500)
-    if not responsive:
-        result['imagenet'] = 'skipped: jax backend unresponsive after 180s+500s probes'
-        result['jax_staging'] = 'skipped: jax backend unresponsive after 180s+500s probes'
-        print(json.dumps(result))
-        return
+    # Probe before launching TPU children. Schedule (VERDICT r3 #1): a quick
+    # probe, then one PATIENT retry sized to the observed failure mode — the
+    # axon claim has been seen blocking 1505s before erroring UNAVAILABLE,
+    # so a sub-30-min probe cannot distinguish "slow pool grant" from
+    # "dead". Every attempt's timing/stderr lands in the JSON.
+    probe_timeouts = [int(t) for t in os.environ.get(
+        'BENCH_PROBE_TIMEOUTS', '120,1700').split(',')]
+    probes = []
+    responsive = False
+    for t in probe_timeouts:
+        probes.append(_probe_backend(t))
+        if probes[-1]['ok']:
+            responsive = True
+            break
+    result['backend_probes'] = probes
 
     imagenet_url = _ensure_imagenet_dataset()
 
+    if not responsive:
+        reason = ('skipped: jax backend unresponsive/failed after probes '
+                  '({}); see backend_probes'.format(
+                      ', '.join('{}s'.format(p['timeout_s']) for p in probes)))
+        result['imagenet'] = reason
+        result['jax_staging'] = reason
+        # CPU stand-in (VERDICT r3 #1 fallback): the same reader -> loader
+        # -> train-step pipeline forced onto the CPU backend with a small
+        # model, proving the INPUT pipeline (decode, cache, collate,
+        # staging, stall accounting) on this box even when the chip is
+        # unreachable. Not comparable to the TPU north star; reported
+        # under its own key, never as the headline.
+        standin, err = _run_child(
+            'imagenet', [imagenet_url, str(workers)], timeout_s=1200,
+            extra_env={'JAX_PLATFORMS': 'cpu',
+                       'BENCH_IMAGENET_MODEL': 'tiny',
+                       'BENCH_IMAGENET_BATCH': '32',
+                       'BENCH_IMAGENET_WARMUP': '8',
+                       'BENCH_IMAGENET_STEPS': '16',
+                       'BENCH_IMAGENET_SCAN_K': '4'})
+        if standin:
+            result['imagenet_cpu_standin'] = standin
+        else:
+            result['imagenet_cpu_standin'] = err
+        print(json.dumps(result))
+        return
+
     # The staging child rides the same per-row make_reader path the sweep
     # just tuned — reuse its winner rather than the decode-pool floor.
-    staging, err = _run_child('staging', [hello_url, str(hello_workers)],
+    staging, err = _run_child('staging',
+                              [hello_url, str(hello_workers), hello_pool],
                               timeout_s=600)
     if staging:
         result.update(staging)
@@ -589,7 +708,27 @@ def main():
         result['hello_world_samples_per_sec'] = round(reader_rate, 2)
         result['hello_world_vs_reference'] = round(reader_rate / _BASELINE_SAMPLES_PER_SEC, 3)
     else:
-        result['imagenet'] = err
+        # The probe said the backend was alive but the child still failed:
+        # retry ONCE with a reduced footprint (shorter warmup, fewer
+        # steps) — a flaky tunnel can often sustain a short window.
+        result['imagenet_full_attempt'] = err
+        inet, err2 = _run_child(
+            'imagenet', [imagenet_url, str(workers)], timeout_s=900,
+            extra_env={'BENCH_IMAGENET_WARMUP': '4',
+                       'BENCH_IMAGENET_STEPS': '16'})
+        if inet:
+            result.update(inet)
+            result['metric'] = 'imagenet_resnet50_img_per_sec_per_chip'
+            result['value'] = inet['imagenet_img_per_sec_per_chip']
+            result['unit'] = 'img/s/chip'
+            result['vs_baseline'] = round(
+                inet['imagenet_img_per_sec_per_chip'] / _NORTH_STAR_IMG_PER_SEC, 3)
+            result['imagenet_reduced_footprint'] = True
+            result['hello_world_samples_per_sec'] = round(reader_rate, 2)
+            result['hello_world_vs_reference'] = round(
+                reader_rate / _BASELINE_SAMPLES_PER_SEC, 3)
+        else:
+            result['imagenet'] = '{} | reduced-footprint retry: {}'.format(err, err2)
 
     print(json.dumps(result))
 

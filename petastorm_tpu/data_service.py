@@ -233,11 +233,16 @@ class DataServer(object):
             if not self._rpc_sock.poll(100):
                 continue
             try:
-                request = pickle.loads(self._rpc_sock.recv())
+                raw = self._rpc_sock.recv()
             except zmq.ZMQError:
                 return
             try:
-                reply = self._handle_rpc(request)
+                # Unpickling is inside the guarded region: stray bytes on
+                # the port (scanner, protocol mismatch) must produce an
+                # error REPLY — REP requires a send before the next recv,
+                # and an escaped exception would kill this thread and
+                # silently disable checkpointing for the server's lifetime.
+                reply = self._handle_rpc(pickle.loads(raw))
             except Exception as e:  # noqa: BLE001 - reply, don't die
                 logger.exception('data server rpc failed')
                 reply = {'error': repr(e)}
@@ -635,7 +640,9 @@ class RemoteReader(object):
         zmq = self._zmq
         states, total_sent = [], 0
         socks = []
-        paused = []     # endpoints whose pause_state succeeded
+        paused = []     # endpoints that were ASKED to pause (a server whose
+        #                 reply timed out client-side may still park later —
+        #                 it must be resumed too, not only confirmed ones)
         try:
             for endpoint in self._rpc_endpoints:
                 sock = self._context.socket(zmq.REQ)
@@ -643,6 +650,7 @@ class RemoteReader(object):
                 sock.connect(endpoint)
                 socks.append(sock)
             for sock, endpoint in zip(socks, self._rpc_endpoints):
+                paused.append(endpoint)
                 sock.send(pickle.dumps({'cmd': 'pause_state'}, protocol=5))
                 # Drain data while waiting: the serve loop may be parked in
                 # a HWM send retry, which must complete before it can reach
@@ -651,7 +659,6 @@ class RemoteReader(object):
                 if 'error' in reply:
                     raise RuntimeError('server {} checkpoint failed: {}'
                                        .format(endpoint, reply['error']))
-                paused.append(endpoint)
                 states.append(reply['state'])
                 total_sent += reply['sent']
             # Every server is now parked; drain until all sent chunks are

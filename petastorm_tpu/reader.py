@@ -430,7 +430,10 @@ class Reader(object):
             iterations=num_epochs,
             randomize_item_order=shuffle_row_groups,
             random_seed=seed,
-            max_ventilation_queue_size=self._pool_workers_count() + _VENTILATE_EXTRA_ROWGROUPS)
+            max_ventilation_queue_size=self._pool_workers_count() + _VENTILATE_EXTRA_ROWGROUPS,
+            # Synchronous pools (dummy) drive ventilation from the consumer
+            # thread; a feeder thread there is only GIL contention.
+            inline=getattr(self._workers_pool, 'inline_ventilation', False))
         self._workers_pool.start(worker_class, worker_args, ventilator=self._ventilator)
 
     def _pool_workers_count(self):

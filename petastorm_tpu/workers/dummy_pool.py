@@ -12,6 +12,12 @@ from petastorm_tpu.workers import (EmptyResultError,
 
 
 class DummyPool(object):
+    #: Readers build the ventilator with ``inline=True`` for this pool: work
+    #: happens on the consumer thread, so a feeder thread (and its GIL
+    #: ping-pong — ~50% of the 1-core per-row path, PROFILE_r04.md) would
+    #: be pure overhead. ``get_results`` pumps the ventilator itself.
+    inline_ventilation = True
+
     def __init__(self, workers_count=None):
         self._results = deque()
         self._ventilated = deque()
@@ -42,12 +48,26 @@ class DummyPool(object):
                     raise result
                 return result
             if not self._ventilated:
+                if self._ventilator is None:
+                    raise EmptyResultError()
+                if getattr(self._ventilator, 'inline', False):
+                    # Everything runs on this thread: pump the ventilator
+                    # directly instead of waiting on a feeder thread.
+                    if not self._ventilator.pump() and not self._ventilated:
+                        if self._ventilator.completed() or self._stopped:
+                            raise EmptyResultError()
+                        raise RuntimeError(
+                            'inline ventilator stalled: nothing ventilated, '
+                            'nothing queued, not completed')
                 # Read `completed` BEFORE re-checking the deque: once completed
                 # is observed no further ventilation can occur, so a still-empty
                 # deque really means end of data (no lost-item race).
-                if self._ventilator is None or self._ventilator.completed():
+                elif self._ventilator.completed():
                     if not self._ventilated and not self._results:
                         raise EmptyResultError()
+                else:
+                    continue
+            if not self._ventilated:
                 continue
             args, kwargs = self._ventilated.popleft()
             try:

@@ -42,7 +42,8 @@ class ConcurrentVentilator(Ventilator):
                  iterations=1, randomize_item_order=False,
                  random_seed=None,
                  max_ventilation_queue_size=None,
-                 ventilation_interval=0.01):
+                 ventilation_interval=0.01,
+                 inline=False):
         """
         :param ventilate_fn: called with ``**item`` for each ventilated item.
         :param items_to_ventilate: list of dicts of kwargs.
@@ -51,6 +52,12 @@ class ConcurrentVentilator(Ventilator):
         :param random_seed: seed for reproducible shuffling (``None`` = os random).
         :param max_ventilation_queue_size: cap on unprocessed in-flight items;
             defaults to ``len(items_to_ventilate)``.
+        :param inline: no ventilation thread — the consumer drives
+            ventilation by calling :meth:`pump` (synchronous pools). A
+            ventilator thread next to an inline pool is pure overhead: on a
+            single-core host the GIL ping-pong between the feeder thread
+            and the consumer measured ~50% of the whole per-row read path
+            (round-4 profile, PROFILE_r04.md).
         """
         if iterations is not None and iterations <= 0:
             raise ValueError('iterations must be positive or None, got {}'.format(iterations))
@@ -64,38 +71,67 @@ class ConcurrentVentilator(Ventilator):
                                             if max_ventilation_queue_size is not None
                                             else len(self._items_to_ventilate))
         self._ventilation_interval = ventilation_interval
+        self.inline = inline
 
         self._current_item_to_ventilate = 0
         self._in_flight = 0
         self._in_flight_lock = threading.Lock()
         self._ventilation_thread = None
+        self._started = False
         self._stop_event = threading.Event()
         self._wakeup = threading.Event()
         self._completed_flag = threading.Event()
 
     def start(self):
-        if self._ventilation_thread is not None:
+        if self._started:
             raise RuntimeError('Ventilator already started')
+        self._started = True
         if not self._items_to_ventilate or (self._iterations is not None and self._iterations == 0):
             self._completed_flag.set()
             return
         if self._randomize_item_order:
             self._rng.shuffle(self._items_to_ventilate)
+        if self.inline:
+            return
         self._ventilation_thread = threading.Thread(target=self._ventilate, daemon=True)
         self._ventilation_thread.start()
 
+    def _advance_epoch(self):
+        """At the end of an item list, roll to the next epoch (reshuffling)
+        or mark completion. Returns False when all iterations are done."""
+        if self._current_item_to_ventilate >= len(self._items_to_ventilate):
+            if self._iterations_remaining is not None:
+                self._iterations_remaining -= 1
+                if self._iterations_remaining <= 0:
+                    self._completed_flag.set()
+                    return False
+            self._current_item_to_ventilate = 0
+            if self._randomize_item_order:
+                self._rng.shuffle(self._items_to_ventilate)
+        return True
+
+    def pump(self):
+        """Inline mode: ventilate items up to the backpressure cap from the
+        CALLING thread. Returns the number of items ventilated."""
+        assert self.inline, 'pump() is for inline ventilators'
+        pumped = 0
+        while (not self._stop_event.is_set()
+               and not self._completed_flag.is_set()):
+            if self._in_flight >= self._max_ventilation_queue_size:
+                break
+            if not self._advance_epoch():
+                break
+            item = self._items_to_ventilate[self._current_item_to_ventilate]
+            self._current_item_to_ventilate += 1
+            self._in_flight += 1   # single-threaded: no lock needed
+            self._ventilate_fn(**item)
+            pumped += 1
+        return pumped
+
     def _ventilate(self):
         while not self._stop_event.is_set():
-            if self._current_item_to_ventilate >= len(self._items_to_ventilate):
-                # Epoch boundary.
-                if self._iterations_remaining is not None:
-                    self._iterations_remaining -= 1
-                    if self._iterations_remaining <= 0:
-                        self._completed_flag.set()
-                        return
-                self._current_item_to_ventilate = 0
-                if self._randomize_item_order:
-                    self._rng.shuffle(self._items_to_ventilate)
+            if not self._advance_epoch():
+                return
             with self._in_flight_lock:
                 below_cap = self._in_flight < self._max_ventilation_queue_size
             if below_cap:
@@ -128,7 +164,10 @@ class ConcurrentVentilator(Ventilator):
                 self._ventilation_thread.join()
             elif self._ventilation_thread.is_alive():
                 raise RuntimeError('Cannot reset a ventilator that is still ventilating')
+        elif self._started and self.inline and not self._completed_flag.is_set():
+            raise RuntimeError('Cannot reset a ventilator that is still ventilating')
         self._ventilation_thread = None
+        self._started = False
         self._iterations_remaining = self._iterations
         self._current_item_to_ventilate = 0
         with self._in_flight_lock:

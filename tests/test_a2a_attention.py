@@ -15,6 +15,10 @@ from petastorm_tpu.models.attention import a2a_self_attention, dense_attention
 from petastorm_tpu.parallel import make_mesh
 
 
+# Heavyweight (jit compiles of full models / interpret-mode Pallas):
+# excluded from the fast CI lane; run the full suite before shipping.
+pytestmark = pytest.mark.slow
+
 def _qkv(key, b=2, t=64, h=8, d=16, dtype=jnp.float32):
     kq, kk, kv = jax.random.split(key, 3)
     shape = (b, t, h, d)

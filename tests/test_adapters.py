@@ -140,6 +140,7 @@ def test_tf_dataset_ngram_rejected(timeseries_dataset):
             make_petastorm_dataset(reader)
 
 
+@pytest.mark.slow
 def test_scan_train_step_matches_sequential():
     """lax.scan multi-step trainer == K sequential per-step updates."""
     import jax

@@ -14,7 +14,10 @@ from petastorm_tpu.predicates import in_lambda, in_set
 
 # Reader factories parametrizing the pool flavors (reference test_end_to_end.py:37-53).
 # Out-of-process flavors run the full feature matrix too (VERDICT r1 weak #2):
-# cross-process serialization of predicates/transforms/codecs is where bugs hide.
+# cross-process serialization of predicates/transforms/codecs is where bugs
+# hide — but spawning real processes costs ~5-15s per test, so those
+# variants are `slow` (full suite); the fast lane keeps dummy/thread E2E
+# plus the dedicated pool internals tests (test_process_pool/test_shm_pool).
 READER_FACTORIES = [
     pytest.param(lambda url, **kw: make_reader(url, reader_pool_type='dummy', **kw),
                  id='dummy'),
@@ -23,10 +26,10 @@ READER_FACTORIES = [
                  id='thread'),
     pytest.param(lambda url, **kw: make_reader(url, reader_pool_type='process-zmq',
                                                workers_count=2, **kw),
-                 id='process-zmq'),
+                 id='process-zmq', marks=pytest.mark.slow),
     pytest.param(lambda url, **kw: make_reader(url, reader_pool_type='process-shm',
                                                workers_count=2, **kw),
-                 id='process-shm'),
+                 id='process-shm', marks=pytest.mark.slow),
 ]
 
 BATCH_READER_FACTORIES = [
@@ -37,10 +40,10 @@ BATCH_READER_FACTORIES = [
                  id='thread'),
     pytest.param(lambda url, **kw: make_batch_reader(url, reader_pool_type='process-zmq',
                                                      workers_count=2, **kw),
-                 id='process-zmq'),
+                 id='process-zmq', marks=pytest.mark.slow),
     pytest.param(lambda url, **kw: make_batch_reader(url, reader_pool_type='process-shm',
                                                      workers_count=2, **kw),
-                 id='process-shm'),
+                 id='process-shm', marks=pytest.mark.slow),
 ]
 
 

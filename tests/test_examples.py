@@ -27,6 +27,7 @@ def test_mnist_train_reaches_accuracy(tmp_path):
     assert accuracy > 0.8, 'MLP failed to learn digits: accuracy {}'.format(accuracy)
 
 
+@pytest.mark.slow
 def test_imagenet_generate_and_one_step(tmp_path):
     from examples.imagenet.generate_imagenet_dataset import generate_synthetic
     from examples.imagenet.jax_resnet_example import train
@@ -60,6 +61,7 @@ def test_run_in_subprocess():
     assert pid != os.getpid()
 
 
+@pytest.mark.slow
 def test_long_context_lm_example(tmp_path):
     """Sequence-parallel LM: generate tokens, train a few ring-attention
     steps on the 8-device mesh, loss finite and decreasing-ish."""

@@ -9,6 +9,10 @@ from petastorm_tpu.models.attention import dense_attention
 from petastorm_tpu.ops.flash_attention import flash_attention
 
 
+# Heavyweight (jit compiles of full models / interpret-mode Pallas):
+# excluded from the fast CI lane; run the full suite before shipping.
+pytestmark = pytest.mark.slow
+
 @pytest.mark.parametrize('causal', [False, True])
 @pytest.mark.parametrize('shape,blocks', [
     ((2, 64, 2, 16), (16, 16)),

@@ -10,6 +10,10 @@ from petastorm_tpu.models.moe import SwitchMoE, expert_param_spec
 from petastorm_tpu.parallel import make_mesh
 
 
+# Heavyweight (jit compiles of full models / interpret-mode Pallas):
+# excluded from the fast CI lane; run the full suite before shipping.
+pytestmark = pytest.mark.slow
+
 def _inputs(b=2, t=8, d=16, seed=0):
     rng = np.random.default_rng(seed)
     return jnp.asarray(rng.standard_normal((b, t, d)), jnp.float32)

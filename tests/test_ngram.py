@@ -71,7 +71,13 @@ def test_invalid_constructions():
         NGram({0: 'not_a_list'}, 1, 'ts')
 
 
-@pytest.mark.parametrize('pool', ['dummy', 'thread', 'process-zmq', 'process-shm'])
+@pytest.mark.parametrize('pool', [
+    'dummy', 'thread',
+    # Real worker processes (~30s each): full suite only; the pools
+    # themselves stay fast-lane-covered by test_process_pool/test_shm_pool.
+    pytest.param('process-zmq', marks=pytest.mark.slow),
+    pytest.param('process-shm', marks=pytest.mark.slow),
+])
 def test_ngram_end_to_end(timeseries_dataset, pool):
     fields = {0: [TimeseriesSchema.timestamp, TimeseriesSchema.sensor],
               1: [TimeseriesSchema.timestamp, TimeseriesSchema.sensor,

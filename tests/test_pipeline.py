@@ -10,6 +10,10 @@ from petastorm_tpu.models.pipeline import (pipeline_apply,
                                            pipeline_param_spec)
 from petastorm_tpu.parallel import make_mesh
 
+# Heavyweight (jit compiles of full models / interpret-mode Pallas):
+# excluded from the fast CI lane; run the full suite before shipping.
+pytestmark = pytest.mark.slow
+
 N_STAGES = 4
 D = 8
 

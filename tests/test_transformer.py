@@ -9,6 +9,10 @@ import pytest
 from petastorm_tpu.models import TransformerLM
 from petastorm_tpu.parallel import make_mesh
 
+# Heavyweight (jit compiles of full models / interpret-mode Pallas):
+# excluded from the fast CI lane; run the full suite before shipping.
+pytestmark = pytest.mark.slow
+
 VOCAB = 64
 
 

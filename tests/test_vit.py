@@ -15,6 +15,10 @@ from petastorm_tpu.models.train import (create_train_state, make_train_step,
 from petastorm_tpu.parallel import make_mesh
 
 
+# Heavyweight (jit compiles of full models / interpret-mode Pallas):
+# excluded from the fast CI lane; run the full suite before shipping.
+pytestmark = pytest.mark.slow
+
 def test_forward_shape_and_dtype():
     model = ViTTiny(num_classes=7)
     x = jnp.ones((2, 16, 16, 3), jnp.float32)
