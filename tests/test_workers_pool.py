@@ -179,5 +179,83 @@ def test_thread_pool_stop_mid_stream_does_not_hang():
     assert joined, 'pool.join() hung after stop()'
 
 
+def test_inline_ventilator_pump_epochs_and_cap():
+    """Inline mode: no feeder thread; pump() ventilates up to the
+    backpressure cap from the calling thread and rolls epochs."""
+    out = []
+    v = ConcurrentVentilator(lambda **kw: out.append(kw['value']),
+                             _items(6), iterations=2,
+                             max_ventilation_queue_size=4, inline=True)
+    v.start()
+    assert v.pump() == 4            # capped
+    assert out == [0, 1, 2, 3]
+    v.processed_item()
+    v.processed_item()
+    assert v.pump() == 2
+    assert out == [0, 1, 2, 3, 4, 5]
+    for _ in range(4):
+        v.processed_item()
+    assert v.pump() == 4            # epoch 2 starts
+    for _ in range(4):
+        v.processed_item()
+    assert v.pump() == 2
+    for _ in range(2):
+        v.processed_item()
+    assert v.pump() == 0            # exhausted
+    assert v.completed()
+    assert out == list(range(6)) * 2
+
+
+def test_inline_ventilator_dummy_pool_end_to_end():
+    """DummyPool + inline ventilator: all work on the consumer thread,
+    exact results, clean EmptyResultError, reset() supported."""
+    pool = DummyPool()
+    ventilator = ConcurrentVentilator(None, _items(25), iterations=1,
+                                      max_ventilation_queue_size=3,
+                                      inline=True)
+    pool.start(EchoWorker, None, ventilator)
+    before = threading.active_count()
+    results = []
+    with pytest.raises(EmptyResultError):
+        while True:
+            results.extend(pool.get_results())
+    assert threading.active_count() == before   # never spawned a feeder
+    assert sorted(results) == [i * 2 for i in range(25)]
+    ventilator.reset()
+    results2 = []
+    with pytest.raises(EmptyResultError):
+        while True:
+            results2.extend(pool.get_results())
+    assert sorted(results2) == [i * 2 for i in range(25)]
+    pool.stop()
+    pool.join()
+
+
+def test_inline_ventilator_seeded_shuffle_matches_threaded():
+    """The seeded epoch shuffle must not depend on the ventilation mode —
+    a resume under the other pool type sees the same row-group order."""
+    orders = []
+    for inline in (False, True):
+        order = []
+        v = ConcurrentVentilator(lambda **kw: order.append(kw['value']),
+                                 _items(20), iterations=1,
+                                 randomize_item_order=True, random_seed=7,
+                                 inline=inline)
+        v.start()
+        if inline:
+            while v.pump():
+                for _ in range(20):
+                    v.processed_item()
+        else:
+            deadline = time.time() + 10
+            while not v.completed() and time.time() < deadline:
+                v.processed_item()
+                time.sleep(0.001)
+        v.stop()
+        orders.append(order)
+    assert orders[0] == orders[1]
+    assert orders[0] != list(range(20))   # actually shuffled
+
+
 def test_sentinel_types():
     assert isinstance(VentilatedItemProcessedMessage(), VentilatedItemProcessedMessage)
