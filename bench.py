@@ -144,12 +144,10 @@ def _measure_reader(url, workers, cache_type='null', pool='thread'):
 # --------------------------------------------------------------------------
 
 def _force_cpu_if_requested(jax):
-    """A TPU plugin registered from sitecustomize may pin jax_platforms,
-    which beats the JAX_PLATFORMS env var — honor an explicit cpu-FIRST
-    request (CI smokes) the way ``__graft_entry__.dryrun_multichip`` does.
-    ``JAX_PLATFORMS='tpu,cpu'`` (tpu with cpu fallback) must NOT pin cpu."""
-    if os.environ.get('JAX_PLATFORMS', '').split(',')[0].strip() == 'cpu':
-        jax.config.update('jax_platforms', 'cpu')
+    """Honor an explicit cpu-FIRST ``JAX_PLATFORMS`` request (CI smokes,
+    the stand-in child) — the shared helper; see its docstring."""
+    from petastorm_tpu.utils import honor_jax_platform_request
+    honor_jax_platform_request()
 
 
 def _child_staging(url, workers, pool='thread'):

@@ -14,6 +14,13 @@ import tempfile
 
 import numpy as np
 
+# Honor an explicit JAX_PLATFORMS=cpu request even when a TPU plugin's
+# sitecustomize pinned jax_platforms through jax.config (which beats the
+# env var) - otherwise this script would try to claim the accelerator.
+from petastorm_tpu.utils import honor_jax_platform_request  # noqa: E402
+
+honor_jax_platform_request()
+
 
 def generate_external_dataset(path, rows=100):
     """A Parquet store written by 'some other system' (plain pyarrow)."""

@@ -8,6 +8,12 @@ import os
 import sys
 
 sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..')))
+# Honor an explicit JAX_PLATFORMS=cpu request even when a TPU plugin's
+# sitecustomize pinned jax_platforms through jax.config (which beats the
+# env var) - otherwise this script would try to claim the accelerator.
+from petastorm_tpu.utils import honor_jax_platform_request  # noqa: E402
+honor_jax_platform_request()
+
 
 import argparse
 

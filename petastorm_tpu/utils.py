@@ -23,6 +23,24 @@ def cached_namedtuple(cache, type_name, names):
     return nt
 
 
+def honor_jax_platform_request():
+    """Pin jax to CPU when ``JAX_PLATFORMS`` asks for it FIRST.
+
+    A TPU PJRT plugin registered from a ``sitecustomize`` may call
+    ``jax.config.update('jax_platforms', ...)``, which takes precedence
+    over the ``JAX_PLATFORMS`` env var — an explicit ``JAX_PLATFORMS=cpu``
+    then silently still initializes the accelerator backend (and on a
+    wedged tunnel, blocks for minutes). CLIs and examples call this before
+    their first jax operation so a cpu-first request is honored the way
+    ``bench.py`` and ``__graft_entry__`` honor it. A request like
+    ``tpu,cpu`` (accelerator with cpu fallback) is left alone.
+    """
+    import os
+    if os.environ.get('JAX_PLATFORMS', '').split(',')[0].strip() == 'cpu':
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+
+
 def run_in_subprocess(func, *args, **kwargs):
     """Run ``func(*args, **kwargs)`` in a one-shot subprocess and return its
     result — isolates memory leaks / library state from the calling process
