@@ -143,7 +143,7 @@ def _measure_reader(url, workers, cache_type='null', pool='thread'):
 # TPU children (each prints ONE json line; parent runs them with a timeout)
 # --------------------------------------------------------------------------
 
-def _force_cpu_if_requested(jax):
+def _force_cpu_if_requested():
     """Honor an explicit cpu-FIRST ``JAX_PLATFORMS`` request (CI smokes,
     the stand-in child) — the shared helper; see its docstring."""
     from petastorm_tpu.utils import honor_jax_platform_request
@@ -154,7 +154,7 @@ def _child_staging(url, workers, pool='thread'):
     """hello_world batches staged to the default JAX device."""
     import jax
 
-    _force_cpu_if_requested(jax)
+    _force_cpu_if_requested()
 
     from petastorm_tpu import make_reader
     from petastorm_tpu.jax_loader import JaxLoader, PadTo
@@ -256,7 +256,7 @@ def _child_imagenet(url, workers):
 
     import jax
 
-    _force_cpu_if_requested(jax)
+    _force_cpu_if_requested()
     import jax.numpy as jnp
 
     from petastorm_tpu import make_tensor_reader

@@ -10,10 +10,12 @@ Run: python -m examples.hello_world.external_dataset
 """
 
 import os
+import sys
 import tempfile
 
 import numpy as np
 
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..')))
 # Honor an explicit JAX_PLATFORMS=cpu request even when a TPU plugin's
 # sitecustomize pinned jax_platforms through jax.config (which beats the
 # env var) - otherwise this script would try to claim the accelerator.

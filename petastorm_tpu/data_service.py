@@ -121,30 +121,45 @@ class DataServer(object):
         self._reader = reader
         self._zmq = zmq
         self._context = zmq.Context.instance()
-        self._data_sock = self._context.socket(zmq.PUSH)
-        self._data_sock.setsockopt(zmq.SNDHWM, sndhwm)
-        self._data_sock.bind(bind)
-        # Resolve wildcard ports ('tcp://127.0.0.1:*') to the actual bind.
-        actual = self._data_sock.getsockopt(zmq.LAST_ENDPOINT).decode()
-        self._ctrl_sock = None
-        self._rpc_sock = None
-        try:
-            if control_bind is None:
-                control_bind = _next_port_endpoint(actual)
-            self._ctrl_sock = self._context.socket(zmq.PUB)
-            self._ctrl_sock.bind(control_bind)
-            if rpc_bind is None:
-                rpc_bind = _next_port_endpoint(actual, 2)
-            self._rpc_sock = self._context.socket(zmq.REP)
-            self._rpc_sock.bind(rpc_bind)
-        except Exception:
-            # A derived-port bind can fail (port+1/port+2 already in use);
-            # close whatever bound so the ports don't stay held by the
-            # shared zmq context.
-            for sock in (self._data_sock, self._ctrl_sock, self._rpc_sock):
-                if sock is not None:
-                    sock.close(linger=0)
-            raise
+        # A wildcard data bind derives control = port+1 and rpc = port+2,
+        # and either derived port may already be taken by an unrelated
+        # socket — retry on a fresh wildcard port rather than flaking.
+        # Explicit ports get exactly one attempt (the caller chose them).
+        wildcard = bind.rstrip().endswith(':*')
+        derives_ports = control_bind is None or rpc_bind is None
+        attempts = 16 if wildcard and derives_ports else 1
+        last_error = None
+        for _ in range(attempts):
+            self._data_sock = self._context.socket(zmq.PUSH)
+            self._ctrl_sock = None
+            self._rpc_sock = None
+            try:
+                self._data_sock.setsockopt(zmq.SNDHWM, sndhwm)
+                self._data_sock.bind(bind)
+                # Resolve wildcard ports ('tcp://127.0.0.1:*') to the
+                # actual bind.
+                actual = self._data_sock.getsockopt(zmq.LAST_ENDPOINT).decode()
+                ctrl_endpoint = (control_bind if control_bind is not None
+                                 else _next_port_endpoint(actual))
+                self._ctrl_sock = self._context.socket(zmq.PUB)
+                self._ctrl_sock.bind(ctrl_endpoint)
+                rpc_endpoint = (rpc_bind if rpc_bind is not None
+                                else _next_port_endpoint(actual, 2))
+                self._rpc_sock = self._context.socket(zmq.REP)
+                self._rpc_sock.bind(rpc_endpoint)
+                last_error = None
+                break
+            except Exception as e:
+                # Close whatever bound so the ports don't stay held by the
+                # shared zmq context; only bind clashes are retryable.
+                for sock in (self._data_sock, self._ctrl_sock, self._rpc_sock):
+                    if sock is not None:
+                        sock.close(linger=0)
+                if not isinstance(e, zmq.ZMQError):
+                    raise
+                last_error = e
+        if last_error is not None:
+            raise last_error
         self.data_endpoint = _connectable(actual)
         self.control_endpoint = _connectable(
             self._ctrl_sock.getsockopt(zmq.LAST_ENDPOINT).decode())

@@ -84,8 +84,7 @@ class JobCheckpointer(object):
         loader_state = _capture_loader_state(loader)
         items = {'state': ocp.args.StandardSave(state)}
         # JSON entries; always present so restore never probes directories.
-        items['loader'] = ocp.args.JsonSave(loader_state if loader_state
-                                            is not None else {})
+        items['loader'] = ocp.args.JsonSave(_encode_loader_state(loader_state))
         items['extra'] = ocp.args.JsonSave(extra if extra is not None else {})
         saved = self._manager.save(step, args=ocp.args.Composite(**items),
                                    force=force)
@@ -124,7 +123,7 @@ class JobCheckpointer(object):
                 state=ocp.args.StandardRestore(state_template),
                 loader=ocp.args.JsonRestore(),
                 extra=ocp.args.JsonRestore()))
-        loader_state = restored['loader'] or None
+        loader_state = _decode_loader_state(restored['loader']) or None
         return JobCheckpoint(step=step, state=restored['state'],
                              loader_state=loader_state,
                              extra=restored['extra'] or {})
@@ -144,6 +143,51 @@ class JobCheckpointer(object):
     def __exit__(self, exc_type, exc, tb):
         self.close()
         return False
+
+
+_PICKLED_KEY = '__pst_pickled_b64__'
+
+
+def _pickle_to_json(loader_state):
+    import base64
+    import pickle
+    return {_PICKLED_KEY: base64.b64encode(
+        pickle.dumps(loader_state, protocol=5)).decode('ascii')}
+
+
+def _encode_loader_state(loader_state):
+    """Loader states are JSON by contract — except the data service's,
+    whose snapshot embeds the drained in-flight numpy chunks
+    (``RemoteReader.state_dict``). Those ride as base64 pickle inside the
+    same JSON entry, keeping the composite atomic (params + loader land
+    or neither) without a second artifact format."""
+    if loader_state is None:
+        return {}
+    if (isinstance(loader_state, dict) and 'pending' in loader_state
+            and 'server_states' in loader_state):
+        # The service snapshot shape — known non-JSON (and potentially
+        # megabytes of chunks): go straight to pickle, no throwaway probe.
+        return _pickle_to_json(loader_state)
+    import json
+    try:
+        # Cheap for the contract-conformant states (small dicts of chunk
+        # counters). The probe checks ROUND-TRIP fidelity, not just
+        # serializability: an exotic state with int dict keys or tuples
+        # would survive json.dumps but come back altered (str keys,
+        # lists) — such states must take the pickle path too.
+        if json.loads(json.dumps(loader_state)) == loader_state:
+            return loader_state
+    except TypeError:
+        pass
+    return _pickle_to_json(loader_state)
+
+
+def _decode_loader_state(entry):
+    if isinstance(entry, dict) and _PICKLED_KEY in entry:
+        import base64
+        import pickle
+        return pickle.loads(base64.b64decode(entry[_PICKLED_KEY]))
+    return entry
 
 
 def _capture_loader_state(loader):

@@ -171,3 +171,58 @@ def test_restore_missing_explicit_step_returns_none(tmp_path):
         ckpt.save(1, state)
         _, template = _fresh_state()
         assert ckpt.restore(template, step=99) is None
+
+
+def test_whole_job_checkpoint_over_data_service(tmp_path, job_dataset):
+    """The orbax composite must carry a data-service snapshot — whose
+    pending chunks are numpy arrays, not JSON — atomically alongside the
+    params, and the restored pair must resume the service exactly-once."""
+    from petastorm_tpu.data_service import RemoteReader, serve_dataset
+
+    _, state = _fresh_state()
+    train_step = make_train_step()
+    seen = []
+
+    server = serve_dataset(job_dataset, 'tcp://127.0.0.1:*',
+                           num_epochs=1, seed=0, workers_count=1)
+    remote = RemoteReader(server.data_endpoint)
+    try:
+        with JaxLoader(remote, BATCH, last_batch='drop',
+                       prefetch=4) as loader:
+            it = iter(loader)
+            for _ in range(2):
+                b = next(it)
+                state, _metrics = train_step(state, b.x, b.label)
+                seen.extend(np.asarray(b.sample_id).tolist())
+            with JobCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+                assert ckpt.save(1, state, loader=loader)
+            loader.stop()
+    finally:
+        remote.stop()
+        remote.join()
+        server.stop()
+
+    _, template = _fresh_state()
+    with JobCheckpointer(str(tmp_path / 'ckpt')) as ckpt:
+        job = ckpt.restore(template)
+    assert job is not None
+    _params_equal(job.state.params, state.params)
+    svc_state = job.loader_state
+    assert svc_state and svc_state['pending'], (
+        'service snapshot lost its in-flight chunks through orbax')
+    assert isinstance(svc_state['pending'][0]['x'], np.ndarray)
+
+    server2 = serve_dataset(job_dataset, 'tcp://127.0.0.1:*',
+                            num_epochs=1, seed=0, workers_count=1,
+                            resume_state=svc_state['server_states'][0])
+    remote2 = RemoteReader(server2.data_endpoint, resume_state=svc_state)
+    try:
+        with JaxLoader(remote2, BATCH, last_batch='drop') as loader2:
+            for b in loader2:
+                seen.extend(np.asarray(b.sample_id).tolist())
+    finally:
+        remote2.stop()
+        remote2.join()
+        server2.stop()
+    assert len(seen) == len(set(seen)), 'duplicates across service-job resume'
+    assert N_ROWS - len(set(seen)) < BATCH, 'rows lost across service-job resume'
