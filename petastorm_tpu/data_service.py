@@ -258,10 +258,15 @@ class DataServer(object):
                 # and an escaped exception would kill this thread and
                 # silently disable checkpointing for the server's lifetime.
                 reply = self._handle_rpc(pickle.loads(raw))
+                # Serialize inside the guard too: a reply embedding an
+                # unpicklable user object (e.g. a schema holding a lambda
+                # codec) must degrade to an error reply, not kill the
+                # thread mid-REP-cycle.
+                payload = pickle.dumps(reply, protocol=5)
             except Exception as e:  # noqa: BLE001 - reply, don't die
                 logger.exception('data server rpc failed')
-                reply = {'error': repr(e)}
-            self._rpc_sock.send(pickle.dumps(reply, protocol=5))
+                payload = pickle.dumps({'error': repr(e)}, protocol=5)
+            self._rpc_sock.send(payload)
 
     def _handle_rpc(self, request):
         cmd = request.get('cmd')
@@ -300,6 +305,12 @@ class DataServer(object):
             return {'server_id': self._server_id,
                     'sent': self._served_chunks,
                     'done': self._serving_done.is_set()}
+        if cmd == 'schema':
+            # Lets trainer-side framework adapters (pytorch.DataLoader,
+            # tf_utils.make_petastorm_dataset) see the stream's schema
+            # without a store connection of their own.
+            return {'schema': getattr(self._reader, 'transformed_schema', None),
+                    'ngram': getattr(self._reader, 'ngram', None)}
         raise ValueError('unknown rpc command {!r}'.format(cmd))
 
     def start(self):
@@ -402,6 +413,9 @@ class RemoteReader(object):
     """
 
     batched_output = True
+    #: The service rejects NGram readers at the server (per-row), so the
+    #: stream is always plain batched columns — adapters check this.
+    ngram = None
 
     def __init__(self, endpoints, control_endpoints=None, rpc_endpoints=None,
                  rcvhwm=4, poll_timeout_s=0.1, shared_stream=False,
@@ -465,6 +479,7 @@ class RemoteReader(object):
         self._unacked = deque()
         self._unacked_offset = 0
         self._row_granular = False
+        self._schema = None     # lazily fetched over rpc (transformed_schema)
         if resume_state is not None:
             for cols in resume_state['pending']:
                 self._pending.append(dict(cols))
@@ -738,13 +753,8 @@ class RemoteReader(object):
             # stuck mid-request and REQ sockets cannot re-send).
             for endpoint in paused:
                 try:
-                    sock = self._context.socket(zmq.REQ)
-                    sock.setsockopt(zmq.LINGER, 0)
-                    sock.connect(endpoint)
-                    sock.send(pickle.dumps({'cmd': 'resume'}, protocol=5))
-                    if sock.poll(5000):
-                        sock.recv()
-                    sock.close(linger=0)
+                    self._one_shot_rpc(endpoint, {'cmd': 'resume'},
+                                       timeout_ms=5000)
                 except Exception:   # noqa: BLE001 - already failing
                     logger.exception('could not un-pause server %s after '
                                      'failed checkpoint', endpoint)
@@ -758,6 +768,48 @@ class RemoteReader(object):
                     and time.monotonic() >= deadline):
                 raise RuntimeError('server {} did not answer pause_state '
                                    'within {}s'.format(endpoint, timeout_s))
+
+    def _one_shot_rpc(self, endpoint, request, timeout_ms=10000):
+        """One REQ/REP round-trip on a fresh socket; None on timeout."""
+        zmq = self._zmq
+        sock = self._context.socket(zmq.REQ)
+        sock.setsockopt(zmq.LINGER, 0)
+        try:
+            sock.connect(endpoint)
+            sock.send(pickle.dumps(request, protocol=5))
+            if not sock.poll(timeout_ms):
+                return None
+            return pickle.loads(sock.recv())
+        finally:
+            sock.close(linger=0)
+
+    @property
+    def transformed_schema(self):
+        """The stream's Unischema, fetched once from the first server's rpc
+        socket — what lets ``pytorch.DataLoader`` and
+        ``tf_utils.make_petastorm_dataset`` consume a RemoteReader exactly
+        like a local Reader (they build their namedtuple/tf types from it)."""
+        if self._schema is None:
+            endpoint = self._rpc_endpoints[0]
+            reply = self._one_shot_rpc(endpoint, {'cmd': 'schema'})
+            if reply is None:
+                raise RuntimeError(
+                    'server {} did not answer the schema request — is it '
+                    'running a build without the schema rpc?'.format(endpoint))
+            if reply.get('ngram') is not None:
+                # The class-level `ngram = None` relies on the server
+                # rejecting per-row/ngram readers; if that invariant ever
+                # weakens, fail loudly instead of letting the adapters
+                # mis-handle a windowed stream.
+                raise RuntimeError('server {} streams an NGram reader; the '
+                                   'service adapters do not support windowed '
+                                   'rows'.format(endpoint))
+            if reply.get('schema') is None:
+                raise RuntimeError('server {} exposes no transformed_schema '
+                                   '({})'.format(endpoint,
+                                                 reply.get('error', 'None')))
+            self._schema = reply['schema']
+        return self._schema
 
     @property
     def diagnostics(self):

@@ -332,6 +332,41 @@ def test_remote_throughput_vs_local(throughput_dataset):
             remote_rate, local_rate))
 
 
+def test_pytorch_loader_over_service(service_dataset):
+    """The torch adapter consumes a RemoteReader exactly like a local
+    reader — the schema rides the rpc socket, rows transpose out of the
+    remote column chunks, and the epoch is exact."""
+    torch = pytest.importorskip('torch')
+    from petastorm_tpu.pytorch import DataLoader
+
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0) as server:
+        remote = RemoteReader(server.data_endpoint)
+        ids = []
+        with DataLoader(remote, batch_size=16) as torch_loader:
+            for batch in torch_loader:
+                assert isinstance(batch.vec, torch.Tensor)
+                assert batch.vec.shape[1:] == (4,)
+                ids.extend(int(i) for i in batch.sid)
+    assert sorted(ids) == list(range(N_ROWS))
+
+
+def test_tf_dataset_over_service(service_dataset):
+    """tf.data over the service stream: batched chunk shapes, exact epoch."""
+    tf = pytest.importorskip('tensorflow')
+    from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0) as server:
+        with RemoteReader(server.data_endpoint) as remote:
+            dataset = make_petastorm_dataset(remote)
+            ids = []
+            for chunk in dataset:
+                assert chunk.vec.shape[1:] == (4,)
+                ids.extend(int(i) for i in chunk.sid.numpy())
+    assert sorted(ids) == list(range(N_ROWS))
+
+
 def test_remote_reader_mesh_staging(service_dataset):
     """Remote chunks stage onto an 8-device mesh exactly like local ones."""
     from petastorm_tpu.jax_loader import JaxLoader
