@@ -332,6 +332,52 @@ def test_remote_throughput_vs_local(throughput_dataset):
             remote_rate, local_rate))
 
 
+def test_service_over_plain_parquet_store(tmp_path):
+    """serve_dataset(reader_factory=make_batch_reader) over a store no
+    petastorm writer produced: Arrow-inferred schema, string columns (which
+    cannot ride out-of-band — they pickle in-band), exact epoch."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu import make_batch_reader
+
+    n = 40
+    table = pa.table({'id': pa.array(range(n), pa.int64()),
+                      'name': pa.array(['row-{}'.format(i) for i in range(n)]),
+                      'value': pa.array(np.linspace(0, 1, n).astype(np.float64))})
+    path = tmp_path / 'plain'
+    path.mkdir()
+    pq.write_table(table, str(path / 'part0.parquet'), row_group_size=8)
+    url = 'file://' + str(path)
+
+    with serve_dataset(url, 'tcp://127.0.0.1:*',
+                       reader_factory=make_batch_reader,
+                       num_epochs=1) as server:
+        with RemoteReader(server.data_endpoint) as remote:
+            ids, names = [], []
+            for chunk in remote:
+                ids.extend(int(i) for i in np.asarray(chunk.id))
+                names.extend(str(s) for s in np.asarray(chunk.name))
+    assert sorted(ids) == list(range(n))
+    assert sorted(names) == sorted('row-{}'.format(i) for i in range(n))
+
+
+def test_stats_rpc(service_dataset):
+    """The rpc 'stats' command reports served chunks + done flag, and an
+    unknown command degrades to an error reply (thread stays alive)."""
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0) as server:
+        with RemoteReader(server.data_endpoint) as remote:
+            ids = _drain_ids(remote)
+            reply = remote._one_shot_rpc(remote._rpc_endpoints[0],
+                                         {'cmd': 'nonsense'})
+            assert 'error' in reply
+            stats = remote._one_shot_rpc(remote._rpc_endpoints[0],
+                                         {'cmd': 'stats'})
+    assert sorted(ids) == list(range(N_ROWS))
+    assert stats['done'] and stats['sent'] == server.served_chunks
+
+
 def test_pytorch_loader_over_service(service_dataset):
     """The torch adapter consumes a RemoteReader exactly like a local
     reader — the schema rides the rpc socket, rows transpose out of the
