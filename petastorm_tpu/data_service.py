@@ -51,10 +51,44 @@ Semantics vs in-process readers:
   either side (the reference's multipart-payload idea,
   ``petastorm/workers_pool/process_pool.py:317-321``, upgraded to
   zero-copy). Received blocks are read-only views over zmq frames; copy
-  before mutating.
+  before mutating. Every chunk leads with a fixed-size meta frame
+  ``(server_id, seq)``: consumers record received sequence numbers per
+  server and silently drop duplicates, which is what makes bounded replay
+  (server crash recovery, see below) and multi-consumer checkpoint
+  aggregation exact.
+* Multi-consumer checkpoint: several ``shared_stream=True`` consumers on
+  the same servers checkpoint through
+  :func:`checkpoint_shared_stream` — pause every server once, drain all
+  consumers until the union of their received seq sets covers every
+  server's sent count (per-consumer counts alone are unknowable; the
+  union is exact), snapshot each consumer's backlog, resume. Per-consumer
+  ``state_dict()`` stays sole-consumer-only.
+* Unplanned server death: construct the server with ``snapshot_path=``
+  (or ``serve_dataset(..., snapshot_path=...)``) and it self-snapshots
+  every ``snapshot_every`` chunks — reader position, identity, and a
+  replay ring of the most recent chunk frames sized past the zmq send
+  queue (the only bytes a SIGKILL can lose; the kernel still flushes
+  TCP-buffered data of a killed process). Restart via
+  ``serve_dataset(..., snapshot_resume=path)``: the server re-sends the
+  ring (consumers drop what they already had) and continues from the
+  recorded position under its ORIGINAL identity, so end-of-stream
+  accounting spans the crash and the epoch completes with no lost rows.
+
+**Trust boundary**: chunk headers, rpc requests/replies, and resume
+snapshots are **pickle** — unpickling attacker-controlled bytes is
+arbitrary code execution. Run all three ports on a trusted network
+(loopback or a private cluster fabric) only. Defense in depth: pass a
+shared ``auth_key`` to both sides and every control message, rpc body,
+and chunk header is authenticated with keyed BLAKE2b *before* any
+unpickling (unauthenticated traffic is dropped/refused). The key
+authenticates; it does not encrypt — for untrusted networks add CurveZMQ
+or a TLS tunnel.
 """
 
+import hashlib
+import hmac as hmac_mod
 import logging
+import os
 import pickle
 import struct
 import threading
@@ -68,6 +102,19 @@ _CTRL_END = b'PST_END'
 _CTRL_ERR = b'PST_ERR'
 _SERVER_ID_LEN = 16
 _COUNT_STRUCT = struct.Struct('<Q')
+_META_STRUCT = struct.Struct('<16sQ')   # (server_id, chunk seq)
+_MAC_LEN = 16
+
+
+def _mac(key, *parts):
+    h = hashlib.blake2b(digest_size=_MAC_LEN, key=key)
+    for p in parts:
+        h.update(p)
+    return h.digest()
+
+
+def _mac_ok(key, mac, *parts):
+    return hmac_mod.compare_digest(bytes(mac), _mac(key, *parts))
 
 
 def _dump_frames(cols):
@@ -104,10 +151,44 @@ class DataServer(object):
         (default: data port + 2).
     :param sndhwm: per-consumer high-water mark (chunks buffered in zmq
         before the server blocks — the service's backpressure).
+    :param auth_key: optional shared secret (bytes). When set, control
+        broadcasts, rpc traffic, and chunk headers carry a keyed-BLAKE2b
+        mac, verified BEFORE unpickling (see the module trust-boundary
+        note). Consumers must pass the same key.
+    :param snapshot_path: when set, the server self-snapshots to this
+        path (atomically) every ``snapshot_every`` chunks: reader
+        position + identity + a replay ring of recent chunk frames, so
+        an UNPLANNED death (SIGKILL) can be recovered via
+        ``snapshot_resume`` with no lost rows. The ring keeps the last
+        ``sndhwm + 4`` chunks' frames alive in memory — size chunks
+        accordingly. **Requires a chunk-deterministic reader config**:
+        seq-based dedupe assumes a resumed reader re-produces the same
+        chunks in the same order past the snapshot point, so use
+        ``workers_count=1`` (a ventilated multi-worker pool completes
+        row groups in nondeterministic order; a replayed seq could then
+        carry different rows than the original and be wrongly deduped).
+        Replayed chunks are deduped per consumer by
+        ``(server_id, seq)``; with SEVERAL shared-stream consumers a
+        replayed chunk can land on a different consumer than the
+        original did, so a crash can duplicate rows across consumers
+        within the ring window (a sole consumer sees exactly-once).
+    :param snapshot_every: snapshot cadence in chunks (default 16).
+    :param replay_ring_chunks: replay-ring depth (default ``sndhwm + 4``,
+        sized for ONE consumer). zmq PUSH queues up to ``sndhwm`` chunks
+        per consumer pipe, so with N consumers a SIGKILL can strand up to
+        ``N * sndhwm`` sent-but-undelivered chunks — pass at least that
+        plus slack or recovery can lose the oldest of them.
+    :param snapshot_resume: a loaded snapshot dict (see
+        :func:`load_server_snapshot`) — restores the server's identity
+        and served-count, and queues the ring for re-send. The READER
+        must separately be built from the snapshot's ``reader_state``
+        (``serve_dataset(snapshot_resume=path)`` wires both).
     """
 
     def __init__(self, reader, bind, control_bind=None, rpc_bind=None,
-                 sndhwm=4):
+                 sndhwm=4, auth_key=None, snapshot_path=None,
+                 snapshot_every=16, snapshot_resume=None,
+                 replay_ring_chunks=None):
         import zmq
 
         if not getattr(reader, 'batched_output', False):
@@ -180,11 +261,39 @@ class DataServer(object):
         self._pause_gen = 0
         self._paused_gen = 0
         self._served_chunks = 0
+        self._auth_key = auth_key
+        self._snapshot_path = snapshot_path
+        self._snapshot_every = max(1, int(snapshot_every))
+        # Replay ring: the raw frames of the most recent chunks. A SIGKILL
+        # loses at most the zmq userland send queue (TCP-buffered bytes of
+        # a dead process still get flushed by the kernel) — and PUSH
+        # queues up to ``sndhwm`` PER consumer pipe, so the default depth
+        # covers one consumer; topologies with N consumers must pass
+        # ``replay_ring_chunks >= N * sndhwm + slack`` for the recovery
+        # to stay lossless.
+        from collections import deque
+        if replay_ring_chunks is None:
+            replay_ring_chunks = sndhwm + 4
+        self._ring = deque(maxlen=replay_ring_chunks)
+        self._replay = []
         import uuid
         # END messages carry the server's identity: a client connected to N
         # servers must see N DISTINCT ends (one server repeats its broadcast
-        # for slow joiners and must not count N times).
-        self._server_id = uuid.uuid4().bytes
+        # for slow joiners and must not count N times). A snapshot resume
+        # KEEPS the identity: consumers' dedupe sets and end accounting then
+        # span the crash.
+        if snapshot_resume is not None:
+            self._server_id = snapshot_resume['server_id']
+            self._served_chunks = snapshot_resume['sent']
+            self._replay = [(seq, [memoryview(f) for f in frames])
+                            for seq, frames in snapshot_resume['ring']]
+            # Re-seed the ring too: the next snapshot (written at serve
+            # start) must keep covering these chunks, or a SECOND crash
+            # before the ring refills would lose what the first one
+            # nearly did.
+            self._ring.extend(self._replay)
+        else:
+            self._server_id = uuid.uuid4().bytes
 
     def serve_forever(self):
         """Blocking serve loop: pull batches off the reader, push to
@@ -192,8 +301,23 @@ class DataServer(object):
         (or an error marker if it failed — trainers re-raise, they must
         never mistake a half-served dataset for a clean epoch)."""
         err_body = None
-        rows = iter(self._reader)
         try:
+            # iter() inside the guard: an __iter__ failure must take the
+            # same error-broadcast path as a mid-stream one — an escaped
+            # exception here would kill the thread with no END/ERR and a
+            # sole consumer would poll forever.
+            rows = iter(self._reader)
+            # Crash recovery: before the initial snapshot exists, a restart
+            # cannot recover identity — write one at chunk 0 so every
+            # restart-from-snapshot has the original server_id.
+            if self._snapshot_path is not None:
+                self._write_snapshot()
+            # Re-send the resumed ring first (already counted in the
+            # served total — consumers drop the ones they already have).
+            for seq, frames in self._replay:
+                if not self._send_chunk(seq, frames, count=False):
+                    break
+            self._replay = []
             while not self._stop.is_set():
                 if self._pause.is_set():
                     # Chunk boundary: _served_chunks is final and the
@@ -207,16 +331,18 @@ class DataServer(object):
                     break
                 frames = _dump_frames(
                     {name: getattr(sample, name) for name in sample._fields})
-                while not self._stop.is_set():
-                    try:
-                        self._data_sock.send_multipart(
-                            frames, flags=self._zmq.NOBLOCK, copy=False)
-                        self._served_chunks += 1
-                        break
-                    except self._zmq.Again:
-                        # All consumers at HWM (or none connected yet):
-                        # wake the moment one can take the chunk.
-                        self._data_sock.poll(50, self._zmq.POLLOUT)
+                seq = self._served_chunks
+                self._ring.append((seq, frames))
+                if not self._send_chunk(seq, frames, count=True):
+                    # Stopped mid-HWM-retry: the reader has advanced past
+                    # this chunk but `sent` has not — a snapshot here
+                    # would be one chunk ahead of its count and a resume
+                    # would reuse this seq for DIFFERENT rows (consumers
+                    # would dedupe them away). Don't snapshot; exit.
+                    break
+                if (self._snapshot_path is not None
+                        and self._served_chunks % self._snapshot_every == 0):
+                    self._write_snapshot()
         except Exception as e:  # noqa: BLE001 - forwarded to trainers
             logger.exception('data server reader failed')
             err_body = repr(e).encode('utf-8', 'replace')[:512]
@@ -226,8 +352,17 @@ class DataServer(object):
             if err_body is None:
                 marker = (_CTRL_END + self._server_id
                           + _COUNT_STRUCT.pack(self._served_chunks))
+                if self._snapshot_path is not None:
+                    # Final snapshot: a restart after a clean end re-serves
+                    # nothing and re-advertises the full count.
+                    try:
+                        self._write_snapshot()
+                    except Exception:   # noqa: BLE001 - end still broadcast
+                        logger.exception('final server snapshot failed')
             else:
                 marker = _CTRL_ERR + self._server_id + err_body
+            if self._auth_key is not None:
+                marker += _mac(self._auth_key, marker)
             # Broadcast until stopped: PUB drops messages for slow-JOINING
             # subscribers, so a client that dials in after the data ended
             # still learns the stream is over.
@@ -241,6 +376,45 @@ class DataServer(object):
                     self._paused_gen = self._pause_gen
                 time.sleep(0.05)
 
+    def _send_chunk(self, seq, frames, count):
+        """HWM-respecting send of ``[meta, header, buf...]``; returns False
+        only when stopped mid-retry. The meta frame carries (server_id,
+        seq) — and, under ``auth_key``, a mac over the meta prefix and the
+        pickle header, so consumers authenticate before unpickling."""
+        meta = _META_STRUCT.pack(self._server_id, seq)
+        if self._auth_key is not None:
+            meta += _mac(self._auth_key, meta, frames[0])
+        parts = [meta] + frames
+        while not self._stop.is_set():
+            try:
+                self._data_sock.send_multipart(
+                    parts, flags=self._zmq.NOBLOCK, copy=False)
+                if count:
+                    self._served_chunks += 1
+                return True
+            except self._zmq.Again:
+                # All consumers at HWM (or none connected yet): wake the
+                # moment one can take the chunk.
+                self._data_sock.poll(50, self._zmq.POLLOUT)
+        return False
+
+    def _write_snapshot(self):
+        """Atomically persist {identity, served count, reader position,
+        replay ring} — the serve thread is between chunks here, so the
+        reader state corresponds exactly to ``sent``."""
+        state_fn = getattr(self._reader, 'state_dict', None)
+        snapshot = {
+            'server_id': self._server_id,
+            'sent': self._served_chunks,
+            'reader_state': state_fn() if state_fn is not None else None,
+            'ring': [(seq, [bytes(f) for f in frames])
+                     for seq, frames in self._ring],
+        }
+        tmp = '{}.tmp.{}'.format(self._snapshot_path, os.getpid())
+        with open(tmp, 'wb') as f:
+            pickle.dump(snapshot, f, protocol=5)
+        os.replace(tmp, self._snapshot_path)
+
     def _rpc_loop(self):
         """Answer checkpoint/stats requests (REP socket, one at a time)."""
         zmq = self._zmq
@@ -251,6 +425,18 @@ class DataServer(object):
                 raw = self._rpc_sock.recv()
             except zmq.ZMQError:
                 return
+            if self._auth_key is not None:
+                # Authenticate BEFORE unpickling: an unauthenticated
+                # request gets an explicit (non-pickle-derived) refusal.
+                if (len(raw) < _MAC_LEN or
+                        not _mac_ok(self._auth_key, raw[-_MAC_LEN:],
+                                    raw[:-_MAC_LEN])):
+                    reply = pickle.dumps({'error': 'unauthenticated rpc '
+                                          'request refused'}, protocol=5)
+                    self._rpc_sock.send(
+                        reply + _mac(self._auth_key, reply))
+                    continue
+                raw = raw[:-_MAC_LEN]
             try:
                 # Unpickling is inside the guarded region: stray bytes on
                 # the port (scanner, protocol mismatch) must produce an
@@ -266,6 +452,8 @@ class DataServer(object):
             except Exception as e:  # noqa: BLE001 - reply, don't die
                 logger.exception('data server rpc failed')
                 payload = pickle.dumps({'error': repr(e)}, protocol=5)
+            if self._auth_key is not None:
+                payload += _mac(self._auth_key, payload)
             self._rpc_sock.send(payload)
 
     def _handle_rpc(self, request):
@@ -357,8 +545,21 @@ class DataServer(object):
         return False
 
 
+def load_server_snapshot(path):
+    """Load a server self-snapshot written via ``snapshot_path=``.
+
+    **Pickle — trusted storage only** (module trust-boundary note).
+    Returns the snapshot dict: ``server_id``, ``sent``, ``reader_state``
+    (pass to the reader factory as ``resume_state``), ``ring``.
+    """
+    with open(path, 'rb') as f:
+        return pickle.load(f)
+
+
 def serve_dataset(dataset_url, bind, reader_factory=None, start=True,
-                  sndhwm=4, **reader_kwargs):
+                  sndhwm=4, auth_key=None, snapshot_path=None,
+                  snapshot_every=16, snapshot_resume=None,
+                  replay_ring_chunks=None, **reader_kwargs):
     """Convenience: build a tensor reader over ``dataset_url`` and serve it.
 
     Returns the started :class:`DataServer` (context-manage it). Extra
@@ -366,13 +567,33 @@ def serve_dataset(dataset_url, bind, reader_factory=None, start=True,
     ``reader_factory`` if given — use ``make_batch_reader`` for plain
     stores); pass ``resume_state=`` to continue a checkpointed server from
     its recorded position.
+
+    Crash recovery: ``snapshot_path`` arms periodic self-snapshots;
+    ``snapshot_resume`` (a path, or a dict from
+    :func:`load_server_snapshot`) restarts a killed server from its last
+    snapshot — reader position, identity, and replay ring all restored
+    (``resume_state`` must not also be given; the snapshot carries it).
+    Recovery's seq-based dedupe requires the reader to re-produce chunks
+    deterministically after resume: pass ``workers_count=1`` when arming
+    ``snapshot_path`` (see :class:`DataServer`).
     """
     from petastorm_tpu.reader import make_tensor_reader
 
+    if isinstance(snapshot_resume, str):
+        snapshot_resume = load_server_snapshot(snapshot_resume)
+    if snapshot_resume is not None:
+        if 'resume_state' in reader_kwargs:
+            raise ValueError('pass either snapshot_resume or resume_state, '
+                             'not both — the snapshot embeds the reader state')
+        reader_kwargs['resume_state'] = snapshot_resume['reader_state']
     factory = reader_factory or make_tensor_reader
     reader = factory(dataset_url, **reader_kwargs)
     try:
-        server = DataServer(reader, bind, sndhwm=sndhwm)
+        server = DataServer(reader, bind, sndhwm=sndhwm, auth_key=auth_key,
+                            snapshot_path=snapshot_path,
+                            snapshot_every=snapshot_every,
+                            snapshot_resume=snapshot_resume,
+                            replay_ring_chunks=replay_ring_chunks)
     except Exception:
         # e.g. bind: address already in use — don't leak the started pool.
         reader.stop()
@@ -381,13 +602,55 @@ def serve_dataset(dataset_url, bind, reader_factory=None, start=True,
     return server.start() if start else server
 
 
+class _SeqTracker(object):
+    """Per-server received-seq set: a contiguous watermark plus a sparse
+    overflow. A sole consumer receives gaplessly, so it collapses to the
+    bare watermark (O(1)); a shared-stream consumer's gaps are the chunks
+    its peers took, so its sparse set grows with chunks received — ~100
+    bytes/chunk of accounting on an endless (``num_epochs=None``) shared
+    stream. Epoch-bounded streams reset with the reader; services running
+    unbounded shared streams for days should rotate consumers (or accept
+    the linear growth — 1M chunks is ~100 MB)."""
+
+    __slots__ = ('watermark', 'extras')
+
+    def __init__(self):
+        self.watermark = 0      # every seq < watermark has been received
+        self.extras = set()     # received seqs >= watermark
+
+    def add(self, seq):
+        """Record ``seq``; False when it was already received (duplicate —
+        e.g. a restarted server replaying its ring)."""
+        if seq < self.watermark or seq in self.extras:
+            return False
+        self.extras.add(seq)
+        while self.watermark in self.extras:
+            self.extras.discard(self.watermark)
+            self.watermark += 1
+        return True
+
+    @property
+    def count(self):
+        return self.watermark + len(self.extras)
+
+
 class RemoteReader(object):
     """Trainer-side consumer of one or more :class:`DataServer` streams.
+
+    **Several consumers on the same servers?** Every one of them must be
+    constructed with ``shared_stream=True`` — the default (False) assumes
+    a sole consumer and RAISES at end-of-epoch when its received count
+    falls short of the servers' advertised totals (with peers it always
+    will: they took the difference). Shared-stream checkpointing goes
+    through :func:`checkpoint_shared_stream`, not :meth:`state_dict`.
 
     Implements the Reader surface :class:`~petastorm_tpu.jax_loader.
     JaxLoader` needs: iterate namedtuples of column blocks
     (``batched_output=True``), ``stop``/``join``, ``diagnostics`` — plus
-    :meth:`state_dict` for cross-boundary checkpointing.
+    :meth:`state_dict` for cross-boundary checkpointing. Chunks arriving
+    twice (a crashed server replaying its snapshot ring) are detected by
+    their ``(server_id, seq)`` meta frame and dropped silently
+    (``diagnostics['duplicate_chunks']``).
 
     :param endpoints: data endpoint(s), e.g. ``'tcp://host:5555'`` or a
         list — PULL fair-queues across all connected servers.
@@ -407,9 +670,13 @@ class RemoteReader(object):
     :param end_grace_s: how long to wait for advertised-but-undelivered
         tail chunks after all servers ended before declaring the stream
         lost (sole consumer) or finished (``shared_stream=True``).
-    :param resume_state: a :meth:`state_dict` snapshot — re-delivers the
+    :param resume_state: a :meth:`state_dict` snapshot (or one consumer's
+        entry from :func:`checkpoint_shared_stream`) — re-delivers the
         chunks that were in flight at checkpoint time before pulling
         from the (restarted) servers.
+    :param auth_key: shared secret matching the servers' ``auth_key`` —
+        chunk headers, control broadcasts, and rpc replies are then
+        authenticated before unpickling (module trust-boundary note).
     """
 
     batched_output = True
@@ -419,7 +686,7 @@ class RemoteReader(object):
 
     def __init__(self, endpoints, control_endpoints=None, rpc_endpoints=None,
                  rcvhwm=4, poll_timeout_s=0.1, shared_stream=False,
-                 end_grace_s=5.0, resume_state=None):
+                 end_grace_s=5.0, resume_state=None, auth_key=None):
         import zmq
 
         if isinstance(endpoints, str):
@@ -458,7 +725,11 @@ class RemoteReader(object):
         self._server_errors = {}
         self._stopped = False
         self._nt_cache = {}
-        self._chunks = 0
+        self._chunks = 0        # unique chunks received (dupes excluded)
+        self._auth_key = auth_key
+        self._seen = {}         # server_id -> _SeqTracker (under _acct_lock)
+        self._dup_chunks = 0
+        self._bad_auth_frames = 0
         # Thread-safety of stop() vs an iterating pump thread: sockets are
         # only touched under _sock_lock; stop() sets _stopped and closes
         # the sockets itself ONLY if it can take the lock without blocking
@@ -493,6 +764,13 @@ class RemoteReader(object):
         try:
             while True:
                 msg = self._ctrl_sock.recv(flags=zmq.NOBLOCK)
+                if self._auth_key is not None:
+                    if (len(msg) < _MAC_LEN or
+                            not _mac_ok(self._auth_key, msg[-_MAC_LEN:],
+                                        msg[:-_MAC_LEN])):
+                        self._bad_auth_frames += 1
+                        continue
+                    msg = msg[:-_MAC_LEN]
                 if msg.startswith(_CTRL_ERR):
                     body = msg[len(_CTRL_ERR):]
                     sid = body[:_SERVER_ID_LEN]
@@ -517,29 +795,65 @@ class RemoteReader(object):
             self._ctrl_sock.close(linger=0)
 
     def _recv_chunk_nowait(self):
-        """One data chunk as a cols dict, or None. Caller holds _sock_lock
-        and must count+retain the chunk under _acct_lock in one step (the
-        snapshot logic treats ``_chunks == sent`` as "every counted chunk
-        is in _unacked/_pending or consumed")."""
-        if self._closed:
-            return None
-        try:
-            frames = self._data_sock.recv_multipart(
-                flags=self._zmq.NOBLOCK, copy=False)
-        except self._zmq.Again:
-            return None
-        return _load_frames(frames)
+        """One data chunk as ``(server_id, seq, cols)``, or None. Caller
+        holds _sock_lock and must dedupe+count+retain under _acct_lock in
+        one step via :meth:`_track` (the snapshot logic treats ``_chunks
+        == sent`` as "every counted chunk is in _unacked/_pending or
+        consumed"). Frames failing authentication or with a malformed
+        meta frame are dropped without touching pickle."""
+        while not self._closed:
+            try:
+                frames = self._data_sock.recv_multipart(
+                    flags=self._zmq.NOBLOCK, copy=False)
+            except self._zmq.Again:
+                return None
+            want = _META_STRUCT.size + (_MAC_LEN if self._auth_key is not None
+                                        else 0)
+            if len(frames) < 2:
+                # A stray single-frame message (port reused by an alien
+                # process, spoofed traffic) must be dropped, not crash
+                # the pump thread with an IndexError below.
+                self._bad_auth_frames += 1
+                continue
+            meta = frames[0]
+            meta = bytes(meta.buffer if hasattr(meta, 'buffer') else meta)
+            if len(meta) != want:
+                self._bad_auth_frames += 1
+                continue
+            if self._auth_key is not None:
+                head = frames[1]
+                head = head.buffer if hasattr(head, 'buffer') else head
+                if not _mac_ok(self._auth_key, meta[-_MAC_LEN:],
+                               meta[:_META_STRUCT.size], head):
+                    self._bad_auth_frames += 1
+                    continue
+            sid, seq = _META_STRUCT.unpack_from(meta)
+            return sid, seq, _load_frames(frames[1:])
+        return None
+
+    def _track(self, sid, seq):
+        """Count a received chunk (caller holds _acct_lock); False for a
+        duplicate (replayed by a restarted server) — drop, don't count."""
+        tracker = self._seen.get(sid)
+        if tracker is None:
+            tracker = self._seen[sid] = _SeqTracker()
+        if not tracker.add(seq):
+            self._dup_chunks += 1
+            return False
+        self._chunks += 1
+        return True
 
     def _drain_one_into_pending(self):
         """Receive one chunk into the undelivered backlog; False if none
         was waiting. Shared by the checkpoint drain paths."""
         with self._sock_lock:
-            cols = self._recv_chunk_nowait()
-        if cols is None:
+            received = self._recv_chunk_nowait()
+        if received is None:
             return False
+        sid, seq, cols = received
         with self._acct_lock:
-            self._chunks += 1
-            self._pending.append(cols)
+            if self._track(sid, seq):
+                self._pending.append(cols)
         return True
 
     def _to_namedtuple(self, cols):
@@ -596,11 +910,13 @@ class RemoteReader(object):
                 if self._stopped or self._closed:
                     self._close_sockets()
                     raise StopIteration
-                cols = self._recv_chunk_nowait()
-                if cols is not None:
+                received = self._recv_chunk_nowait()
+                if received is not None:
+                    sid, seq, cols = received
                     with self._acct_lock:
-                        self._chunks += 1
-                        return self._deliver(cols)
+                        if self._track(sid, seq):
+                            return self._deliver(cols)
+                    continue    # duplicate (server ring replay): drop
                 # No data pending: check for END/ERR broadcasts, re-poll.
                 self._drain_control()
                 if len(self._ended_server_ids) >= self._n_servers:
@@ -635,13 +951,19 @@ class RemoteReader(object):
                             self.last_row_consumed = True
                             raise StopIteration
                         self._stopped = True
+                        hint = ('' if not self._bad_auth_frames else
+                                ' NOTE: {} frame(s) failed authentication '
+                                '— auth_key mismatch with the server is '
+                                'the likely cause.'.format(
+                                    self._bad_auth_frames))
                         raise RuntimeError(
                             'stream ended with {} of {} advertised chunks '
                             'delivered after {}s grace — tail chunks were '
                             'lost (half-served dataset). If several '
                             'consumers share this stream, construct '
-                            'RemoteReader(shared_stream=True).'.format(
-                                self._chunks, expected, self._end_grace_s))
+                            'RemoteReader(shared_stream=True).{}'.format(
+                                self._chunks, expected, self._end_grace_s,
+                                hint))
                     self._poller.poll(min(self._poll_ms, 50))
                     continue
                 self._poller.poll(self._poll_ms)
@@ -666,31 +988,16 @@ class RemoteReader(object):
         if self._shared_stream:
             raise RuntimeError('state_dict() requires a sole consumer '
                                '(shared_stream=True streams cannot '
-                               'attribute in-flight chunks)')
-        zmq = self._zmq
-        states, total_sent = [], 0
-        socks = []
+                               'attribute in-flight chunks); use '
+                               'checkpoint_shared_stream(readers)')
         paused = []     # endpoints that were ASKED to pause (a server whose
         #                 reply timed out client-side may still park later —
         #                 it must be resumed too, not only confirmed ones)
         try:
-            for endpoint in self._rpc_endpoints:
-                sock = self._context.socket(zmq.REQ)
-                sock.setsockopt(zmq.LINGER, 0)
-                sock.connect(endpoint)
-                socks.append(sock)
-            for sock, endpoint in zip(socks, self._rpc_endpoints):
-                paused.append(endpoint)
-                sock.send(pickle.dumps({'cmd': 'pause_state'}, protocol=5))
-                # Drain data while waiting: the serve loop may be parked in
-                # a HWM send retry, which must complete before it can reach
-                # the pause boundary.
-                reply = self._rpc_recv_draining(sock, endpoint)
-                if 'error' in reply:
-                    raise RuntimeError('server {} checkpoint failed: {}'
-                                       .format(endpoint, reply['error']))
-                states.append(reply['state'])
-                total_sent += reply['sent']
+            replies = _pause_servers(self, self._rpc_endpoints,
+                                     self._drain_one_into_pending, paused)
+            states = [r['state'] for r in replies]
+            total_sent = sum(r['sent'] for r in replies)
             # Every server is now parked; drain until all sent chunks are
             # here (they are at most HWM-deep in zmq queues). The final
             # check and the snapshot share one _acct_lock acquisition:
@@ -701,23 +1008,7 @@ class RemoteReader(object):
             while pending_snapshot is None:
                 with self._acct_lock:
                     if self._chunks >= total_sent:
-                        # The checkpoint's replay set, in delivery order:
-                        # rows delivered to the loader but not yet
-                        # attributed via rows_consumed (prefetch-queue
-                        # rows; the front chunk may be partially consumed
-                        # — keep only its tail), then the received-but-
-                        # undelivered backlog.
-                        pending_snapshot = []
-                        offset = self._unacked_offset
-                        for cols, _nrows in self._unacked:
-                            if offset:
-                                pending_snapshot.append(
-                                    {k: v[offset:] for k, v in cols.items()})
-                                offset = 0
-                            else:
-                                pending_snapshot.append(dict(cols))
-                        pending_snapshot.extend(
-                            dict(c) for c in self._pending)
+                        pending_snapshot = self._pending_snapshot_locked()
                         continue
                 if self._drain_one_into_pending():
                     continue
@@ -736,38 +1027,58 @@ class RemoteReader(object):
                         self._data_sock.poll(50)
             state = {'server_states': states,
                      'pending': pending_snapshot}
-            for sock, endpoint in zip(socks, self._rpc_endpoints):
-                sock.send(pickle.dumps({'cmd': 'resume'}, protocol=5))
-                if not sock.poll(10000):
-                    raise RuntimeError('server {} did not acknowledge '
-                                       'resume'.format(endpoint))
-                sock.recv()
+            _resume_servers(self, self._rpc_endpoints)
             paused = []     # all resumed cleanly
             return state
         finally:
-            for sock in socks:
-                sock.close(linger=0)
-            # A failure after some servers paused must not leave them
-            # parked forever (the stream would hang, not error): best-
-            # effort resume over fresh REQ sockets (the originals may be
-            # stuck mid-request and REQ sockets cannot re-send).
-            for endpoint in paused:
-                try:
-                    self._one_shot_rpc(endpoint, {'cmd': 'resume'},
-                                       timeout_ms=5000)
-                except Exception:   # noqa: BLE001 - already failing
-                    logger.exception('could not un-pause server %s after '
-                                     'failed checkpoint', endpoint)
+            _best_effort_resume(self, paused)
 
-    def _rpc_recv_draining(self, sock, endpoint, timeout_s=30.0):
-        deadline = time.monotonic() + timeout_s
-        while True:
-            if sock.poll(20):
-                return pickle.loads(sock.recv())
-            if (not self._drain_one_into_pending()
-                    and time.monotonic() >= deadline):
-                raise RuntimeError('server {} did not answer pause_state '
-                                   'within {}s'.format(endpoint, timeout_s))
+    def _pending_snapshot_locked(self):
+        """The checkpoint replay set in delivery order (caller holds
+        _acct_lock): rows delivered to the loader but not yet attributed
+        via rows_consumed (prefetch-queue rows; the front chunk may be
+        partially consumed — keep only its tail), then the received-but-
+        undelivered backlog."""
+        snapshot = []
+        offset = self._unacked_offset
+        for cols, _nrows in self._unacked:
+            if offset:
+                snapshot.append({k: v[offset:] for k, v in cols.items()})
+                offset = 0
+            else:
+                snapshot.append(dict(cols))
+        snapshot.extend(dict(c) for c in self._pending)
+        return snapshot
+
+    def _unique_received(self):
+        """Per-server unique received-chunk counts (for checkpoint
+        aggregation across shared-stream consumers)."""
+        with self._acct_lock:
+            return {sid: t.count for sid, t in self._seen.items()}
+
+    def _received_seqs(self):
+        """Per-server (watermark, extras) received-seq sets — the raw
+        material for TRUE cross-consumer unions (a summed count would
+        double-count a chunk a crashed server's ring replay landed on a
+        different consumer than the original)."""
+        with self._acct_lock:
+            return {sid: (t.watermark, frozenset(t.extras))
+                    for sid, t in self._seen.items()}
+
+    def _rpc_dumps(self, request):
+        payload = pickle.dumps(request, protocol=5)
+        if self._auth_key is not None:
+            payload += _mac(self._auth_key, payload)
+        return payload
+
+    def _rpc_loads(self, raw):
+        if self._auth_key is not None:
+            if (len(raw) < _MAC_LEN or
+                    not _mac_ok(self._auth_key, raw[-_MAC_LEN:],
+                                raw[:-_MAC_LEN])):
+                raise RuntimeError('unauthenticated rpc reply')
+            raw = raw[:-_MAC_LEN]
+        return pickle.loads(raw)
 
     def _one_shot_rpc(self, endpoint, request, timeout_ms=10000):
         """One REQ/REP round-trip on a fresh socket; None on timeout."""
@@ -776,10 +1087,10 @@ class RemoteReader(object):
         sock.setsockopt(zmq.LINGER, 0)
         try:
             sock.connect(endpoint)
-            sock.send(pickle.dumps(request, protocol=5))
+            sock.send(self._rpc_dumps(request))
             if not sock.poll(timeout_ms):
                 return None
-            return pickle.loads(sock.recv())
+            return self._rpc_loads(sock.recv())
         finally:
             sock.close(linger=0)
 
@@ -816,7 +1127,9 @@ class RemoteReader(object):
         return {'remote_chunks': self._chunks,
                 'servers': self._n_servers,
                 'servers_ended': len(self._ended_server_ids),
-                'pending_chunks': len(self._pending)}
+                'pending_chunks': len(self._pending),
+                'duplicate_chunks': self._dup_chunks,
+                'bad_auth_frames': self._bad_auth_frames}
 
     def stop(self):
         # May be called from any thread while another is blocked in
@@ -847,6 +1160,201 @@ class RemoteReader(object):
         return False
 
 
+def _pause_servers(reader, endpoints, drain_once, paused, timeout_s=30.0):
+    """Send ``pause_state`` to every server in turn, calling
+    ``drain_once()`` while waiting for each reply — the serve loop may be
+    parked in an HWM send retry that must complete before it can reach
+    the pause boundary. Appends each endpoint to ``paused`` BEFORE
+    sending (a server whose reply times out client-side may still park
+    later and must be resumed too). Returns the reply dicts. Shared by
+    :meth:`RemoteReader.state_dict` and :func:`checkpoint_shared_stream`
+    — one copy of a subtle pause protocol, not two drifting ones."""
+    zmq = reader._zmq
+    replies = []
+    for endpoint in endpoints:
+        sock = reader._context.socket(zmq.REQ)
+        sock.setsockopt(zmq.LINGER, 0)
+        try:
+            sock.connect(endpoint)
+            paused.append(endpoint)
+            sock.send(reader._rpc_dumps({'cmd': 'pause_state'}))
+            deadline = time.monotonic() + timeout_s
+            while not sock.poll(20):
+                if not drain_once() and time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        'server {} did not answer pause_state within '
+                        '{}s'.format(endpoint, timeout_s))
+            reply = reader._rpc_loads(sock.recv())
+        finally:
+            sock.close(linger=0)
+        if 'error' in reply:
+            raise RuntimeError('server {} checkpoint failed: {}'.format(
+                endpoint, reply['error']))
+        replies.append(reply)
+    return replies
+
+
+def _resume_servers(reader, endpoints):
+    for endpoint in endpoints:
+        if reader._one_shot_rpc(endpoint, {'cmd': 'resume'}) is None:
+            raise RuntimeError('server {} did not acknowledge '
+                               'resume'.format(endpoint))
+
+
+def _best_effort_resume(reader, endpoints):
+    """A failure after some servers paused must not leave them parked
+    forever (the stream would hang, not error)."""
+    for endpoint in endpoints:
+        try:
+            reader._one_shot_rpc(endpoint, {'cmd': 'resume'},
+                                 timeout_ms=5000)
+        except Exception:   # noqa: BLE001 - already failing
+            logger.exception('could not un-pause server %s after failed '
+                             'checkpoint', endpoint)
+
+
+def _union_received_counts(readers):
+    """Exact per-server count of DISTINCT chunks received across all
+    ``readers``: reader i holds every seq below its watermark plus its
+    extras, so the union is ``[0, max_watermark) ∪ {extras >= max_w}``
+    (extras below another reader's watermark are already covered).
+    Duplicates that landed on different consumers collapse, unlike a sum
+    of per-reader counts."""
+    per_sid = {}
+    for r in readers:
+        for sid, (w, extras) in r._received_seqs().items():
+            pw, pex = per_sid.get(sid, (0, set()))
+            per_sid[sid] = (max(pw, w), pex | set(extras))
+    return {sid: w + sum(1 for e in extras if e >= w)
+            for sid, (w, extras) in per_sid.items()}
+
+
+def checkpoint_shared_stream(readers, timeout_s=60.0):
+    """Coordinated mid-epoch checkpoint for SEVERAL RemoteReaders sharing
+    the same servers (``shared_stream=True``) — the topology where
+    per-consumer :meth:`RemoteReader.state_dict` is impossible (chunk
+    attribution is dynamic, so no single consumer can verify it drained
+    its share).
+
+    Protocol — callers must make every consumer quiescent first (no
+    thread inside ``__next__`` during the call; pause the trainers):
+
+    1. pause every server once at a chunk boundary (rpc ``pause_state``),
+       collecting its reader state, identity, and sent count;
+    2. drain ALL consumers until, for every server, the union of the
+       consumers' received seq sets covers its sent count — per-consumer
+       counts are unknowable, but each chunk goes to exactly one
+       consumer, so the union is exact;
+    3. snapshot each consumer's replay set (prefetched-but-unattributed
+       rows + undelivered backlog);
+    4. resume the servers.
+
+    Returns ``{'server_states': [...], 'consumers': [{'pending': [...]},
+    ...]}``: restart server ``i`` with
+    ``serve_dataset(resume_state=state['server_states'][i])`` and
+    consumer ``j`` with ``RemoteReader(...,
+    resume_state=state['consumers'][j], shared_stream=True)`` — the union
+    of rows delivered across consumers is exactly-once
+    (``tests/test_data_service.py::test_shared_stream_checkpoint``).
+
+    Works in-process as given. Across trainer hosts, run the same
+    protocol with each host draining its own reader and a coordinator
+    union-merging the per-server received-seq sets
+    (``reader._received_seqs()``; a SUM of counts would be fooled by a
+    crash-replay chunk landing on two different consumers) over the
+    job's control fabric — chunk-to-consumer attribution itself needs no
+    exchange.
+    """
+    if not readers:
+        raise ValueError('checkpoint_shared_stream needs at least one reader')
+    first = readers[0]
+    endpoints = first._rpc_endpoints
+    for r in readers[1:]:
+        if r._rpc_endpoints != endpoints:
+            raise ValueError('all readers must consume the same servers '
+                             '(rpc endpoints differ)')
+    paused = []
+    try:
+        def drain_all():
+            # Drain EVERY reader while waiting: the serve loop may be
+            # parked in an HWM send retry against any consumer (list
+            # comprehension: no short-circuit, all readers progress).
+            return any([r._drain_one_into_pending() for r in readers])
+
+        replies = _pause_servers(first, endpoints, drain_all, paused)
+        states = [r['state'] for r in replies]
+        sids = [r['server_id'] for r in replies]
+        sents = [r['sent'] for r in replies]
+        deadline = time.monotonic() + timeout_s
+        while True:
+            counts = _union_received_counts(readers)
+            if all(counts.get(sid, 0) >= sent
+                   for sid, sent in zip(sids, sents)):
+                break
+            progressed = [r._drain_one_into_pending() for r in readers]
+            if not any(progressed):
+                if time.monotonic() >= deadline:
+                    short = {e: sent - counts.get(sid, 0)
+                             for e, sid, sent in zip(endpoints, sids, sents)
+                             if counts.get(sid, 0) < sent}
+                    raise RuntimeError(
+                        'shared-stream checkpoint: sent chunks never '
+                        'arrived at any consumer (per-server shortfall: '
+                        '{}) — a consumer outside `readers` on this '
+                        'stream?'.format(short))
+                time.sleep(0.02)
+        consumers = []
+        for r in readers:
+            with r._acct_lock:
+                consumers.append({'pending': r._pending_snapshot_locked()})
+        state = {'server_states': states, 'consumers': consumers}
+        _resume_servers(first, endpoints)
+        paused = []
+        return state
+    finally:
+        _best_effort_resume(first, paused)
+
+
+def verify_shared_stream_complete(readers):
+    """Exact end-of-stream accounting for shared streams — restores, at
+    the job level, the guarantee each shared consumer individually gives
+    up (its own end is a grace-window heuristic): after every consumer's
+    iteration finished, assert the union of received chunks covers every
+    server's advertised total. Raises ``RuntimeError`` on a shortfall
+    (lost tail chunks) or on a server that never advertised; returns
+    ``{'received': total, 'advertised': total, 'duplicates': n}``.
+
+    Across hosts, union-merge ``reader._received_seqs()`` and each
+    reader's advertised map the same way over the job's control fabric.
+    """
+    counts = _union_received_counts(readers)
+    advertised = {}
+    dups = 0
+    for r in readers:
+        for sid, adv in r._advertised.items():
+            advertised[sid] = max(advertised.get(sid, 0), adv)
+        dups += r._dup_chunks
+    # Cross-consumer duplicates (a crashed server's replay landing on a
+    # different consumer) show up as sum-of-counts exceeding the union.
+    uniq = [r._unique_received() for r in readers]
+    dups += sum(sum(u.get(sid, 0) for u in uniq) - n
+                for sid, n in counts.items())
+    unadvertised = [sid for sid in counts if sid not in advertised]
+    if unadvertised:
+        raise RuntimeError('{} server(s) never advertised an end count — '
+                           'stream incomplete or killed server not yet '
+                           'restarted'.format(len(unadvertised)))
+    short = {sid: adv - counts.get(sid, 0)
+             for sid, adv in advertised.items() if counts.get(sid, 0) < adv}
+    if short:
+        raise RuntimeError(
+            'shared stream incomplete: {} advertised chunk(s) were never '
+            'received by any consumer'.format(sum(short.values())))
+    return {'received': sum(counts.values()),
+            'advertised': sum(advertised.values()),
+            'duplicates': dups}
+
+
 def _next_port_endpoint(endpoint, offset=1):
     """tcp endpoint with port + ``offset`` (control/rpc channel convention)."""
     if not endpoint.startswith('tcp://') or ':' not in endpoint[6:]:
@@ -869,5 +1377,14 @@ def _connectable(bound_endpoint):
         if bound_endpoint.startswith(wildcard):
             import socket
             port = bound_endpoint[len(wildcard):]
-            return 'tcp://{}:{}'.format(socket.gethostname(), port)
+            host = socket.gethostname()
+            try:
+                socket.gethostbyname(host)
+            except OSError:
+                # Containers without a DNS/hosts entry for their own
+                # hostname: an unresolvable advertisement would break even
+                # same-host clients — fall back to loopback (cross-host
+                # callers must then dial an explicit address).
+                host = '127.0.0.1'
+            return 'tcp://{}:{}'.format(host, port)
     return bound_endpoint

