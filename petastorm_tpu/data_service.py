@@ -79,10 +79,10 @@ snapshots are **pickle** — unpickling attacker-controlled bytes is
 arbitrary code execution. Run all three ports on a trusted network
 (loopback or a private cluster fabric) only. Defense in depth: pass a
 shared ``auth_key`` to both sides and every control message, rpc body,
-and chunk header is authenticated with keyed BLAKE2b *before* any
-unpickling (unauthenticated traffic is dropped/refused). The key
-authenticates; it does not encrypt — for untrusted networks add CurveZMQ
-or a TLS tunnel.
+and chunk (meta, header, AND payload buffers) is authenticated with
+keyed BLAKE2b *before* any unpickling (unauthenticated traffic is
+dropped/refused). The key authenticates; it does not encrypt — for
+untrusted networks add CurveZMQ or a TLS tunnel.
 """
 
 import hashlib
@@ -274,7 +274,11 @@ class DataServer(object):
         from collections import deque
         if replay_ring_chunks is None:
             replay_ring_chunks = sndhwm + 4
-        self._ring = deque(maxlen=replay_ring_chunks)
+        # maxlen=0 when snapshots are off: the ring pins chunk frames in
+        # memory and only ever feeds _write_snapshot — no reason to retain
+        # hundreds of MB of frames for a disabled feature.
+        self._ring = deque(
+            maxlen=replay_ring_chunks if snapshot_path is not None else 0)
         self._replay = []
         import uuid
         # END messages carry the server's identity: a client connected to N
@@ -379,11 +383,17 @@ class DataServer(object):
     def _send_chunk(self, seq, frames, count):
         """HWM-respecting send of ``[meta, header, buf...]``; returns False
         only when stopped mid-retry. The meta frame carries (server_id,
-        seq) — and, under ``auth_key``, a mac over the meta prefix and the
-        pickle header, so consumers authenticate before unpickling."""
+        seq) — and, under ``auth_key``, a mac over the meta prefix, the
+        pickle header, and every payload buffer, so consumers authenticate
+        the whole chunk before unpickling."""
         meta = _META_STRUCT.pack(self._server_id, seq)
         if self._auth_key is not None:
-            meta += _mac(self._auth_key, meta, frames[0])
+            # MAC the WHOLE chunk (meta prefix + header + every payload
+            # buffer): header-only coverage would let a peer replay a
+            # valid (meta, header) pair over substituted buffer bytes and
+            # feed corrupted tensors past verification. Costs one keyed-
+            # BLAKE2b pass over the payload (~GB/s) when auth is armed.
+            meta += _mac(self._auth_key, meta, *frames)
         parts = [meta] + frames
         while not self._stop.is_set():
             try:
@@ -821,10 +831,10 @@ class RemoteReader(object):
                 self._bad_auth_frames += 1
                 continue
             if self._auth_key is not None:
-                head = frames[1]
-                head = head.buffer if hasattr(head, 'buffer') else head
+                bufs = [f.buffer if hasattr(f, 'buffer') else f
+                        for f in frames[1:]]
                 if not _mac_ok(self._auth_key, meta[-_MAC_LEN:],
-                               meta[:_META_STRUCT.size], head):
+                               meta[:_META_STRUCT.size], *bufs):
                     self._bad_auth_frames += 1
                     continue
             sid, seq = _META_STRUCT.unpack_from(meta)
