@@ -21,8 +21,15 @@ demonstration (each tier is normally its own host):
   trainer from ``resume_state=state``, and together they deliver exactly
   the rows the first session had not consumed: no duplicates, no losses.
 
-Run: ``python examples/data_service/serve_and_train.py`` (any JAX
-backend; loopback tcp).
+``--demo crash`` runs the UNPLANNED-death variant instead: two real
+server subprocesses with self-snapshots armed
+(``serve_dataset(snapshot_path=...)``), one SIGKILLed mid-stream and
+restarted from its snapshot on the same endpoint — the trainer never
+restarts, dedupes the replay ring by ``(server_id, seq)``, and finishes
+the epoch with every row delivered exactly once.
+
+Run: ``python examples/data_service/serve_and_train.py [--demo crash]``
+(any JAX backend; loopback tcp).
 """
 
 import os
@@ -143,14 +150,100 @@ def run(dataset_url=None, batch=8, n_rows=96, n_servers=2, preempt_after=3):
     return losses, seen, len(svc_state['pending'])
 
 
+def _serve_subprocess(url, bind, snapshot_path, resume):
+    """Child entry for --demo crash: a real decode-tier process. Armed
+    with self-snapshots so a SIGKILL is recoverable; ``workers_count=1``
+    because crash recovery's seq dedupe needs chunk-deterministic resume
+    (see DataServer's snapshot_path doc)."""
+    import json
+
+    from petastorm_tpu.data_service import load_server_snapshot, serve_dataset
+
+    snapshot = load_server_snapshot(snapshot_path) if resume else None
+    server = serve_dataset(url, bind,
+                           snapshot_path=snapshot_path, snapshot_every=2,
+                           snapshot_resume=snapshot,
+                           num_epochs=1, seed=0, workers_count=1,
+                           shuffle_row_groups=False)
+    print(json.dumps({'data_endpoint': server.data_endpoint}), flush=True)
+    import time
+    while True:         # serve threads run until this process is killed
+        time.sleep(0.5)
+
+
+def run_crash_recovery(n_rows=192):
+    """Two server subprocesses, one SIGKILLed mid-stream and restarted
+    from its self-snapshot; the sole trainer rides through the crash.
+    (Chunk granularity comes from the store's ``rows_per_row_group``;
+    the child re-runs this file, whose module top already puts the repo
+    on ``sys.path``.)"""
+    import collections
+    import json
+    import subprocess
+    import tempfile
+
+    from petastorm_tpu.data_service import RemoteReader
+
+    url = 'file://' + tempfile.mkdtemp(prefix='svc_crash_ds_')
+    _write_store(url, n_rows)
+    workdir = tempfile.mkdtemp(prefix='svc_crash_')
+
+    def spawn(bind, snap, resume=False):
+        cmd = [sys.executable, os.path.abspath(__file__), '--_serve', url,
+               bind, snap] + (['--resume'] if resume else [])
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+        return proc, json.loads(proc.stdout.readline())
+
+    snaps = [os.path.join(workdir, 'a.pkl'), os.path.join(workdir, 'b.pkl')]
+    procs = []
+    try:
+        proc_a, info_a = spawn('tcp://127.0.0.1:*', snaps[0])
+        proc_b, info_b = spawn('tcp://127.0.0.1:*', snaps[1])
+        procs += [proc_a, proc_b]
+        seen = []
+        with RemoteReader([info_a['data_endpoint'], info_b['data_endpoint']],
+                          rcvhwm=1, end_grace_s=10.0) as remote:
+            for _ in range(4):                      # consume a little...
+                seen.extend(np.asarray(next(remote).sample_id).tolist())
+            proc_a.kill()                           # ...SIGKILL a server...
+            proc_a.wait()
+            proc_a2, _ = spawn(info_a['data_endpoint'], snaps[0],
+                               resume=True)         # ...restart from snapshot
+            procs.append(proc_a2)
+            for chunk in remote:                    # trainer never restarted
+                seen.extend(np.asarray(chunk.sample_id).tolist())
+            dups = remote.diagnostics['duplicate_chunks']
+        counts = collections.Counter(seen)
+        assert sorted(counts) == list(range(n_rows)), 'rows lost in crash'
+        assert set(counts.values()) == {2}, 'unexpected duplicate rows'
+        print('crash-recovery example: every one of {} rows delivered '
+              'exactly twice (once per server) across a SIGKILL; {} replayed '
+              'chunk(s) deduped by (server_id, seq)'.format(n_rows, dups))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
 def main():
+    if '--_serve' in sys.argv:      # crash-demo server subprocess
+        i = sys.argv.index('--_serve')
+        _serve_subprocess(sys.argv[i + 1], sys.argv[i + 2], sys.argv[i + 3],
+                          '--resume' in sys.argv)
+        return
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--dataset-url', default=None)
     parser.add_argument('--batch', type=int, default=8)
     parser.add_argument('--rows', type=int, default=96)
     parser.add_argument('--servers', type=int, default=2)
     parser.add_argument('--preempt-after', type=int, default=3)
+    parser.add_argument('--demo', choices=['preempt', 'crash'],
+                        default='preempt')
     args = parser.parse_args()
+    if args.demo == 'crash':
+        run_crash_recovery(n_rows=args.rows if args.rows != 96 else 192)
+        return
     run(dataset_url=args.dataset_url, batch=args.batch, n_rows=args.rows,
         n_servers=args.servers, preempt_after=args.preempt_after)
 

@@ -109,6 +109,10 @@ _MAC_LEN = 16
 def _mac(key, *parts):
     h = hashlib.blake2b(digest_size=_MAC_LEN, key=key)
     for p in parts:
+        # Length-framed: without it, moving bytes across a frame boundary
+        # keeps the concatenation (and so the MAC) identical while the
+        # chunk parses differently.
+        h.update(_COUNT_STRUCT.pack(len(p)))
         h.update(p)
     return h.digest()
 

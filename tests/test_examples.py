@@ -95,6 +95,16 @@ def test_data_service_example(tmp_path):
                         'snapshot happened at an idle boundary and proves nothing'
 
 
+@pytest.mark.slow
+def test_data_service_crash_example():
+    """The --demo crash variant: subprocess servers, SIGKILL + restart
+    from self-snapshot, trainer rides through — its own exactly-twice
+    assertions must hold."""
+    from examples.data_service.serve_and_train import run_crash_recovery
+
+    run_crash_recovery(n_rows=128)
+
+
 def test_preemptible_resume_example(tmp_path):
     from examples.preemptible.train_resume_example import run
 
