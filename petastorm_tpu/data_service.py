@@ -1250,8 +1250,15 @@ def checkpoint_shared_stream(readers, timeout_s=60.0):
     attribution is dynamic, so no single consumer can verify it drained
     its share).
 
-    Protocol — callers must make every consumer quiescent first (no
-    thread inside ``__next__`` during the call; pause the trainers):
+    Protocol — the only precondition is that no trainer CONSUMES batches
+    while this runs (a row delivered downstream mid-checkpoint would also
+    appear in the snapshot's replay set and arrive twice after resume).
+    Background prefetch pumps (``JaxLoader`` staging threads) may stay
+    live: receive, drain, and snapshot all share the reader's accounting
+    locks, and rows a pump moves from the backlog into its prefetch queue
+    remain in the replay set either way
+    (``test_shared_stream_checkpoint_through_loaders`` pins this).
+    The steps:
 
     1. pause every server once at a chunk boundary (rpc ``pause_state``),
        collecting its reader state, identity, and sent count;

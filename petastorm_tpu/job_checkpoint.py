@@ -163,9 +163,10 @@ def _encode_loader_state(loader_state):
     or neither) without a second artifact format."""
     if loader_state is None:
         return {}
-    if (isinstance(loader_state, dict) and 'pending' in loader_state
-            and 'server_states' in loader_state):
-        # The service snapshot shape — known non-JSON (and potentially
+    if (isinstance(loader_state, dict) and 'server_states' in loader_state
+            and ('pending' in loader_state or 'consumers' in loader_state)):
+        # The service snapshot shapes (sole-consumer state_dict or
+        # checkpoint_shared_stream) — known non-JSON (and potentially
         # megabytes of chunks): go straight to pickle, no throwaway probe.
         return _pickle_to_json(loader_state)
     import json

@@ -698,3 +698,74 @@ def test_server_sigkill_recovery(kill_dataset, tmp_path):
             if proc.poll() is None:
                 proc.kill()
                 proc.wait()
+
+
+def test_shared_stream_checkpoint_through_loaders(service_dataset):
+    """The full production stack for the north-star topology: TWO trainers,
+    each a JaxLoader (prefetch queue, row-granular accounting) over a
+    shared-stream RemoteReader, checkpoint via checkpoint_shared_stream
+    with the loader pumps live — prefetched-but-undelivered rows must
+    re-deliver on resume, and the union across both trainers is exactly
+    the dataset. batch == chunk size (8), so last_batch='drop' drops
+    nothing and the union check can be exact."""
+    from petastorm_tpu.data_service import checkpoint_shared_stream
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    def shard_server(shard, state=None):
+        return serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                             num_epochs=1, seed=0, workers_count=1,
+                             cur_shard=shard, shard_count=2, start=False,
+                             resume_state=state)
+
+    ids_before = []
+    with shard_server(0) as s1, shard_server(1) as s2:
+        endpoints = [s1.data_endpoint, s2.data_endpoint]
+        r1 = RemoteReader(endpoints, shared_stream=True)
+        r2 = RemoteReader(endpoints, shared_stream=True)
+        l1 = JaxLoader(r1, 8, last_batch='drop', prefetch=4)
+        l2 = JaxLoader(r2, 8, last_batch='drop', prefetch=4)
+        s1.start()
+        s2.start()
+        it1, it2 = iter(l1), iter(l2)
+        for it, n in ((it1, 2), (it2, 1)):
+            for _ in range(n):
+                batch = next(it)
+                ids_before.extend(int(i) for i in np.asarray(batch.sid))
+        state = checkpoint_shared_stream([r1, r2])
+        l1.stop()
+        l2.stop()
+    assert len(ids_before) == 24
+    ids_after = []
+    with shard_server(0, state['server_states'][0]) as s1b, \
+            shard_server(1, state['server_states'][1]) as s2b:
+        endpoints = [s1b.data_endpoint, s2b.data_endpoint]
+        r1b = RemoteReader(endpoints, shared_stream=True, end_grace_s=1.0,
+                           resume_state=state['consumers'][0])
+        r2b = RemoteReader(endpoints, shared_stream=True, end_grace_s=1.0,
+                           resume_state=state['consumers'][1])
+        l1b = JaxLoader(r1b, 8, last_batch='drop')
+        l2b = JaxLoader(r2b, 8, last_batch='drop')
+        s1b.start()
+        s2b.start()
+        for loader in (l1b, l2b):
+            with loader:
+                for batch in loader:
+                    ids_after.extend(int(i) for i in np.asarray(batch.sid))
+    all_ids = ids_before + ids_after
+    assert len(all_ids) == len(set(all_ids)), 'rows delivered twice'
+    assert sorted(all_ids) == list(range(N_ROWS)), 'rows lost'
+
+
+def test_shared_stream_state_in_job_checkpoint(tmp_path):
+    """checkpoint_shared_stream's state (numpy chunks inside 'consumers')
+    rides the JobCheckpointer composite like the sole-consumer shape."""
+    from petastorm_tpu.job_checkpoint import (_decode_loader_state,
+                                              _encode_loader_state)
+
+    state = {'server_states': [{'pos': 3}],
+             'consumers': [{'pending': [{'sid': np.arange(4)}]}]}
+    entry = _encode_loader_state(state)
+    back = _decode_loader_state(entry)
+    assert back['server_states'] == state['server_states']
+    np.testing.assert_array_equal(back['consumers'][0]['pending'][0]['sid'],
+                                  np.arange(4))
