@@ -12,7 +12,9 @@ __version__ = '0.1.0'
 
 from petastorm_tpu.converter import make_converter  # noqa: F401
 from petastorm_tpu.data_service import (DataServer, RemoteReader,  # noqa: F401
-                                        serve_dataset)
+                                        checkpoint_shared_stream,
+                                        load_server_snapshot, serve_dataset,
+                                        verify_shared_stream_complete)
 from petastorm_tpu.device_cache import DeviceDatasetCache  # noqa: F401
 from petastorm_tpu.job_checkpoint import JobCheckpointer  # noqa: F401
 from petastorm_tpu.reader import (Reader, make_batch_reader,  # noqa: F401

@@ -59,6 +59,7 @@ class MemoryCache(CacheBase):
         self._total = 0
         self._size_limit = size_limit_bytes
         self._lock = threading.Lock()
+        self._inflight = {}             # key -> Event (single-flight fills)
         self.hits = 0
         self.misses = 0
 
@@ -77,25 +78,58 @@ class MemoryCache(CacheBase):
             return 1024
 
     def get(self, key, fill_cache_func):
-        with self._lock:
-            entry = self._entries.get(key)
-            if entry is not None:
-                self._entries.move_to_end(key)
-                self.hits += 1
-                return entry[0]
-        value = fill_cache_func()
-        if value is None:
-            return None
-        nbytes = self._nbytes(value)
-        with self._lock:
-            self.misses += 1
-            if key not in self._entries:
-                self._entries[key] = (value, nbytes)
-                self._total += nbytes
-                if self._size_limit is not None:
-                    while self._total > self._size_limit and len(self._entries) > 1:
-                        _, (_, old_bytes) = self._entries.popitem(last=False)
-                        self._total -= old_bytes
+        # Single-flight per key: the ventilator dispatches the SAME row
+        # group for epoch N+1 while epoch N's decode of it may still be
+        # in flight, and two concurrent misses would both pay the decode
+        # (pure waste — on a 1-core host it directly steals throughput at
+        # every epoch boundary until the cache is warm). The second
+        # thread waits (GIL released) and reads the first one's entry.
+        while True:
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    return entry[0]
+                event = self._inflight.get(key)
+                if event is None:
+                    event = self._inflight[key] = threading.Event()
+                    break               # this thread does the fill
+            event.wait()
+            # Fill finished (or failed/returned None): re-check; on a
+            # still-absent entry the loop claims the fill for this thread.
+        value, filled = None, False
+        try:
+            value = fill_cache_func()
+            filled = True
+        finally:
+            try:
+                # Returned None IS cached (as (None, 0)): empty row-groups
+                # would otherwise never warm the cache and every epoch's
+                # duplicate dispatch would serialize behind a futile fill.
+                # A RAISING fill caches nothing — a transient read error
+                # must not become a permanently-served empty chunk.
+                if filled:
+                    nbytes = self._nbytes(value) if value is not None else 0
+                    with self._lock:
+                        self.misses += 1
+                        if key not in self._entries:
+                            self._entries[key] = (value, nbytes)
+                            self._total += nbytes
+                            if self._size_limit is not None:
+                                while (self._total > self._size_limit
+                                       and len(self._entries) > 1):
+                                    _, (_, old) = self._entries.popitem(
+                                        last=False)
+                                    self._total -= old
+            finally:
+                # Unconditionally un-register and wake waiters — a raise
+                # anywhere above (a value whose .nbytes property throws,
+                # the fill itself) must never leave an unset Event behind,
+                # or every future get() for this key deadlocks.
+                with self._lock:
+                    self._inflight.pop(key, None)
+                event.set()
         return value
 
     def cleanup(self):

@@ -103,6 +103,80 @@ def test_memory_cache_eviction():
     assert cache.get('e', lambda: pytest.fail('e should be cached')) is not None
 
 
+def test_memory_cache_single_flight():
+    """Concurrent misses on one key must run the fill exactly once — the
+    ventilator dispatches the same row group for the next epoch while the
+    previous epoch's decode may still be in flight, and a duplicated
+    decode steals real CPU on small hosts."""
+    import threading
+    import time
+
+    from petastorm_tpu.cache import MemoryCache
+
+    cache = MemoryCache()
+    fills = []
+
+    def slow_fill():
+        fills.append(threading.get_ident())
+        time.sleep(0.2)
+        return {'x': np.arange(8)}
+
+    results = []
+    threads = [threading.Thread(
+        target=lambda: results.append(cache.get('k', slow_fill)))
+        for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(fills) == 1, 'fill ran {} times for one key'.format(len(fills))
+    assert len(results) == 4
+    assert all(r is results[0] for r in results), 'waiters must share the entry'
+
+
+def test_memory_cache_caches_none_fills():
+    """A fill returning None (empty row-group) is cached as a negative
+    entry — later epochs must not re-pay the futile read — while a
+    RAISING fill caches nothing."""
+    from petastorm_tpu.cache import MemoryCache
+
+    cache = MemoryCache()
+    calls = []
+
+    def none_fill():
+        calls.append(1)
+        return None
+
+    assert cache.get('empty', none_fill) is None
+    assert cache.get('empty', none_fill) is None
+    assert len(calls) == 1, 'None fill must be cached, not re-run'
+
+
+def test_memory_cache_failed_fill_releases_waiters():
+    """A raising fill must not deadlock waiters: one of them re-claims."""
+    import threading
+
+    from petastorm_tpu.cache import MemoryCache
+
+    cache = MemoryCache()
+    calls = []
+
+    def fill_fail_then_ok():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError('first fill fails')
+        return {'x': 1}
+
+    with pytest.raises(RuntimeError):
+        cache.get('k', fill_fail_then_ok)
+    got = []
+    t = threading.Thread(target=lambda: got.append(cache.get('k', fill_fail_then_ok)))
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert got and got[0]['x'] == 1 and len(calls) == 2
+
+
 def test_transform_spec_on_blocks(synthetic_dataset):
     from petastorm_tpu.transform import TransformSpec
 
