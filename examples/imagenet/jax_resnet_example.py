@@ -30,7 +30,7 @@ from petastorm_tpu.parallel import make_mesh, process_shard
 
 
 def train(dataset_url, global_batch=256, steps=100, image_size=224,
-          model_parallel=1, log_every=10):
+          model_parallel=1, log_every=10, augment=False):
     n_devices = len(jax.devices())
     mesh = make_mesh({'data': n_devices // model_parallel, 'model': model_parallel})
     cur_shard, shard_count = process_shard()
@@ -39,9 +39,47 @@ def train(dataset_url, global_batch=256, steps=100, image_size=224,
     state = create_train_state(jax.random.PRNGKey(0), model,
                                (1, image_size, image_size, 3), mesh=mesh,
                                learning_rate=0.1)
-    train_step = make_train_step(mesh=mesh)
+    if augment:
+        # Full Inception recipe ON DEVICE (random resized crop, flip,
+        # color jitter, normalize): the host ships raw uint8 and XLA fuses
+        # the augmentation into the first conv's input — a host-side
+        # TransformSpec would pay CPU for every augmented byte and ship
+        # 4x the h2d traffic as float32. Compose the UN-jitted step body
+        # (make_train_step_fn) under one jit — wrapping the jitted
+        # make_train_step would nest donation and forfeit the buffer.
+        import functools
 
-    crop = CropTo((image_size, image_size, 3))
+        from petastorm_tpu.models.train import make_train_step_fn
+        from petastorm_tpu.ops.augment import imagenet_train_augment
+
+        step_fn = make_train_step_fn(mesh=mesh)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def train_step(state, images_u8, labels, key):
+            images = imagenet_train_augment(images_u8, key,
+                                            out_h=image_size,
+                                            out_w=image_size)
+            return step_fn(state, images, labels)
+
+        aug_key = jax.random.PRNGKey(42)
+    else:
+        inner_step = make_train_step(mesh=mesh)
+
+        def train_step(state, images_u8, labels, key):
+            del key
+            return inner_step(state, images_u8.astype('float32') / 255.0,
+                              labels)
+
+        aug_key = None
+
+    # Augment mode stages a LARGER canvas (the classic 256/224 ratio) so
+    # the device-side random resized crop has spatial room to sample —
+    # center-cropping straight to image_size first would confine the
+    # "random" crop to one fixed window. True full-image diversity on
+    # ragged stores would need per-sample host resize; the 8/7 canvas is
+    # the standard approximation (stored images must be at least that big).
+    canvas = image_size * 8 // 7 if augment else image_size
+    crop = CropTo((canvas, canvas, 3))
     step = 0
     times = []
     with make_reader(dataset_url, schema_fields=['image', 'label'],
@@ -53,8 +91,10 @@ def train(dataset_url, global_batch=256, steps=100, image_size=224,
             # time whole iterations (fetch + step) so input stall shows up
             prev = time.perf_counter()
             for batch in loader:
+                key = (jax.random.fold_in(aug_key, step)
+                       if aug_key is not None else None)
                 state, metrics = train_step(
-                    state, batch.image.astype('float32') / 255.0, batch.label)
+                    state, batch.image, batch.label, key)
                 jax.block_until_ready(metrics['loss'])
                 now = time.perf_counter()
                 times.append(now - prev)
@@ -76,6 +116,9 @@ if __name__ == '__main__':
     parser.add_argument('--steps', type=int, default=100)
     parser.add_argument('--image-size', type=int, default=224)
     parser.add_argument('--model-parallel', type=int, default=1)
+    parser.add_argument('--augment', action='store_true',
+                        help='full on-device Inception augmentation '
+                             '(random resized crop, flip, color jitter)')
     args = parser.parse_args()
     train(args.dataset_url, args.global_batch, args.steps, args.image_size,
-          args.model_parallel)
+          args.model_parallel, augment=args.augment)

@@ -1,7 +1,9 @@
 """On-device input-path ops (Pallas TPU kernels with XLA fallbacks)."""
 
-from petastorm_tpu.ops.augment import (random_crop,  # noqa: F401
-                                       random_flip, train_augment)
+from petastorm_tpu.ops.augment import (color_jitter,  # noqa: F401
+                                       imagenet_train_augment, random_crop,
+                                       random_flip, random_resized_crop,
+                                       train_augment)
 from petastorm_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from petastorm_tpu.ops.image_ops import (normalize_images,  # noqa: F401
                                          normalize_images_reference,

@@ -44,6 +44,107 @@ def random_flip(images, key):
     return jnp.where(flips[:, None, None, None], flipped, images)
 
 
+def random_resized_crop(images, key, out_h, out_w, scale=(0.08, 1.0),
+                        ratio=(3.0 / 4.0, 4.0 / 3.0)):
+    """Inception-style random resized crop:
+    ``[N, H, W, C] -> [N, out_h, out_w, C]`` (float32).
+
+    Per sample: area fraction ~ U(scale), aspect ~ exp(U(log ratio)); the
+    crop box (clamped inside the image) is resampled to ``(out_h, out_w)``
+    with a bilinear ``jax.image.scale_and_translate`` — one fused
+    gather/matmul pipeline per sample, vmapped over the batch, static
+    shapes throughout (the reference's torchvision-transform equivalent
+    runs per-row on host CPU; here the MXU-adjacent resample costs the
+    host nothing).
+    """
+    import numpy as np
+
+    n, h, w, _ = images.shape
+    k_area, k_ratio, k_y, k_x = jax.random.split(key, 4)
+    area = jax.random.uniform(k_area, (n,), minval=scale[0], maxval=scale[1])
+    log_r = jax.random.uniform(k_ratio, (n,),
+                               minval=float(np.log(ratio[0])),
+                               maxval=float(np.log(ratio[1])))
+    aspect = jnp.exp(log_r)
+    # Box solving area = ch*cw, aspect = cw/ch; clamp inside the image.
+    ch = jnp.sqrt(area * h * w / aspect)
+    cw = ch * aspect
+    ch = jnp.clip(ch, 1.0, h)
+    cw = jnp.clip(cw, 1.0, w)
+    oy = jax.random.uniform(k_y, (n,)) * (h - ch)
+    ox = jax.random.uniform(k_x, (n,)) * (w - cw)
+    scale_y = out_h / ch
+    scale_x = out_w / cw
+
+    def resample_one(img, sy, sx, ty, tx):
+        return jax.image.scale_and_translate(
+            img.astype(jnp.float32), (out_h, out_w, img.shape[-1]),
+            (0, 1), jnp.stack([sy, sx]),
+            jnp.stack([-ty * sy, -tx * sx]), method='linear')
+
+    return jax.vmap(resample_one)(images, scale_y, scale_x, oy, ox)
+
+
+def color_jitter(images, key, brightness=0.4, contrast=0.4, saturation=0.4,
+                 max_value=255.0):
+    """Per-sample brightness/contrast/saturation jitter on float images
+    ``[N, H, W, 3]`` in the ``[0, max_value]`` domain (applied in that
+    fixed order; pure elementwise + per-image means, so XLA fuses the
+    whole thing into neighboring ops).
+
+    Factors are ``1 + U(-x, x)`` per sample; pass 0 to disable a term.
+    Each stage clamps back to ``[0, max_value]`` — torchvision's
+    ColorJitter does the same (in its [0, 1] domain), and unclamped
+    brightness/contrast would otherwise push pixels negative or past the
+    white point, shifting the input distribution the recipe promises.
+    """
+    n = images.shape[0]
+    k_b, k_c, k_s = jax.random.split(key, 3)
+    out = images.astype(jnp.float32)
+    if brightness:
+        f = 1.0 + jax.random.uniform(k_b, (n, 1, 1, 1),
+                                     minval=-brightness, maxval=brightness)
+        out = jnp.clip(out * f, 0.0, max_value)
+    if contrast:
+        f = 1.0 + jax.random.uniform(k_c, (n, 1, 1, 1),
+                                     minval=-contrast, maxval=contrast)
+        mean = out.mean(axis=(1, 2, 3), keepdims=True)
+        out = jnp.clip((out - mean) * f + mean, 0.0, max_value)
+    if saturation:
+        f = 1.0 + jax.random.uniform(k_s, (n, 1, 1, 1),
+                                     minval=-saturation, maxval=saturation)
+        gray = (out * jnp.array([0.299, 0.587, 0.114])).sum(
+            axis=-1, keepdims=True)
+        out = jnp.clip(gray + (out - gray) * f, 0.0, max_value)
+    return out
+
+
+def imagenet_train_augment(images_u8, key, out_h=224, out_w=224,
+                           jitter=0.4, dtype=jnp.bfloat16):
+    """The full Inception/ResNet train recipe, fused on device: random
+    resized crop -> horizontal flip -> color jitter -> normalize. uint8
+    ``[N, H, W, 3]`` in, ``dtype`` ``[N, out_h, out_w, 3]`` out.
+
+    The key must vary per step — fold the step counter on the host
+    (``jax.random.fold_in(base, step)``; key arrays don't retrigger
+    tracing) and pass it into your jitted step alongside the batch, as
+    ``examples/imagenet --augment`` does. Don't bake a key into a
+    closure handed to ``make_scan_train_step(preprocess=...)``:
+    preprocess receives only the images, so a closed-over key is traced
+    as a constant and every microbatch reuses the identical augmentation.
+    """
+    from petastorm_tpu.ops.image_ops import normalize_images_reference
+
+    k_crop, k_flip, k_jit = jax.random.split(key, 3)
+    out = random_resized_crop(images_u8, k_crop, out_h, out_w)
+    out = random_flip(out, k_flip)
+    if jitter:
+        out = color_jitter(out, k_jit, jitter, jitter, jitter)
+    # normalize_images_reference divides by 255 itself; the jitter output
+    # is float in [0, 255], which it handles identically to uint8.
+    return normalize_images_reference(out, dtype=dtype)
+
+
 def train_augment(images_u8, key, crop_h, crop_w, flip=True,
                   normalize=True, dtype=jnp.bfloat16):
     """The standard ImageNet train-time augmentation, fused on device.

@@ -37,6 +37,10 @@ def test_imagenet_generate_and_one_step(tmp_path):
     # Tiny config: 8-device mesh, 1 step, 32x32 crop
     state = train(url, global_batch=16, steps=1, image_size=32, log_every=1)
     assert state.step == 1
+    # With the full on-device augmentation recipe compiled into the step.
+    state = train(url, global_batch=16, steps=1, image_size=32, log_every=1,
+                  augment=True)
+    assert state.step == 1
 
 
 def test_external_dataset_example(tmp_path, monkeypatch, capsys):

@@ -107,6 +107,79 @@ class TestAugment:
         assert out.dtype == jnp.bfloat16
         assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
 
+    def test_random_resized_crop_shapes_and_determinism(self):
+        from petastorm_tpu.ops.augment import random_resized_crop
+        imgs = self._images(n=6, h=20, w=16)
+        a = random_resized_crop(imgs, jax.random.PRNGKey(5), 8, 8)
+        b = random_resized_crop(imgs, jax.random.PRNGKey(5), 8, 8)
+        assert a.shape == (6, 8, 8, 3)
+        assert a.dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # Different keys draw different boxes for at least one sample.
+        c = random_resized_crop(imgs, jax.random.PRNGKey(6), 8, 8)
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+        # Bilinear resampling of a crop can never leave the source range.
+        assert float(a.min()) >= 0.0 and float(a.max()) <= 255.0
+
+    def test_random_resized_crop_full_box_identity(self):
+        """scale=(1,1), ratio=(1,1) on a square image selects the whole
+        image; resampling to the same size must reproduce it (bilinear is
+        exact at integer alignment)."""
+        from petastorm_tpu.ops.augment import random_resized_crop
+        imgs = self._images(n=3, h=10, w=10)
+        out = random_resized_crop(imgs, jax.random.PRNGKey(0), 10, 10,
+                                  scale=(1.0, 1.0), ratio=(1.0, 1.0))
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(imgs, dtype=np.float32),
+                                   atol=1e-3)
+
+    def test_color_jitter_properties(self):
+        from petastorm_tpu.ops.augment import color_jitter
+        imgs = self._images(n=8).astype(jnp.float32)
+        out = color_jitter(imgs, jax.random.PRNGKey(1))
+        assert out.shape == imgs.shape
+        # Contrast preserves the per-image mean when brightness/saturation
+        # are disabled (mean is the fixed point of the contrast affine) —
+        # on values far from 0/255, where the torchvision-style clamp
+        # never engages.
+        mid = jnp.asarray(np.random.default_rng(0).uniform(
+            100.0, 150.0, (4, 6, 6, 3)).astype(np.float32))
+        co = color_jitter(mid, jax.random.PRNGKey(2),
+                          brightness=0.0, contrast=0.5, saturation=0.0)
+        np.testing.assert_allclose(
+            np.asarray(co.mean(axis=(1, 2, 3))),
+            np.asarray(mid.mean(axis=(1, 2, 3))), rtol=1e-5)
+        # The clamp itself: extreme brightness cannot escape [0, 255].
+        hot = color_jitter(imgs, jax.random.PRNGKey(9),
+                           brightness=0.9, contrast=0.0, saturation=0.0)
+        assert float(hot.max()) <= 255.0 and float(hot.min()) >= 0.0
+        # Saturation toward gray: factor range (0,2); gray image unchanged.
+        gray = jnp.ones((2, 4, 4, 3), jnp.float32) * 100.0
+        go = color_jitter(gray, jax.random.PRNGKey(3),
+                          brightness=0.0, contrast=0.0, saturation=0.9)
+        np.testing.assert_allclose(np.asarray(go), np.asarray(gray),
+                                   rtol=1e-5)
+        # Disabled == identity.
+        ident = color_jitter(imgs, jax.random.PRNGKey(4), 0.0, 0.0, 0.0)
+        np.testing.assert_array_equal(np.asarray(ident), np.asarray(imgs))
+
+    def test_imagenet_train_augment_jits(self):
+        from petastorm_tpu.ops.augment import imagenet_train_augment
+        imgs = self._images(n=4, h=32, w=28)
+
+        @jax.jit
+        def step(x, key):
+            return imagenet_train_augment(x, key, out_h=16, out_w=16)
+
+        out = step(imgs, jax.random.PRNGKey(0))
+        assert out.shape == (4, 16, 16, 3)
+        assert out.dtype == jnp.bfloat16
+        assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+        # Per-step fold_in keys give different augmentations, same shapes.
+        out2 = step(imgs, jax.random.fold_in(jax.random.PRNGKey(0), 1))
+        assert not np.array_equal(np.asarray(out, dtype=np.float32),
+                                  np.asarray(out2, dtype=np.float32))
+
     def test_crop_too_large_raises(self):
         from petastorm_tpu.ops.augment import random_crop
         with pytest.raises(ValueError, match='exceeds'):
