@@ -1,6 +1,7 @@
 """On-device input-path ops (Pallas TPU kernels with XLA fallbacks)."""
 
 from petastorm_tpu.ops.augment import (color_jitter,  # noqa: F401
+                                       imagenet_eval_preprocess,
                                        imagenet_train_augment, random_crop,
                                        random_flip, random_resized_crop,
                                        train_augment)

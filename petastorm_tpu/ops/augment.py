@@ -13,6 +13,8 @@ control flow), so they compile once and shard over the batch axis like any
 other per-sample op.
 """
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -57,14 +59,12 @@ def random_resized_crop(images, key, out_h, out_w, scale=(0.08, 1.0),
     runs per-row on host CPU; here the MXU-adjacent resample costs the
     host nothing).
     """
-    import numpy as np
-
     n, h, w, _ = images.shape
     k_area, k_ratio, k_y, k_x = jax.random.split(key, 4)
     area = jax.random.uniform(k_area, (n,), minval=scale[0], maxval=scale[1])
     log_r = jax.random.uniform(k_ratio, (n,),
-                               minval=float(np.log(ratio[0])),
-                               maxval=float(np.log(ratio[1])))
+                               minval=math.log(ratio[0]),
+                               maxval=math.log(ratio[1]))
     aspect = jnp.exp(log_r)
     # Box solving area = ch*cw, aspect = cw/ch; clamp inside the image.
     ch = jnp.sqrt(area * h * w / aspect)
@@ -133,16 +133,60 @@ def imagenet_train_augment(images_u8, key, out_h=224, out_w=224,
     preprocess receives only the images, so a closed-over key is traced
     as a constant and every microbatch reuses the identical augmentation.
     """
-    from petastorm_tpu.ops.image_ops import normalize_images_reference
+    from petastorm_tpu.ops.image_ops import normalize_images
 
     k_crop, k_flip, k_jit = jax.random.split(key, 3)
     out = random_resized_crop(images_u8, k_crop, out_h, out_w)
     out = random_flip(out, k_flip)
     if jitter:
         out = color_jitter(out, k_jit, jitter, jitter, jitter)
-    # normalize_images_reference divides by 255 itself; the jitter output
-    # is float in [0, 255], which it handles identically to uint8.
-    return normalize_images_reference(out, dtype=dtype)
+    # normalize_images divides by 255 itself (float [0, 255] input is
+    # handled identically to uint8) and auto-selects the fused Pallas
+    # kernel on TPU.
+    return normalize_images(out, dtype=dtype)
+
+
+def imagenet_eval_preprocess(images_u8, out_h=224, out_w=224,
+                             resize_ratio=256.0 / 224.0,
+                             dtype=jnp.bfloat16):
+    """The deterministic eval-side counterpart of
+    :func:`imagenet_train_augment`: resize so the target is a centered
+    ``1/resize_ratio`` fraction (the classic resize-256 / center-crop-224
+    pipeline), then normalize. ``[N, H, W, 3]`` uint8 in,
+    ``dtype`` ``[N, out_h, out_w, 3]`` out; no randomness, no key.
+
+    Implemented as one ``scale_and_translate`` per sample (resize and
+    center-crop fused into a single resample — never materializes the
+    intermediate 256x256 image).
+    """
+    from petastorm_tpu.ops.image_ops import normalize_images
+
+    n, h, w, _ = images_u8.shape
+    # The source crop box equivalent to resize-shorter-side-then-center-
+    # crop: out_h px at (shorter/resized) source-px-per-output-px, so a
+    # box keyed off the SHORTER side, centered, with the output's aspect.
+    shorter = min(h, w)
+    ch = out_h * shorter / (resize_ratio * min(out_h, out_w))
+    cw = out_w * shorter / (resize_ratio * min(out_h, out_w))
+    if ch > h or cw > w:
+        # scale_and_translate would silently sample zeros outside the
+        # image (black bars after normalization) — refuse instead.
+        raise ValueError(
+            'eval crop box {:.0f}x{:.0f} exceeds the {}x{} source: the '
+            'output aspect {}x{} is too far from the source aspect for '
+            'resize_ratio={} (crop to a squarer output, or lower the '
+            'ratio)'.format(ch, cw, h, w, out_h, out_w, resize_ratio))
+    oy, ox = (h - ch) / 2.0, (w - cw) / 2.0
+    sy, sx = out_h / ch, out_w / cw
+
+    def resample_one(img):
+        return jax.image.scale_and_translate(
+            img.astype(jnp.float32), (out_h, out_w, img.shape[-1]),
+            (0, 1), jnp.array([sy, sx]),
+            jnp.array([-oy * sy, -ox * sx]), method='linear')
+
+    out = jax.vmap(resample_one)(images_u8)
+    return normalize_images(out, dtype=dtype)
 
 
 def train_augment(images_u8, key, crop_h, crop_w, flip=True,

@@ -180,6 +180,32 @@ class TestAugment:
         assert not np.array_equal(np.asarray(out, dtype=np.float32),
                                   np.asarray(out2, dtype=np.float32))
 
+    def test_imagenet_eval_preprocess(self):
+        from petastorm_tpu.ops.augment import imagenet_eval_preprocess
+        imgs = self._images(n=3, h=40, w=32)
+
+        out = jax.jit(lambda x: imagenet_eval_preprocess(x, 16, 16))(imgs)
+        assert out.shape == (3, 16, 16, 3)
+        assert out.dtype == jnp.bfloat16
+        # Deterministic: identical (equally-compiled) calls agree
+        # bitwise; jit-vs-eager may differ by an ulp from fusion.
+        out2 = jax.jit(lambda x: imagenet_eval_preprocess(x, 16, 16))(imgs)
+        np.testing.assert_array_equal(
+            np.asarray(out, np.float32), np.asarray(out2, np.float32))
+        # resize_ratio=1 on a square source selects the whole image; at
+        # identical output size that is the identity (then normalized).
+        from petastorm_tpu.ops.image_ops import normalize_images_reference
+        sq = self._images(n=2, h=12, w=12)
+        got = imagenet_eval_preprocess(sq, 12, 12, resize_ratio=1.0,
+                                       dtype=jnp.float32)
+        want = normalize_images_reference(sq, dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-3)
+        # An output aspect the source cannot cover must refuse loudly
+        # (scale_and_translate would silently pad black bars).
+        with pytest.raises(ValueError, match='exceeds'):
+            imagenet_eval_preprocess(self._images(n=2, h=30, w=30), 22, 32)
+
     def test_crop_too_large_raises(self):
         from petastorm_tpu.ops.augment import random_crop
         with pytest.raises(ValueError, match='exceeds'):
