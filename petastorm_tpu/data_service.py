@@ -156,9 +156,10 @@ class DataServer(object):
     :param sndhwm: per-consumer high-water mark (chunks buffered in zmq
         before the server blocks — the service's backpressure).
     :param auth_key: optional shared secret (bytes). When set, control
-        broadcasts, rpc traffic, and chunk headers carry a keyed-BLAKE2b
-        mac, verified BEFORE unpickling (see the module trust-boundary
-        note). Consumers must pass the same key.
+        broadcasts, rpc traffic, and whole chunks (meta, header, and
+        payload buffers) carry a keyed-BLAKE2b mac, verified BEFORE
+        unpickling (see the module trust-boundary note). Consumers must
+        pass the same key.
     :param snapshot_path: when set, the server self-snapshots to this
         path (atomically) every ``snapshot_every`` chunks: reader
         position + identity + a replay ring of recent chunk frames, so
