@@ -146,6 +146,63 @@ def imagenet_train_augment(images_u8, key, out_h=224, out_w=224,
     return normalize_images(out, dtype=dtype)
 
 
+def mixup(images, labels_onehot, key, alpha=0.2):
+    """Batch mixup (Zhang et al. 2017): convex-combine each sample with a
+    permuted partner, one Beta(alpha, alpha) lambda per batch (the
+    standard recipe). Labels must be soft (one-hot / probabilities) —
+    pair with a soft-target cross entropy, not the integer-label loss.
+
+    Returns ``(mixed_images, mixed_labels)``; float images in, any
+    ``[N, ...]`` layout.
+    """
+    k_lam, k_perm = jax.random.split(key)
+    lam = jax.random.beta(k_lam, alpha, alpha)
+    perm = jax.random.permutation(k_perm, images.shape[0])
+    # Blend in the images' own dtype: a float32 lam would silently
+    # promote a bf16 pipeline's activations (cutmix's where() keeps the
+    # dtype, and the two must be drop-in swappable).
+    lam_i = lam.astype(images.dtype)
+    mixed_images = lam_i * images + (1 - lam_i) * images[perm]
+    mixed_labels = lam * labels_onehot + (1.0 - lam) * labels_onehot[perm]
+    return mixed_images, mixed_labels
+
+
+def cutmix(images, labels_onehot, key, alpha=1.0):
+    """Batch CutMix (Yun et al. 2019): paste a random box from a permuted
+    partner into each image; labels mix by the pasted-area fraction. One
+    Beta(alpha, alpha) lambda per batch; the box is realized as an
+    iota-comparison mask (static shapes, no dynamic slicing), so the op
+    jits and shards like any elementwise op.
+
+    ``[N, H, W, C]`` float images in; labels soft, as in :func:`mixup`.
+    """
+    n, h, w, _ = images.shape
+    k_lam, k_y, k_x, k_perm = jax.random.split(key, 4)
+    lam = jax.random.beta(k_lam, alpha, alpha)
+    # Box with area (1-lam), centered at a uniform point, clipped — the
+    # paper's construction; the realized area replaces lam for labels.
+    cut = jnp.sqrt(1.0 - lam)
+    bh, bw = cut * h, cut * w
+    cy = jax.random.uniform(k_y) * h
+    cx = jax.random.uniform(k_x) * w
+    # Integer pixel edges, so the label fraction below equals the pixel
+    # count of the mask exactly (a continuous area would drift from the
+    # discretized box on small images).
+    y0 = jnp.floor(jnp.clip(cy - bh / 2.0, 0, h))
+    y1 = jnp.floor(jnp.clip(cy + bh / 2.0, 0, h))
+    x0 = jnp.floor(jnp.clip(cx - bw / 2.0, 0, w))
+    x1 = jnp.floor(jnp.clip(cx + bw / 2.0, 0, w))
+    ys = jnp.arange(h, dtype=jnp.float32)[:, None]
+    xs = jnp.arange(w, dtype=jnp.float32)[None, :]
+    inside = ((ys >= y0) & (ys < y1) & (xs >= x0) & (xs < x1))
+    perm = jax.random.permutation(k_perm, n)
+    mixed = jnp.where(inside[None, :, :, None], images[perm], images)
+    area = (y1 - y0) * (x1 - x0) / (h * w)
+    lam_real = 1.0 - area
+    mixed_labels = lam_real * labels_onehot + (1.0 - lam_real) * labels_onehot[perm]
+    return mixed, mixed_labels
+
+
 def imagenet_eval_preprocess(images_u8, out_h=224, out_w=224,
                              resize_ratio=256.0 / 224.0,
                              dtype=jnp.bfloat16):

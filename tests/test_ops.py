@@ -206,6 +206,57 @@ class TestAugment:
         with pytest.raises(ValueError, match='exceeds'):
             imagenet_eval_preprocess(self._images(n=2, h=30, w=30), 22, 32)
 
+    def test_mixup_properties(self):
+        from petastorm_tpu.ops.augment import mixup
+        rng = np.random.default_rng(0)
+        imgs = jnp.asarray(rng.uniform(0, 1, (8, 6, 6, 3)).astype(np.float32))
+        labels = jax.nn.one_hot(jnp.arange(8) % 4, 4)
+        mi, ml = jax.jit(lambda i, l, k: mixup(i, l, k))(
+            imgs, labels, jax.random.PRNGKey(0))
+        assert mi.shape == imgs.shape and ml.shape == labels.shape
+        # Labels stay a probability distribution.
+        np.testing.assert_allclose(np.asarray(ml.sum(-1)), 1.0, rtol=1e-5)
+        # Pixel means are preserved batch-wide up to the permutation
+        # (convex combination of a multiset with its permutation).
+        np.testing.assert_allclose(float(mi.mean()), float(imgs.mean()),
+                                   rtol=1e-5)
+        # dtype preserved: a bf16 pipeline must stay bf16 through mixup.
+        bi, _ = mixup(imgs.astype(jnp.bfloat16), labels,
+                      jax.random.PRNGKey(1))
+        assert bi.dtype == jnp.bfloat16
+
+    def test_cutmix_properties(self):
+        from petastorm_tpu.ops.augment import cutmix
+        rng = np.random.default_rng(1)
+        imgs = jnp.asarray(rng.uniform(0, 1, (6, 8, 8, 3)).astype(np.float32))
+        labels = jax.nn.one_hot(jnp.arange(6) % 3, 3)
+        mi, ml = jax.jit(lambda i, l, k: cutmix(i, l, k))(
+            imgs, labels, jax.random.PRNGKey(2))
+        assert mi.shape == imgs.shape and ml.shape == labels.shape
+        np.testing.assert_allclose(np.asarray(ml.sum(-1)), 1.0, rtol=1e-5)
+        # Every output pixel comes verbatim from one of the two sources.
+        src = np.asarray(imgs)
+        out = np.asarray(mi)
+        pasted_fracs = []
+        for i in range(6):
+            from_self = np.isclose(out[i], src[i]).all(axis=-1)
+            pasted = ~from_self
+            pasted_fracs.append(pasted.mean())
+            # every pasted pixel must come verbatim from SOME sample
+            for y, x in zip(*np.nonzero(pasted)):
+                assert any(np.allclose(out[i, y, x], src[j, y, x])
+                           for j in range(6)), 'pasted pixel from nowhere'
+        # The box is shared batch-wide (a permutation fixed point pastes
+        # onto itself and shows zero): the label mix must use the box
+        # fraction, and un-mixing it must recover one-hot partner rows.
+        box_frac = max(pasted_fracs)
+        if box_frac > 1e-6:
+            lam_real = 1.0 - box_frac
+            recon = (np.asarray(ml) - lam_real * np.asarray(labels)) / box_frac
+            np.testing.assert_allclose(recon.sum(-1), 1.0, atol=1e-4)
+            assert np.allclose(np.sort(recon, axis=-1)[:, :-1], 0.0,
+                               atol=1e-4), 'un-mixed labels are not one-hot'
+
     def test_crop_too_large_raises(self):
         from petastorm_tpu.ops.augment import random_crop
         with pytest.raises(ValueError, match='exceeds'):
