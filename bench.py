@@ -367,10 +367,30 @@ def _child_imagenet(url, workers):
     # compute and the next group's transfers.
     fence = os.environ.get('BENCH_IMAGENET_FENCE') == '1'
 
-    def normalize(images_u8):
-        # uint8 -> float inside the compiled body: transfers ride h2d as
-        # uint8 (4x less tunnel traffic) and the cast fuses into conv 1.
-        return images_u8.astype(jnp.float32) / 255.0
+    aug = os.environ.get('BENCH_IMAGENET_AUG') == '1'
+    if aug:
+        # Measure the fused on-device Inception augmentation instead of
+        # the bare cast. The key is derived ON DEVICE from the batch's
+        # first pixel: a constant key would let XLA constant-fold the RNG
+        # and resample coefficients and overstate throughput, while a
+        # data-derived key keeps every step's threefry/crop/flip math in
+        # the compiled program — the same per-step cost shape as real
+        # training's fold_in (never use this for actual training:
+        # augmentation must not correlate with the data).
+        from petastorm_tpu.ops.augment import imagenet_train_augment
+
+        def normalize(images_u8):
+            seed = images_u8[0, 0, 0, 0].astype(jnp.uint32)
+            return imagenet_train_augment(images_u8, jax.random.PRNGKey(seed),
+                                          out_h=_IMAGE_SIZE,
+                                          out_w=_IMAGE_SIZE,
+                                          dtype=jnp.float32)
+    else:
+        def normalize(images_u8):
+            # uint8 -> float inside the compiled body: transfers ride h2d
+            # as uint8 (4x less tunnel traffic) and the cast fuses into
+            # conv 1.
+            return images_u8.astype(jnp.float32) / 255.0
 
     if scan_k > 1:
         train_step = make_scan_train_step(mesh=mesh, microbatches=scan_k,
@@ -406,6 +426,7 @@ def _child_imagenet(url, workers):
         'measure_steps': measure_iters * scan_k,
         'native_parquet': os.environ.get('PETASTORM_TPU_NATIVE_PARQUET', 'auto'),
         'native_image': not os.environ.get('PETASTORM_TPU_NO_NATIVE'),
+        'on_device_augment': aug,
     }
     reader = make_tensor_reader(url, schema_fields=['image', 'label'],
                                 reader_pool_type='thread', workers_count=workers,
