@@ -266,6 +266,7 @@ class DataServer(object):
         self._pause_gen = 0
         self._paused_gen = 0
         self._served_chunks = 0
+        self._last_snapshot = (None, None)      # (sent, monotonic time)
         self._auth_key = auth_key
         self._snapshot_path = snapshot_path
         self._snapshot_every = max(1, int(snapshot_every))
@@ -429,6 +430,7 @@ class DataServer(object):
         with open(tmp, 'wb') as f:
             pickle.dump(snapshot, f, protocol=5)
         os.replace(tmp, self._snapshot_path)
+        self._last_snapshot = (self._served_chunks, time.monotonic())
 
     def _rpc_loop(self):
         """Answer checkpoint/stats requests (REP socket, one at a time)."""
@@ -505,9 +507,18 @@ class DataServer(object):
             self._pause.clear()
             return {'ok': True}
         if cmd == 'stats':
+            # snapshot_lag/age let an orchestrator confirm crash-recovery
+            # readiness (a stale snapshot means a wide replay window).
+            snap_sent, snap_at = self._last_snapshot
             return {'server_id': self._server_id,
                     'sent': self._served_chunks,
-                    'done': self._serving_done.is_set()}
+                    'done': self._serving_done.is_set(),
+                    'snapshot_lag_chunks': (
+                        self._served_chunks - snap_sent
+                        if snap_sent is not None else None),
+                    'snapshot_age_s': (
+                        round(time.monotonic() - snap_at, 3)
+                        if snap_at is not None else None)}
         if cmd == 'schema':
             # Lets trainer-side framework adapters (pytorch.DataLoader,
             # tf_utils.make_petastorm_dataset) see the stream's schema

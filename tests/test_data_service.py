@@ -376,6 +376,21 @@ def test_stats_rpc(service_dataset):
                                          {'cmd': 'stats'})
     assert sorted(ids) == list(range(N_ROWS))
     assert stats['done'] and stats['sent'] == server.served_chunks
+    assert stats['snapshot_lag_chunks'] is None  # snapshots not armed
+
+
+def test_stats_reports_snapshot_freshness(service_dataset, tmp_path):
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0, workers_count=1,
+                       snapshot_path=str(tmp_path / 'snap.pkl'),
+                       snapshot_every=1) as server:
+        with RemoteReader(server.data_endpoint) as remote:
+            _drain_ids(remote)
+            stats = remote._one_shot_rpc(remote._rpc_endpoints[0],
+                                         {'cmd': 'stats'})
+    # Final snapshot written at end-of-stream: zero lag, fresh age.
+    assert stats['snapshot_lag_chunks'] == 0
+    assert stats['snapshot_age_s'] is not None and stats['snapshot_age_s'] < 60
 
 
 def test_pytorch_loader_over_service(service_dataset):
