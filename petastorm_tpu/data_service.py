@@ -754,6 +754,7 @@ class RemoteReader(object):
         self._chunks = 0        # unique chunks received (dupes excluded)
         self._auth_key = auth_key
         self._seen = {}         # server_id -> _SeqTracker (under _acct_lock)
+        self._last_recv = {}    # server_id -> monotonic time of last chunk
         self._dup_chunks = 0
         self._bad_auth_frames = 0
         # Thread-safety of stop() vs an iterating pump thread: sockets are
@@ -860,6 +861,7 @@ class RemoteReader(object):
     def _track(self, sid, seq):
         """Count a received chunk (caller holds _acct_lock); False for a
         duplicate (replayed by a restarted server) — drop, don't count."""
+        self._last_recv[sid] = time.monotonic()
         tracker = self._seen.get(sid)
         if tracker is None:
             tracker = self._seen[sid] = _SeqTracker()
@@ -1150,12 +1152,20 @@ class RemoteReader(object):
 
     @property
     def diagnostics(self):
+        now = time.monotonic()
+        with self._acct_lock:
+            ages = {sid.hex(): round(now - t, 3)
+                    for sid, t in self._last_recv.items()}
         return {'remote_chunks': self._chunks,
                 'servers': self._n_servers,
                 'servers_ended': len(self._ended_server_ids),
                 'pending_chunks': len(self._pending),
                 'duplicate_chunks': self._dup_chunks,
-                'bad_auth_frames': self._bad_auth_frames}
+                'bad_auth_frames': self._bad_auth_frames,
+                # Seconds since each server's last chunk: a server gone
+                # silent (SIGKILL, network partition) shows a growing age
+                # here long before the end-of-epoch accounting notices.
+                'server_last_chunk_age_s': ages}
 
     def stop(self):
         # May be called from any thread while another is blocked in

@@ -393,6 +393,19 @@ def test_stats_reports_snapshot_freshness(service_dataset, tmp_path):
     assert stats['snapshot_age_s'] is not None and stats['snapshot_age_s'] < 60
 
 
+def test_diagnostics_per_server_ages(service_dataset):
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0) as s1, \
+            serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                          num_epochs=1, seed=0) as s2:
+        with RemoteReader([s1.data_endpoint, s2.data_endpoint]) as remote:
+            _drain_ids(remote)
+            diag = remote.diagnostics
+    ages = diag['server_last_chunk_age_s']
+    assert len(ages) == 2, 'both servers must appear once chunks arrived'
+    assert all(isinstance(a, float) and a >= 0 for a in ages.values())
+
+
 def test_pytorch_loader_over_service(service_dataset):
     """The torch adapter consumes a RemoteReader exactly like a local
     reader — the schema rides the rpc socket, rows transpose out of the
