@@ -1154,8 +1154,12 @@ class RemoteReader(object):
     def diagnostics(self):
         now = time.monotonic()
         with self._acct_lock:
+            # Cleanly-ended servers are excluded: their age would climb
+            # forever and trip any 'age > N means dead' monitor — the
+            # exact confusion this metric exists to resolve.
             ages = {sid.hex(): round(now - t, 3)
-                    for sid, t in self._last_recv.items()}
+                    for sid, t in self._last_recv.items()
+                    if sid not in self._ended_server_ids}
         return {'remote_chunks': self._chunks,
                 'servers': self._n_servers,
                 'servers_ended': len(self._ended_server_ids),

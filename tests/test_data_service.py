@@ -395,15 +395,30 @@ def test_stats_reports_snapshot_freshness(service_dataset, tmp_path):
 
 def test_diagnostics_per_server_ages(service_dataset):
     with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
-                       num_epochs=1, seed=0) as s1, \
+                       num_epochs=None, seed=0) as s1, \
             serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
                           num_epochs=1, seed=0) as s2:
-        with RemoteReader([s1.data_endpoint, s2.data_endpoint]) as remote:
-            _drain_ids(remote)
-            diag = remote.diagnostics
-    ages = diag['server_last_chunk_age_s']
-    assert len(ages) == 2, 'both servers must appear once chunks arrived'
-    assert all(isinstance(a, float) and a >= 0 for a in ages.values())
+        with RemoteReader([s1.data_endpoint, s2.data_endpoint],
+                          shared_stream=True, end_grace_s=1.0) as remote:
+            seen_sids = set()
+            while len(seen_sids) < 2:
+                next(remote)
+                seen_sids = set(remote.diagnostics
+                                ['server_last_chunk_age_s'])
+            mid = remote.diagnostics['server_last_chunk_age_s']
+            assert len(mid) == 2, 'both live servers must report an age'
+            assert all(isinstance(a, float) and a >= 0
+                       for a in mid.values())
+            # Drain until the finite server ENDs: a cleanly-ended server
+            # must drop out of the ages (its age is not a liveness
+            # signal) while the endless one keeps reporting.
+            import time as _time
+            deadline = _time.monotonic() + 30
+            while len(remote.diagnostics['server_last_chunk_age_s']) == 2:
+                next(remote)
+                assert _time.monotonic() < deadline, 'finite server never ended'
+            final = remote.diagnostics['server_last_chunk_age_s']
+    assert len(final) == 1, 'ended server must be excluded from ages'
 
 
 def test_pytorch_loader_over_service(service_dataset):
