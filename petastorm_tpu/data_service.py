@@ -757,6 +757,7 @@ class RemoteReader(object):
         self._last_recv = {}    # server_id -> monotonic time of last chunk
         self._dup_chunks = 0
         self._bad_auth_frames = 0
+        self._first_bad_auth_t = None
         # Thread-safety of stop() vs an iterating pump thread: sockets are
         # only touched under _sock_lock; stop() sets _stopped and closes
         # the sockets itself ONLY if it can take the lock without blocking
@@ -947,6 +948,28 @@ class RemoteReader(object):
                     continue    # duplicate (server ring replay): drop
                 # No data pending: check for END/ERR broadcasts, re-poll.
                 self._drain_control()
+                if (self._bad_auth_frames >= 3 and self._chunks == 0
+                        and not self._ended_server_ids
+                        and not self._advertised):
+                    # Nothing has EVER authenticated and bad frames keep
+                    # arriving: an auth_key mismatch (keyed consumer vs
+                    # keyless server drops even the END broadcast, so the
+                    # grace path below can never start). Give the true
+                    # server one grace window to produce a valid frame —
+                    # stray alien traffic on a reused port must not kill a
+                    # slow-starting stream — then fail loudly.
+                    if self._first_bad_auth_t is None:
+                        self._first_bad_auth_t = time.monotonic()
+                    elif (time.monotonic() - self._first_bad_auth_t
+                          > self._end_grace_s):
+                        self._close_sockets()
+                        self._stopped = True
+                        raise RuntimeError(
+                            '{} frame(s) failed authentication and none '
+                            'ever succeeded — auth_key mismatch between '
+                            'this consumer and the server(s) (a keyless '
+                            'server cannot satisfy a keyed consumer).'
+                            .format(self._bad_auth_frames))
                 if len(self._ended_server_ids) >= self._n_servers:
                     if self._server_errors:
                         # Error end: deliver loudly as soon as everything
@@ -1334,22 +1357,26 @@ def checkpoint_shared_stream(readers, timeout_s=60.0):
         sents = [r['sent'] for r in replies]
         deadline = time.monotonic() + timeout_s
         while True:
+            # Drain until dry BEFORE paying for a union: the union walks
+            # every tracker's full extras set (it grows with chunks
+            # received on a shared stream), so it must run once per
+            # round, not once per drained chunk.
+            while drain_all():
+                pass
             counts = _union_received_counts(readers)
             if all(counts.get(sid, 0) >= sent
                    for sid, sent in zip(sids, sents)):
                 break
-            progressed = [r._drain_one_into_pending() for r in readers]
-            if not any(progressed):
-                if time.monotonic() >= deadline:
-                    short = {e: sent - counts.get(sid, 0)
-                             for e, sid, sent in zip(endpoints, sids, sents)
-                             if counts.get(sid, 0) < sent}
-                    raise RuntimeError(
-                        'shared-stream checkpoint: sent chunks never '
-                        'arrived at any consumer (per-server shortfall: '
-                        '{}) — a consumer outside `readers` on this '
-                        'stream?'.format(short))
-                time.sleep(0.02)
+            if time.monotonic() >= deadline:
+                short = {e: sent - counts.get(sid, 0)
+                         for e, sid, sent in zip(endpoints, sids, sents)
+                         if counts.get(sid, 0) < sent}
+                raise RuntimeError(
+                    'shared-stream checkpoint: sent chunks never '
+                    'arrived at any consumer (per-server shortfall: '
+                    '{}) — a consumer outside `readers` on this '
+                    'stream?'.format(short))
+            time.sleep(0.02)
         consumers = []
         for r in readers:
             with r._acct_lock:

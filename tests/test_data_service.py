@@ -638,6 +638,20 @@ def test_keyless_consumer_drops_authed_frames(service_dataset):
         assert remote.diagnostics['bad_auth_frames'] > 0
 
 
+def test_keyed_consumer_keyless_server_fails_loudly(service_dataset):
+    """The reverse mismatch: a KEYED consumer against a keyless server must
+    raise (after one grace window), not poll forever — the keyless END
+    broadcast fails the MAC check, so the normal end accounting can never
+    start and the mismatch detector is the only escape."""
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0) as server:      # no key
+        with RemoteReader(server.data_endpoint, auth_key=b'wrong-key',
+                          end_grace_s=1.0) as remote:
+            with pytest.raises(RuntimeError, match='auth_key mismatch'):
+                _drain_ids(remote)
+        assert remote.diagnostics['bad_auth_frames'] >= 3
+
+
 @pytest.fixture(scope='module')
 def kill_dataset(tmp_path_factory):
     """Chunks big enough (~64KB) that TCP buffering cannot swallow the
