@@ -240,49 +240,84 @@ def _child_pipeline(url, workers):
 
 
 def _measure_h2d(jax, batch):
-    """h2d probes: one-shot latency, sustained double-buffered bandwidth, and
-    the overlap fraction of transfers hidden under a jitted compute
-    (VERDICT r2 next-round #7)."""
-    buf = np.ones((batch, _IMAGE_SIZE, _IMAGE_SIZE, 3), np.uint8)
-    jax.block_until_ready(jax.device_put(buf))  # warm the transfer path
-    t0 = time.perf_counter()
-    jax.block_until_ready(jax.device_put(buf))
-    oneshot_gbps = buf.nbytes / (time.perf_counter() - t0) / 1e9
+    """h2d probes: one-shot latency, sustained double-buffered bandwidth, the
+    overlap fraction of transfers hidden under a jitted compute (VERDICT r2
+    next-round #7), and the chunked-put rate (``stage_chunks`` staging).
 
-    # Sustained: keep 2 transfers in flight, 16 total (steady-state rate,
-    # not first-transfer latency).
+    Every timing is fenced by pulling a reduced BYTE back to the host:
+    ``block_until_ready`` can return before the transfer actually lands when
+    the device sits behind a tunnel (observed on axon: a 19 MB put "completed"
+    in 40 ms async but takes ~900 ms fenced), which inflated the r4 numbers
+    to 0.89 GB/s on a link whose true fenced rate is ~0.02 GB/s."""
+    import jax.numpy as jnp
+    ssum = jax.jit(lambda a: jnp.sum(a, dtype=jnp.uint32))
+
+    def fence(a):
+        return int(ssum(a))    # d2h of the reduced byte: cannot lie
+
+    buf = np.ones((batch, _IMAGE_SIZE, _IMAGE_SIZE, 3), np.uint8)
+    fence(jax.device_put(buf))  # warm the transfer path + the sum executable
+    resident = jax.device_put(buf)
+    fence(resident)
+    t0 = time.perf_counter()
+    fence(resident)
+    fence_s = time.perf_counter() - t0   # round-trip floor, no fresh h2d
+    t0 = time.perf_counter()
+    fence(jax.device_put(buf))
+    oneshot_gbps = buf.nbytes / max(1e-9, time.perf_counter() - t0 - fence_s) / 1e9
+
+    # Sustained: keep 2 transfers in flight, 8 total (steady-state rate, not
+    # first-transfer latency); fence each as it retires.
     bufs = [buf, buf + 1]
-    n = 16
-    jax.block_until_ready([jax.device_put(b) for b in bufs])
+    n = 8
     t0 = time.perf_counter()
     inflight = []
     for i in range(n):
         inflight.append(jax.device_put(bufs[i % 2]))
         if len(inflight) > 2:
-            jax.block_until_ready(inflight.pop(0))
-    jax.block_until_ready(inflight)
+            fence(inflight.pop(0))
+    for a in inflight:
+        fence(a)
     sustained_gbps = buf.nbytes * n / (time.perf_counter() - t0) / 1e9
+
+    # Chunked put (what JaxLoader(stage_chunks=k) does): split along the
+    # batch dim, put the pieces, concatenate on device.
+    cat = jax.jit(lambda *xs: jnp.concatenate(xs))
+    k = 4
+    parts = np.array_split(buf, k)
+    fence(cat(*[jax.device_put(p) for p in parts]))  # warm concat
+    t0 = time.perf_counter()
+    fence(cat(*[jax.device_put(p) for p in parts]))
+    chunked_gbps = buf.nbytes / max(1e-9, time.perf_counter() - t0 - fence_s) / 1e9
 
     # Overlap: does a transfer hide under compute? compare compute-only vs
     # compute+concurrent device_put wall time.
     x = jax.device_put(np.ones((2048, 2048), np.float32))
     matmul = jax.jit(lambda a: a @ a)
-    jax.block_until_ready(matmul(x))
+    msum = jax.jit(lambda a: jnp.sum(a))
+
+    def mfence(a):
+        return float(msum(a))
+
+    mfence(matmul(x))
     t0 = time.perf_counter()
-    for _ in range(8):
-        jax.block_until_ready(matmul(x))
+    for _ in range(4):
+        mfence(matmul(x))
     compute_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    for i in range(8):
+    for i in range(4):
         y = matmul(x)
         h = jax.device_put(bufs[i % 2])
-        jax.block_until_ready([y, h])
+        mfence(y)
+        fence(h)
     both_s = time.perf_counter() - t0
-    xfer_s = buf.nbytes * 8 / (sustained_gbps * 1e9)
+    xfer_s = buf.nbytes * 4 / (sustained_gbps * 1e9)
     added = max(0.0, both_s - compute_s)
     overlap_frac = max(0.0, min(1.0, 1.0 - added / xfer_s)) if xfer_s > 0 else 0.0
-    return {'h2d_GBps': round(oneshot_gbps, 2),
-            'h2d_sustained_GBps': round(sustained_gbps, 2),
+    return {'h2d_GBps': round(oneshot_gbps, 3),
+            'h2d_sustained_GBps': round(sustained_gbps, 3),
+            'h2d_chunked_GBps': round(chunked_gbps, 3),
+            'h2d_fence_rtt_ms': round(fence_s * 1e3, 1),
             'h2d_overlap_frac': round(overlap_frac, 3)}
 
 
@@ -366,6 +401,10 @@ def _child_imagenet(url, workers):
     # fence=1 blocks on the loss (d2h) after each scan group, serializing
     # compute and the next group's transfers.
     fence = os.environ.get('BENCH_IMAGENET_FENCE') == '1'
+    # Chunked staging: ~2x fenced h2d on the axon tunnel (sweet spot ~5MB
+    # pieces — PROFILE_r05 §6); pass-through to JaxLoader(stage_chunks=).
+    stage_chunks = int(os.environ.get('BENCH_STAGE_CHUNKS',
+                                      '4' if platform != 'cpu' else '1'))
 
     aug = os.environ.get('BENCH_IMAGENET_AUG') == '1'
     if aug:
@@ -420,6 +459,7 @@ def _child_imagenet(url, workers):
         'scan_microbatches': scan_k,
         'superbatch': superbatch,
         'prefetch': prefetch,
+        'stage_chunks': stage_chunks,
         'fence_per_group': fence,
         'model': os.environ.get('BENCH_IMAGENET_MODEL', 'resnet50'),
         'warmup_steps': warmup_iters * scan_k,
@@ -434,7 +474,8 @@ def _child_imagenet(url, workers):
                                 cache_type='memory')
 
     with reader:
-        with JaxLoader(reader, batch, mesh=mesh, prefetch=prefetch) as loader:
+        with JaxLoader(reader, batch, mesh=mesh, prefetch=prefetch,
+                       stage_chunks=stage_chunks) as loader:
             it = loader.superbatches(scan_k)
             for _ in range(warmup_iters):
                 b = next(it)

@@ -32,6 +32,10 @@ logger = logging.getLogger(__name__)
 
 _END = object()
 
+# Fields smaller than this stage as one put even under stage_chunks>1:
+# chunking a 1KB label column costs k round trips for nothing.
+_STAGE_CHUNK_MIN_BYTES = 4 << 20
+
 
 # --------------------------------------------------------------------------
 # shape policies
@@ -460,7 +464,8 @@ class JaxLoader(object):
     def __init__(self, reader, batch_size, mesh=None, sharding=None,
                  batch_axis='data', prefetch=2, shape_policies=None,
                  shuffling_queue_capacity=0, min_after_dequeue=None, seed=None,
-                 last_batch='drop', strict_fields=False, echo=1, tracer=None):
+                 last_batch='drop', strict_fields=False, echo=1, tracer=None,
+                 stage_chunks=1):
         import jax
 
         if tracer is None:
@@ -530,6 +535,18 @@ class JaxLoader(object):
             self._dlpack_staging = jax.default_backend() == 'cpu'
         except Exception:  # noqa: BLE001 - backend probe must not kill init
             self._dlpack_staging = False
+        # Transport optimization for high-latency host<->device links (the
+        # axon tunnel sustains ~2x the throughput at ~5MB transfers vs one
+        # ~20MB put — measured, PROFILE_r05 §6): split each field along the
+        # batch dim into `stage_chunks` device_puts and concatenate on
+        # device. Only taken when the target is a single device (multi-
+        # device shardings keep the one-shot path — real pod hosts move
+        # h2d over PCIe where one large transfer is optimal).
+        self._stage_chunks = max(1, int(stage_chunks))
+        self._stage_concat = None
+        if self._stage_chunks > 1:
+            import jax.numpy as jnp
+            self._stage_concat = jax.jit(lambda *xs: jnp.concatenate(xs))
         # Start the stager LAST: it touches the state above immediately.
         if self._consumer_staging:
             self._thread = None
@@ -547,6 +564,18 @@ class JaxLoader(object):
         from petastorm_tpu.parallel.mesh import batch_sharding
         return batch_sharding(self._mesh, self._batch_axis)
 
+    def _chunked_put(self, array, sharding):
+        """Split along the batch dim, put each piece, concatenate on device.
+        Wins ~2x on high-latency tunnels (see ``stage_chunks``); only called
+        for single-device targets where per-piece puts are trivially valid."""
+        jax = self._jax
+        parts = np.array_split(array, self._stage_chunks)
+        if sharding is not None:
+            staged = [jax.device_put(p, sharding) for p in parts]
+        else:
+            staged = [jax.device_put(p) for p in parts]
+        return self._stage_concat(*staged)
+
     def _stage(self, host_batch):
         jax = self._jax
         out = {}
@@ -555,9 +584,18 @@ class JaxLoader(object):
         with self._tracer.span('stage', 'device'):
             for name, array in host_batch.items():
                 nbytes += array.nbytes
+                chunkable = (self._stage_chunks > 1
+                             and array.nbytes >= _STAGE_CHUNK_MIN_BYTES
+                             and len(array) >= self._stage_chunks)
                 if self._mesh is not None or self._sharding is not None:
                     sharding = self._field_sharding(name)
-                    out[name] = jax.make_array_from_process_local_data(sharding, array)
+                    if chunkable and sharding.num_devices == 1:
+                        out[name] = self._chunked_put(array, sharding)
+                    else:
+                        out[name] = jax.make_array_from_process_local_data(
+                            sharding, array)
+                elif chunkable and not self._dlpack_staging:
+                    out[name] = self._chunked_put(array, None)
                 elif self._dlpack_staging:
                     # CPU backend: import the host buffer zero-copy via
                     # DLPack (batch buffers are freshly assembled, never
