@@ -29,9 +29,18 @@ def normalize_images_reference(images, mean=_IMAGENET_MEAN, std=_IMAGENET_STD,
 
 
 def _normalize_kernel(images_ref, scale_ref, shift_ref, out_ref):
-    # One grid step owns a (1, H, W, C) block resident in VMEM.
-    x = images_ref[...].astype(jnp.float32)
-    # scale/shift are (1, 1, 1, C): broadcast over the VPU lanes.
+    # One grid step owns a (block_n, H*W*C) tile: each image is one ROW, so
+    # the lane dimension is H*W*C wide and tiles (8,128) densely. Keeping
+    # NHWC blocks instead would put C in the lane dimension — Mosaic pads
+    # lanes to 128, a 42x VMEM blowup for C=3 that OOMs scoped vmem on real
+    # chips (found on first hardware contact; interpret mode never sees it).
+    x = images_ref[...]
+    if x.dtype == jnp.uint8:
+        # Mosaic has no direct uint8->f32 cast; widen through int32.
+        x = x.astype(jnp.int32)
+    x = x.astype(jnp.float32)
+    # scale/shift are (1, H*W*C) rows (the per-channel constants tiled out):
+    # broadcast over the batch block.
     out_ref[...] = (x * scale_ref[...] + shift_ref[...]).astype(out_ref.dtype)
 
 
@@ -40,18 +49,33 @@ def _normalize_pallas(images, scale, shift, dtype=jnp.bfloat16, interpret=False)
     from jax.experimental import pallas as pl
 
     n, h, w, c = images.shape
-    return pl.pallas_call(
+    length = h * w * c
+    flat = images.reshape(n, length)
+    scale_row = jnp.tile(scale.reshape(-1), length // c).reshape(1, length)
+    shift_row = jnp.tile(shift.reshape(-1), length // c).reshape(1, length)
+    # Mosaic requires the sublane block divisible by 8 and the lane block
+    # divisible by 128 (or equal to the full dimension). 8 rows x <=32K
+    # lanes of f32 double-buffers under ~2MB of the 16MB scoped VMEM.
+    block_n = 8 if n % 8 == 0 else n
+    block_l = length
+    if length % 128 == 0 and length > (1 << 15):
+        for lanes in range(1 << 15, 0, -128):
+            if length % lanes == 0:
+                block_l = lanes
+                break
+    out = pl.pallas_call(
         _normalize_kernel,
-        grid=(n,),
+        grid=(n // block_n, length // block_l),
         in_specs=[
-            pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((1, 1, 1, c), lambda i: (0, 0, 0, 0)),
-            pl.BlockSpec((1, 1, 1, c), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((block_n, block_l), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_l), lambda i, j: (0, j)),
+            pl.BlockSpec((1, block_l), lambda i, j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, h, w, c), dtype),
+        out_specs=pl.BlockSpec((block_n, block_l), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((n, length), dtype),
         interpret=interpret,
-    )(images, scale, shift)
+    )(flat, scale_row, shift_row)
+    return out.reshape(n, h, w, c)
 
 
 def normalize_images(images, mean=_IMAGENET_MEAN, std=_IMAGENET_STD,
