@@ -275,11 +275,13 @@ def _child_flashattn():
 
     o_f = flash_attention(q, k, v, causal=True)
     o_d = dense_attention(q, k, v, causal=True)
-    out['fwd_max_abs_err'] = round(float(jnp.max(jnp.abs(o_f - o_d))), 6)
+    out['fwd_max_rel_err'] = round(
+        float(jnp.max(jnp.abs(o_f - o_d)) / jnp.max(jnp.abs(o_d))), 6)
     g_f = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g_d = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
-    out['grad_max_abs_err'] = round(max(
-        float(jnp.max(jnp.abs(a - b))) for a, b in zip(g_f, g_d)), 6)
+    out['grad_max_rel_err'] = round(max(
+        float(jnp.max(jnp.abs(a - b)) / jnp.max(jnp.abs(b)))
+        for a, b in zip(g_f, g_d)), 6)
 
     # Timing sweep, bf16 causal fwd+bwd (the training shape). FLOPs for
     # causal attention: ~2 * 4*B*T^2/2*H*D fwd, x2.5 with bwd.

@@ -131,12 +131,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q, block_k,
         if emit_lse:
             # logsumexp rows: the backward kernels reconstruct P without
             # re-running the online softmax.
-            lse_ref[...] = (m_ref[:, 0] + jnp.log(l))[None, :]
+            lse_ref[...] = m_ref[...] + jnp.log(l[:, None])
 
 
 def _flash_bhtd(q, k, v, seq_len, causal, block_q, block_k, interpret,
                 emit_lse):
-    """Padded ``[BH, T_pad, D]`` -> ``out`` (+ ``lse [BH, nq, block_q]`` when
+    """Padded ``[BH, T_pad, D]`` -> ``out`` (+ ``lse [BH, T_pad, _LANES]`` when
     ``emit_lse`` — the training forward; inference skips the write)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -152,12 +152,15 @@ def _flash_bhtd(q, k, v, seq_len, causal, block_q, block_k, interpret,
     out_specs = [pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0))]
     out_shape = [jax.ShapeDtypeStruct((bh, t_pad, d), q.dtype)]
     if emit_lse:
-        # [BH, n_qblocks, block_q]: same bytes as [BH, T_pad], but each block
-        # is rank-2 with block_q on the lane axis — layouts Mosaic tiles
-        # natively (a rank-1 (block_q,) block is interpreter-only territory).
-        out_specs.append(pl.BlockSpec((None, 1, block_q),
+        # Lane-broadcast [BH, T_pad, _LANES] (all lanes carry the same
+        # value) — the layout the official TPU flash kernels use for l/m
+        # residuals. A (block_q,) rank-1 or (1, block_q) block violates
+        # Mosaic's (8,128)-or-full rule on real chips (found on first
+        # hardware contact); the 128x HBM redundancy is the price of a
+        # layout every Mosaic version tiles natively.
+        out_specs.append(pl.BlockSpec((None, block_q, _LANES),
                                       lambda b, qi, ki: (b, qi, 0)))
-        out_shape.append(jax.ShapeDtypeStruct((bh, t_pad // block_q, block_q),
+        out_shape.append(jax.ShapeDtypeStruct((bh, t_pad, _LANES),
                                               jnp.float32))
     out = pl.pallas_call(
         kernel,
@@ -207,11 +210,11 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
         k_blk = k_ref[...].astype(jnp.float32)
         v_blk = v_ref[...].astype(jnp.float32)
         do = do_ref[...].astype(jnp.float32)
-        p = _recompute_p(q, k_blk, lse_ref[0], qi, ki, block_q, block_k,
+        p = _recompute_p(q, k_blk, lse_ref[:, 0], qi, ki, block_q, block_k,
                          seq_len, causal)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - dd_ref[0][:, None])
+        ds = p * (dp - dd_ref[:, 0:1])
         acc_ref[...] += scale * jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -247,14 +250,14 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         k_blk = k_ref[...].astype(jnp.float32)
         v_blk = v_ref[...].astype(jnp.float32)
         do = do_ref[...].astype(jnp.float32)
-        p = _recompute_p(q, k_blk, lse_ref[0], qi, ki, block_q, block_k,
+        p = _recompute_p(q, k_blk, lse_ref[:, 0], qi, ki, block_q, block_k,
                          seq_len, causal)
         dv_acc_ref[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - dd_ref[0][:, None])
+        ds = p * (dp - dd_ref[:, 0:1])
         dk_acc_ref[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -283,8 +286,8 @@ def _flash_bwd_bhtd(q, k, v, do, lse, dd, seq_len, causal, block_q, block_k,
             pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((None, 1, block_q), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((None, 1, block_q), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t_pad, d), q.dtype),
@@ -301,8 +304,8 @@ def _flash_bwd_bhtd(q, k, v, do, lse, dd, seq_len, causal, block_q, block_k,
             pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
             pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
             pl.BlockSpec((None, block_q, d), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((None, 1, block_q), lambda b, ki, qi: (b, qi, 0)),
-            pl.BlockSpec((None, 1, block_q), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((None, block_q, _LANES), lambda b, ki, qi: (b, qi, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0)),
@@ -375,7 +378,8 @@ def _flash_diff_bwd(causal, block_q, block_k, interpret, residuals, g):
     if t_pad != t:
         # lse is already padded (saved at the forward's padded length).
         dd = jnp.pad(dd, ((0, 0), (0, t_pad - t)))
-    dd = dd.reshape(b * h, t_pad // block_q, block_q)
+    # Lane-broadcast like lse: [BH, T_pad, _LANES] (see _flash_bhtd).
+    dd = jnp.broadcast_to(dd[:, :, None], (b * h, t_pad, _LANES))
 
     dq, dk, dv = _flash_bwd_bhtd(
         _to_bhtd(q, t_pad), _to_bhtd(k, t_pad), _to_bhtd(v, t_pad),
@@ -391,7 +395,7 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
 def _flash_pallas(q, k, v, causal, block_q, block_k, interpret, emit_lse):
-    """Returns ``(out [B,T,H,D], lse [BH, n_qblocks, block_q] | None)``."""
+    """Returns ``(out [B,T,H,D], lse [BH, T_pad, _LANES] | None)``."""
     b, t, h, d = q.shape
     block_q, block_k, t_pad = _pad_plan(t, block_q, block_k)
     out, lse = _flash_bhtd(_to_bhtd(q, t_pad), _to_bhtd(k, t_pad),
