@@ -328,16 +328,21 @@ def _flash_bwd_bhtd(q, k, v, do, lse, dd, seq_len, causal, block_q, block_k,
 # public entry + custom vjp
 # --------------------------------------------------------------------------
 
-def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
+def flash_attention(q, k, v, causal=False, block_q=None, block_k=None,
                     interpret=None):
     """Exact multi-head attention, ``[B, T, H, D]`` -> ``[B, T, H, D]``.
 
     On TPU backends this runs the Pallas blocked kernels; on other backends
     it falls back to the XLA reference unless ``interpret=True`` forces the
-    Pallas interpreter. ``block_q``/``block_k`` are clamped to the sequence
-    length; sequences are zero-padded up to a block multiple and the pad is
-    masked/stripped (padding tolerance is what lets ring attention hand this
-    kernel arbitrary per-device slice lengths).
+    Pallas interpreter. ``block_q``/``block_k`` default per dtype on TPU —
+    ``(512, 1024)`` for bf16, ``(256, 512)`` for f32 (hardware sweep on a
+    v5e, T=8192 causal fwd+bwd: (512,1024) sustains ~40 TF/s vs ~11 at
+    (128,128); f32 doubles VMEM so its blocks halve to stay inside the
+    16MB scoped budget) — and ``(128, 128)`` under the interpreter. Blocks
+    are clamped to the sequence length; sequences are zero-padded up to a
+    block multiple and the pad is masked/stripped (padding tolerance is
+    what lets ring attention hand this kernel arbitrary per-device slice
+    lengths).
 
     Differentiable end to end in O(block) memory: the training forward saves
     the logsumexp rows and the backward runs two more Pallas passes (a dq
@@ -351,6 +356,15 @@ def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
             from petastorm_tpu.models.attention import dense_attention
             return dense_attention(q, k, v, causal=causal)
         interpret = False
+    if block_q is None or block_k is None:
+        if interpret:
+            dq, dk = 128, 128
+        elif q.dtype == jnp.bfloat16:
+            dq, dk = 512, 1024
+        else:
+            dq, dk = 256, 512
+        block_q = dq if block_q is None else block_q
+        block_k = dk if block_k is None else block_k
     return _flash_diff(q, k, v, causal, block_q, block_k, interpret)
 
 
