@@ -1066,10 +1066,13 @@ def main():
         else:
             result['imagenet'] = '{} | reduced-footprint retry: {}'.format(err, err2)
 
-    # TPU path alive: also record loader-only pipeline capacity (r4 #2).
+    # TPU path alive: also record loader-only pipeline capacity (r4 #2)
+    # and the Pallas flash-attention certification + timings.
     pipe, perr = _run_child('pipeline', [imagenet_url, str(workers)],
                             timeout_s=900)
     result['pipeline'] = pipe if pipe else perr
+    fa, faerr = _run_child('flashattn', [], timeout_s=900)
+    result['flash_attention'] = fa if fa else faerr
 
     _fold_opportunistic_and_print(result)
 
