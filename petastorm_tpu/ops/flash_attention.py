@@ -42,11 +42,17 @@ def _block_mask(qi, ki, block_q, block_k, seq_len, causal):
     return mask
 
 
-def _recompute_p(q_scaled, k_blk, lse_vec, qi, ki, block_q, block_k, seq_len,
-                 causal):
-    """Rebuild this tile's probabilities ``P = exp(S - lse)`` (backward)."""
-    s = jax.lax.dot_general(q_scaled, k_blk, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+def _recompute_p(q, k_blk, lse_vec, qi, ki, block_q, block_k, seq_len,
+                 causal, scale):
+    """Rebuild this tile's probabilities ``P = exp(S - lse)`` (backward).
+
+    Operands stay in their input dtype (bf16 matmuls run the MXU at twice
+    the f32 rate); the product accumulates in f32 and the scalar scale is
+    applied to the f32 product — scale*(QK) == (scale*Q)K up to rounding,
+    and post-scaling in f32 keeps more bits than pre-scaling bf16 Q.
+    """
+    s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
     mask = _block_mask(qi, ki, block_q, block_k, seq_len, causal)
     return jnp.where(mask, jnp.exp(s - lse_vec[:, None]), 0.0)
 
@@ -103,11 +109,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q, block_k,
 
     @pl.when(needed)
     def _step():
-        q = q_ref[...].astype(jnp.float32) * scale
-        k_blk = k_ref[...].astype(jnp.float32)
-        v_blk = v_ref[...].astype(jnp.float32)
+        q = q_ref[...]
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
+        # Native-dtype operands, f32 accumulation: bf16 matmuls run the
+        # MXU at twice the f32 rate; scale applies to the f32 product.
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         mask = _block_mask(qi, ki, block_q, block_k, seq_len, causal)
         s = jnp.where(mask, s, NEG_INF)
         m_prev = m_ref[:, 0]
@@ -118,7 +126,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_q, block_k,
         l_new = l_ref[:, 0] * correction + p.sum(axis=-1)
         acc_ref[...] = (acc_ref[...] * correction[:, None]
                         + jax.lax.dot_general(
-                            p, v_blk, (((1,), (0,)), ((), ())),
+                            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32))
         m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
@@ -206,17 +214,17 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
 
     @pl.when(needed)
     def _step():
-        q = q_ref[...].astype(jnp.float32) * scale
-        k_blk = k_ref[...].astype(jnp.float32)
-        v_blk = v_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
+        q = q_ref[...]
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
+        do = do_ref[...]
         p = _recompute_p(q, k_blk, lse_ref[:, 0], qi, ki, block_q, block_k,
-                         seq_len, causal)
+                         seq_len, causal, scale)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - dd_ref[:, 0:1])
         acc_ref[...] += scale * jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
@@ -246,20 +254,22 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 
     @pl.when(needed)
     def _step():
-        q = q_ref[...].astype(jnp.float32) * scale
-        k_blk = k_ref[...].astype(jnp.float32)
-        v_blk = v_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
+        q = q_ref[...]
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
+        do = do_ref[...]
         p = _recompute_p(q, k_blk, lse_ref[:, 0], qi, ki, block_q, block_k,
-                         seq_len, causal)
+                         seq_len, causal, scale)
         dv_acc_ref[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - dd_ref[:, 0:1])
-        dk_acc_ref[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+        # dK = dS^T (scale*Q): scale folds onto the f32 accumulator so Q
+        # stays a native-dtype operand.
+        dk_acc_ref[...] += scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
