@@ -287,7 +287,7 @@ def _child_flashattn():
     # causal attention: ~2 * 4*B*T^2/2*H*D fwd, x2.5 with bwd.
     timings = {}
     for T in (int(s) for s in os.environ.get(
-            'BENCH_FLASH_SEQ', '2048,8192').split(',')):
+            'BENCH_FLASH_SEQ', '2048,8192,16384').split(',')):
         kq, kk, kv = jax.random.split(jax.random.PRNGKey(T), 3)
         shape = (1, T, 8, 128)
         qb = jax.random.normal(kq, shape, jnp.bfloat16)

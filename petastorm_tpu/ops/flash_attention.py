@@ -67,9 +67,23 @@ def _to_bhtd(x, t_pad):
 
 
 def _pad_plan(t, block_q, block_k):
-    """(block_q, block_k, t_pad) with blocks clamped and t padded to their lcm."""
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
+    """(block_q, block_k, t_pad): blocks clamped to ``t`` and rounded down
+    to powers of two (min 8), ``t`` padded to a multiple of both.
+
+    The power-of-two rounding is load-bearing: clamping alone can hand back
+    a block that shares no factors with the other one, and padding to their
+    raw lcm then explodes — e.g. ``block_q=512`` against a T=1000 clamp of
+    ``block_k=1000`` gives lcm 64,000, a 64x memory/compute cliff for the
+    'arbitrary per-device slice lengths' ring attention feeds us. With
+    power-of-two blocks the lcm IS the larger block, so padding overhead is
+    bounded by ``max_block - 1``. The floor of 8 keeps the sublane dimension
+    Mosaic-legal for tiny sequences (the kernel masks the pad via
+    ``seq_len``)."""
+    def _pow2_floor(b):
+        return 1 << (b.bit_length() - 1)
+
+    block_q = max(8, _pow2_floor(min(block_q, t)))
+    block_k = max(8, _pow2_floor(min(block_k, t)))
     lcm = block_q * block_k // math.gcd(block_q, block_k)
     return block_q, block_k, -(-t // lcm) * lcm
 
@@ -349,10 +363,11 @@ def flash_attention(q, k, v, causal=False, block_q=None, block_k=None,
     v5e, T=8192 causal fwd+bwd: (512,1024) sustains ~40 TF/s vs ~11 at
     (128,128); f32 doubles VMEM so its blocks halve to stay inside the
     16MB scoped budget) — and ``(128, 128)`` under the interpreter. Blocks
-    are clamped to the sequence length; sequences are zero-padded up to a
-    block multiple and the pad is masked/stripped (padding tolerance is
-    what lets ring attention hand this kernel arbitrary per-device slice
-    lengths).
+    are clamped to the sequence length and rounded down to powers of two
+    (keeping pad overhead bounded by one block — see ``_pad_plan``);
+    sequences are zero-padded up to a block multiple and the pad is
+    masked/stripped (padding tolerance is what lets ring attention hand
+    this kernel arbitrary per-device slice lengths).
 
     Differentiable end to end in O(block) memory: the training forward saves
     the logsumexp rows and the backward runs two more Pallas passes (a dq

@@ -54,28 +54,43 @@ def _normalize_pallas(images, scale, shift, dtype=jnp.bfloat16, interpret=False)
     scale_row = jnp.tile(scale.reshape(-1), length // c).reshape(1, length)
     shift_row = jnp.tile(shift.reshape(-1), length // c).reshape(1, length)
     # Mosaic requires the sublane block divisible by 8 and the lane block
-    # divisible by 128 (or equal to the full dimension). 8 rows x <=32K
-    # lanes of f32 double-buffers under ~2MB of the 16MB scoped VMEM.
-    block_n = 8 if n % 8 == 0 else n
-    block_l = length
-    if length % 128 == 0 and length > (1 << 15):
+    # divisible by 128. Rather than falling back to whole-dimension blocks
+    # for awkward shapes (an eval tail batch of 100 rows, a 300x300x3 image
+    # whose flattened length is not a 128-multiple) — which is exactly the
+    # unbounded-VMEM cliff this kernel once hit on real chips — PAD: rows
+    # up to a multiple of 8, lanes up to a multiple of 128, and slice the
+    # pad back off after. The kernel computes garbage in the pad cells
+    # (0 * scale + shift); it is never read.
+    n_pad = -(-n // 8) * 8
+    l_pad = -(-length // 128) * 128
+    if n_pad != n:
+        flat = jnp.pad(flat, ((0, n_pad - n), (0, 0)))
+    if l_pad != length:
+        flat = jnp.pad(flat, ((0, 0), (0, l_pad - length)))
+        scale_row = jnp.pad(scale_row, ((0, 0), (0, l_pad - length)))
+        shift_row = jnp.pad(shift_row, ((0, 0), (0, l_pad - length)))
+    # 8 rows x <=32K lanes of f32 double-buffers under ~2MB of the 16MB
+    # scoped VMEM; block_l is the largest 128-multiple divisor of l_pad
+    # within that budget (always >=128 since l_pad is a 128-multiple).
+    block_l = l_pad
+    if l_pad > (1 << 15):
         for lanes in range(1 << 15, 0, -128):
-            if length % lanes == 0:
+            if l_pad % lanes == 0:
                 block_l = lanes
                 break
     out = pl.pallas_call(
         _normalize_kernel,
-        grid=(n // block_n, length // block_l),
+        grid=(n_pad // 8, l_pad // block_l),
         in_specs=[
-            pl.BlockSpec((block_n, block_l), lambda i, j: (i, j)),
+            pl.BlockSpec((8, block_l), lambda i, j: (i, j)),
             pl.BlockSpec((1, block_l), lambda i, j: (0, j)),
             pl.BlockSpec((1, block_l), lambda i, j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((block_n, block_l), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((n, length), dtype),
+        out_specs=pl.BlockSpec((8, block_l), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, l_pad), dtype),
         interpret=interpret,
     )(flat, scale_row, shift_row)
-    return out.reshape(n, h, w, c)
+    return out[:n, :length].reshape(n, h, w, c)
 
 
 def normalize_images(images, mean=_IMAGENET_MEAN, std=_IMAGENET_STD,

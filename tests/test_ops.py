@@ -23,6 +23,41 @@ def test_pallas_kernel_matches_reference_in_interpret_mode():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+def test_pallas_kernel_awkward_shapes_pad_and_slice():
+    """Shapes that trip Mosaic's (8,128) rule must be padded, not given
+    whole-dimension blocks (the unbounded-VMEM cliff found on hardware):
+    odd batch (eval tail), flattened length not a 128-multiple, both."""
+    rng = np.random.default_rng(3)
+    mean = jnp.asarray((0.485, 0.456, 0.406), jnp.float32)
+    std = jnp.asarray((0.229, 0.224, 0.225), jnp.float32)
+    scale = (1.0 / (255.0 * std)).reshape(1, 1, 1, -1)
+    shift = (-mean / std).reshape(1, 1, 1, -1)
+    for shape in [(5, 16, 128, 3), (8, 30, 30, 3), (3, 10, 10, 3)]:
+        images = jnp.asarray(rng.integers(0, 255, shape, dtype=np.uint8))
+        got = _normalize_pallas(images, scale, shift, dtype=jnp.float32,
+                                interpret=True)
+        want = normalize_images_reference(images, dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, err_msg=str(shape))
+
+
+def test_flash_pad_plan_bounded():
+    """Clamped blocks must not explode the padded length: block 512 against
+    T=1000 once padded to lcm(512, 1000) = 64,000 (64x). Power-of-two
+    rounding bounds the pad by one block."""
+    from petastorm_tpu.ops.flash_attention import _pad_plan
+    bq, bk, t_pad = _pad_plan(1000, 512, 1024)
+    assert (bq, bk, t_pad) == (512, 512, 1024)
+    bq, bk, t_pad = _pad_plan(5, 128, 128)
+    assert (bq, bk, t_pad) == (8, 8, 8)   # Mosaic sublane floor
+    bq, bk, t_pad = _pad_plan(8192, 512, 1024)
+    assert (bq, bk, t_pad) == (512, 1024, 8192)
+    for t in (1, 7, 100, 333, 1000, 4097):
+        bq, bk, t_pad = _pad_plan(t, 512, 1024)
+        assert t_pad < t + max(bq, bk), (t, bq, bk, t_pad)
+        assert t_pad % bq == 0 and t_pad % bk == 0
+
+
 def test_normalize_images_cpu_path():
     rng = np.random.default_rng(1)
     images = jnp.asarray(rng.integers(0, 255, (2, 8, 8, 3), dtype=np.uint8))
