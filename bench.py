@@ -776,6 +776,14 @@ def _record_attempt(attempt, inet):
                         'imagenet_img_per_sec_per_chip', 0)):
                 data['best'] = {'measured_at': attempt['started_at'],
                                 'imagenet': inet}
+        # Track the auxiliary TPU measurements separately: the best-imagenet
+        # attempt may predate them, and the end-of-round fold must be able
+        # to carry them even when the pool is dead at bench time.
+        for key in ('pipeline', 'flash_attention'):
+            val = attempt.get(key)
+            if isinstance(val, dict) and val.get('platform') == 'tpu':
+                data['best_' + key] = {'measured_at': attempt['started_at'],
+                                       **val}
         _save_opportunistic(data)
     return data
 
@@ -1104,6 +1112,15 @@ def _fold_opportunistic_and_print(result):
                 inet['imagenet_img_per_sec_per_chip'] / _NORTH_STAR_IMG_PER_SEC, 3)
             result['headline_source'] = 'opportunistic TPU run at {}'.format(
                 best.get('measured_at'))
+    # Auxiliary TPU measurements (loader-only pipeline rate, flash-attention
+    # certification): prefer a recorded TPU result over a CPU fallback run.
+    for key in ('pipeline', 'flash_attention'):
+        recorded = opp.get('best_' + key)
+        live = result.get(key)
+        live_is_tpu = (isinstance(live, dict)
+                       and live.get('platform') == 'tpu')
+        if recorded and not live_is_tpu:
+            result[key + '_tpu_opportunistic'] = recorded
     print(json.dumps(result))
     summary = {'metric': result.get('metric'), 'value': result.get('value'),
                'unit': result.get('unit'),

@@ -574,9 +574,14 @@ class JaxLoader(object):
     def _chunked_put(self, array, sharding):
         """Split along the batch dim, put each piece, concatenate on device.
         Wins ~2x on high-latency tunnels (see ``stage_chunks``); only called
-        for single-device targets where per-piece puts are trivially valid."""
+        for single-device targets where per-piece puts are trivially valid.
+        ``stage_chunks`` is a minimum: pieces are further split to stay
+        under ~8MB each — single ~39MB puts have been observed to wedge
+        device tunnels permanently, and a bigger batch or f32 field must
+        not silently cross that line."""
         jax = self._jax
-        parts = np.array_split(array, self._stage_chunks)
+        n_chunks = max(self._stage_chunks, -(-array.nbytes // (8 << 20)))
+        parts = np.array_split(array, min(n_chunks, len(array)))
         if sharding is not None:
             staged = [jax.device_put(p, sharding) for p in parts]
         else:

@@ -194,6 +194,38 @@ def test_bench_opportunistic_fold(tmp_path, monkeypatch, capsys):
     assert summary['platform'] == 'axon'
 
 
+def test_bench_fold_carries_aux_tpu_measurements(tmp_path, monkeypatch, capsys):
+    """Pipeline and flash-attention TPU results recorded opportunistically
+    must reach the final JSON when the round-end run has no live TPU (the
+    best-imagenet attempt may predate them, so they track separately)."""
+    import json
+
+    bench = _import_bench(monkeypatch)
+    art = tmp_path / 'opp.json'
+    monkeypatch.setattr(bench, '_OPPORTUNISTIC_PATH', str(art))
+    bench._record_attempt(
+        {'started_at': 't1', 'probes': [],
+         'flash_attention': {'platform': 'tpu', 'fwd_max_rel_err': 0.002},
+         'pipeline': {'platform': 'tpu', 'pipeline_img_per_sec': 9000.0}},
+        {'imagenet_img_per_sec_per_chip': 250.0, 'platform': 'tpu'})
+    # A later attempt without aux results must not erase the recorded ones,
+    # and a CPU aux result must not displace a TPU one.
+    data = bench._record_attempt(
+        {'started_at': 't2', 'probes': [],
+         'flash_attention': {'platform': 'cpu'}}, None)
+    assert data['best_flash_attention']['measured_at'] == 't1'
+    assert data['best_pipeline']['pipeline_img_per_sec'] == 9000.0
+
+    result = {'metric': 'hello_world_samples_per_sec', 'value': 2900.0,
+              'unit': 'samples/s', 'vs_baseline': 4.1,
+              'flash_attention': {'platform': 'cpu'}}
+    bench._fold_opportunistic_and_print(result)
+    out = capsys.readouterr().out.strip().splitlines()
+    folded = json.loads(out[0])
+    assert folded['flash_attention_tpu_opportunistic']['fwd_max_rel_err'] == 0.002
+    assert folded['pipeline_tpu_opportunistic']['pipeline_img_per_sec'] == 9000.0
+
+
 def test_bench_fold_prefers_better_live_run(tmp_path, monkeypatch, capsys):
     """A live TPU run better than the opportunistic best keeps the
     headline AND the summary's mfu/stall come from the live run."""
