@@ -288,23 +288,35 @@ def _child_flashattn():
     timings = {}
     for T in (int(s) for s in os.environ.get(
             'BENCH_FLASH_SEQ', '2048,8192,16384').split(',')):
-        kq, kk, kv = jax.random.split(jax.random.PRNGKey(T), 3)
-        shape = (1, T, 8, 128)
-        qb = jax.random.normal(kq, shape, jnp.bfloat16)
-        kb = jax.random.normal(kk, shape, jnp.bfloat16)
-        vb = jax.random.normal(kv, shape, jnp.bfloat16)
-        step = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
-        fence(step(qb, kb, vb)[0])   # compile + land
-        t0 = time.perf_counter()
-        reps = 8
-        for _ in range(reps - 1):
-            g = step(qb, kb, vb)
-        fence(step(qb, kb, vb)[0])
-        dt = (time.perf_counter() - t0) / reps
-        flops = 2.5 * 4 * shape[0] * T * T * shape[2] * shape[3]  # causal halves, fwd+bwd ~2.5x
-        timings['T{}'.format(T)] = {
-            'fwd_bwd_ms': round(dt * 1e3, 2),
-            'tflops_per_s': round(flops / dt / 2 / 1e12, 2)}
+        # Two shapes per length: B=1 (the r4 shape, kept for cross-round
+        # comparability — fixed dispatch overhead weighs heavily on it) and
+        # B=4 (a per-chip training microbatch; amortizes dispatch and fills
+        # the grid's parallel axes — the capability number).
+        for B, tag in ((1, 'T{}'), (4, 'T{}_b4')):
+            kq, kk, kv = jax.random.split(jax.random.PRNGKey(T), 3)
+            shape = (B, T, 8, 128)
+            qb = jax.random.normal(kq, shape, jnp.bfloat16)
+            kb = jax.random.normal(kk, shape, jnp.bfloat16)
+            vb = jax.random.normal(kv, shape, jnp.bfloat16)
+            step = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+            fence(step(qb, kb, vb)[0])   # compile + land
+            # B=1 keeps the r4 methodology exactly (single 8-rep pass) so
+            # the T{N} keys stay comparable across rounds; the new _b4
+            # series takes best-of-2 16-rep passes (first pass can carry
+            # scheduler stragglers).
+            reps, passes = (8, 1) if B == 1 else (16, 2)
+            dt = None
+            for _ in range(passes):
+                t0 = time.perf_counter()
+                for _ in range(reps - 1):
+                    g = step(qb, kb, vb)
+                fence(step(qb, kb, vb)[0])
+                cur = (time.perf_counter() - t0) / reps
+                dt = cur if dt is None else min(dt, cur)
+            flops = 2.5 * 4 * shape[0] * T * T * shape[2] * shape[3]  # causal halves, fwd+bwd ~2.5x
+            timings[tag.format(T)] = {
+                'fwd_bwd_ms': round(dt * 1e3, 2),
+                'tflops_per_s': round(flops / dt / 2 / 1e12, 2)}
     out['flash_train_step'] = timings
     print(json.dumps(out))
 

@@ -32,6 +32,26 @@ NEG_INF = -1e30  # large-finite: -inf breaks the running-max rescale at init
 _LANES = 128     # VPU lane width: in-kernel scratch vectors are lane-broadcast
 
 
+def _mosaic_params(interpret):
+    """Compiler hints for the compiled path: all three kernels carry their
+    online-softmax / accumulator state only along the LAST grid axis, so the
+    first two axes (batch*heads, outer block) are declared parallel —
+    Mosaic may then reorder/pipeline them freely. Interpret mode (CI) takes
+    no TPU compiler params."""
+    if interpret:
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+
+    # Renamed TPUCompilerParams -> CompilerParams across jax releases; the
+    # tests only exercise interpret=True, so guard the compiled-only path.
+    params_cls = getattr(pltpu, 'CompilerParams',
+                         getattr(pltpu, 'TPUCompilerParams', None))
+    if params_cls is None:
+        return {}
+    return {'compiler_params': params_cls(
+        dimension_semantics=('parallel', 'parallel', 'arbitrary'))}
+
+
 def _block_mask(qi, ki, block_q, block_k, seq_len, causal):
     """[block_q, block_k] validity mask: kv tail padding + causal triangle."""
     k_pos = ki * block_k + jax.lax.iota(jnp.int32, block_k)
@@ -200,6 +220,7 @@ def _flash_bhtd(q, k, v, seq_len, causal, block_q, block_k, interpret,
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom
         ],
         interpret=interpret,
+        **_mosaic_params(interpret),
     )(q, k, v)
     return (out[0], out[1]) if emit_lse else (out[0], None)
 
@@ -317,6 +338,7 @@ def _flash_bwd_bhtd(q, k, v, do, lse, dd, seq_len, causal, block_q, block_k,
         out_shape=jax.ShapeDtypeStruct((bh, t_pad, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
+        **_mosaic_params(interpret),
     )(q, k, v, do, lse, dd)
 
     dk, dv = pl.pallas_call(
@@ -344,6 +366,7 @@ def _flash_bwd_bhtd(q, k, v, do, lse, dd, seq_len, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
+        **_mosaic_params(interpret),
     )(q, k, v, do, lse, dd)
     return dq, dk, dv
 
