@@ -284,8 +284,16 @@ def _child_flashattn():
         for a, b in zip(g_f, g_d)), 6)
 
     # Timing sweep, bf16 causal fwd+bwd (the training shape). FLOPs for
-    # causal attention: ~2 * 4*B*T^2/2*H*D fwd, x2.5 with bwd.
+    # causal attention: ~2 * 4*B*T^2/2*H*D fwd, x2.5 with bwd. TPU only:
+    # off-TPU flash_attention falls back to dense attention, whose [BH,T,T]
+    # scores at these lengths (34 GB at T=16384 B=4) would kill the child
+    # before it printed the correctness numbers above.
     timings = {}
+    if platform != 'tpu':
+        out['flash_train_step'] = 'skipped: timing sweep is TPU-only ' \
+                                  '(dense fallback would OOM at these T)'
+        print(json.dumps(out))
+        return
     for T in (int(s) for s in os.environ.get(
             'BENCH_FLASH_SEQ', '2048,8192,16384').split(',')):
         # Two shapes per length: B=1 (the r4 shape, kept for cross-round
@@ -418,7 +426,11 @@ def _peak_bf16_flops(device):
 
 # Forward-pass FLOPs per 224x224x3 image (the standard published counts);
 # train step ~= 3x forward (bwd is ~2x fwd for convnets).
-_MODEL_FWD_FLOPS = {'resnet50': 4.09e9, 'resnet18': 1.82e9}
+# resnet: published counts. vit: analytic for this repo's ViT default
+# (patch 16, d=384, 8 layers, mlp x4 — ViT-S-ish at 2/3 depth) on 224^2:
+# per layer 2*(4*T*d^2 + 2*T^2*d + 8*T*d^2) with T=197, plus patchify
+# (196*384*768 MACs) and the 1000-way head = ~6.2e9 fwd FLOPs.
+_MODEL_FWD_FLOPS = {'resnet50': 4.09e9, 'resnet18': 1.82e9, 'vit': 6.2e9}
 
 # Training retires ~3x the forward FLOPs (fwd + bwd at 2x) — the standard
 # analytic-MFU convention; an intentional lower bound (ignores batch norm
@@ -466,8 +478,10 @@ def _child_imagenet(url, workers):
     warmup_steps = int(os.environ.get(
         'BENCH_IMAGENET_WARMUP', str(_IMAGENET_ROWS // batch + 3)))
     measure_steps = int(os.environ.get('BENCH_IMAGENET_STEPS', '40'))
+    from petastorm_tpu.models import vit
     model_cls = {'resnet50': resnet.ResNet50, 'resnet18': resnet.ResNet18,
-                 'tiny': resnet.ResNetTiny}[os.environ.get('BENCH_IMAGENET_MODEL', 'resnet50')]
+                 'tiny': resnet.ResNetTiny,
+                 'vit': vit.ViT}[os.environ.get('BENCH_IMAGENET_MODEL', 'resnet50')]
     n_devices = jax.device_count()
     platform = jax.devices()[0].platform
 
@@ -875,9 +889,18 @@ def _record_attempt(attempt, inet):
         # Track the auxiliary TPU measurements separately: the best-imagenet
         # attempt may predate them, and the end-of-round fold must be able
         # to carry them even when the pool is dead at bench time.
-        for key in ('pipeline', 'flash_attention'):
+        for key in ('pipeline', 'flash_attention', 'imagenet_vit'):
             val = attempt.get(key)
             if isinstance(val, dict) and val.get('platform') == 'tpu':
+                if key == 'imagenet_vit':
+                    # Throughput slot: keep the best sustained rate (a
+                    # contended late-round grant must not displace a
+                    # healthy earlier one). Certification slots
+                    # (pipeline/flash) stay latest-wins.
+                    prev = data.get('best_' + key)
+                    if prev and (_sustained_best(prev)[0] >=
+                                 _sustained_best(val)[0]):
+                        continue
                 data['best_' + key] = {'measured_at': attempt['started_at'],
                                        **val}
         _save_opportunistic(data)
@@ -961,6 +984,17 @@ def probe_now(workers, probe_timeouts):
     pipe, perr = _run_child('pipeline', [imagenet_url, str(workers)],
                             timeout_s=900)
     attempt['pipeline'] = pipe if pipe is not None else perr
+    # Second model family on real data: the repo's ViT through the same
+    # reader -> loader -> train-step path, reduced footprint (the HBM-cached
+    # phase is the number of interest; streamed warmup kept short).
+    vit, verr = _run_child(
+        'imagenet', [imagenet_url, str(workers)], timeout_s=900,
+        extra_env={'BENCH_IMAGENET_MODEL': 'vit',
+                   'BENCH_IMAGENET_WARMUP': '4',
+                   'BENCH_IMAGENET_STEPS': '16'})
+    if vit is not None and vit.get('platform') == 'cpu':
+        vit, verr = None, 'child fell back to cpu platform'
+    attempt['imagenet_vit'] = vit if vit is not None else verr
     # Pallas flash attention on the real chip (correctness + fwd/bwd
     # timing) — the kernels are interpreter-validated in CI but only a
     # grant can certify them compiled; failure is non-fatal.
@@ -1229,8 +1263,9 @@ def _fold_opportunistic_and_print(result):
                           source='opportunistic TPU run at {}'.format(
                               best.get('measured_at')))
     # Auxiliary TPU measurements (loader-only pipeline rate, flash-attention
-    # certification): prefer a recorded TPU result over a CPU fallback run.
-    for key in ('pipeline', 'flash_attention'):
+    # certification, ViT-on-real-data): prefer a recorded TPU result over a
+    # CPU fallback run.
+    for key in ('pipeline', 'flash_attention', 'imagenet_vit'):
         recorded = opp.get('best_' + key)
         live = result.get(key)
         live_is_tpu = (isinstance(live, dict)
