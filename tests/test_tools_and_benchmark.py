@@ -348,3 +348,28 @@ def test_bench_vit_slot_keeps_best_sustained(tmp_path, monkeypatch):
         None)
     assert data['best_imagenet_vit']['measured_at'] == 't1'
     assert data['best_pipeline']['pipeline_img_per_sec'] == 4000.0
+
+
+@pytest.mark.slow
+def test_bench_lm_child_smoke(tmp_path):
+    """The lm bench child runs end to end (toy config, CPU): token Parquet
+    store -> tensor reader -> JaxLoader -> scanned TransformerLM steps."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({'JAX_PLATFORMS': 'cpu', 'BENCH_LM_VOCAB': '256',
+                'BENCH_LM_DMODEL': '32', 'BENCH_LM_LAYERS': '1',
+                'BENCH_LM_HEADS': '2', 'BENCH_LM_BATCH': '1',
+                'BENCH_LM_SCAN_K': '2', 'BENCH_LM_STEPS': '2'})
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, 'bench.py'), '--_child', 'lm', '2'],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out['lm_tokens_per_sec_per_chip'] > 0
+    assert out['lm_config']['attention'] == 'dense'
+    assert out['lm_final_loss'] > 0
