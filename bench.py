@@ -44,7 +44,6 @@ _IMAGENET_DIR = '/tmp/petastorm_tpu_bench_imagenet_r{}_g{}'.format(
 _IMAGE_SIZE = 224
 _LM_ROWS = 2048
 _LM_SEQ = 1025                       # 1024 inputs + shifted next-token targets
-_LM_DIR = '/tmp/petastorm_tpu_bench_lm_r{}_t{}'.format(_LM_ROWS, _LM_SEQ)
 _WARMUP_SAMPLES = 200
 _MEASURE_SAMPLES = 2000
 
@@ -124,14 +123,17 @@ def _ensure_imagenet_dataset():
     return 'file://' + _IMAGENET_DIR
 
 
-def _ensure_lm_dataset(vocab):
+def _ensure_lm_dataset(vocab, seq=_LM_SEQ):
     from petastorm_tpu.codecs import NdarrayCodec
     from petastorm_tpu.etl.writer import write_dataset
     from petastorm_tpu.unischema import Unischema, UnischemaField
 
-    # Vocab in the dir name: a toy-vocab CI run must not leave a store a
-    # full-vocab run would silently reuse.
-    lm_dir = '{}_v{}'.format(_LM_DIR, vocab)
+    # Every generation parameter in the dir name: a toy-vocab CI run (or a
+    # long-context sweep) must not leave a store another config would
+    # silently reuse.
+    n_rows = _LM_ROWS if seq <= 2048 else max(256, _LM_ROWS * 1024 // seq)
+    lm_dir = '/tmp/petastorm_tpu_bench_lm_r{}_t{}_v{}'.format(
+        n_rows, seq, vocab)
     marker = os.path.join(lm_dir, '_common_metadata')
     if os.path.exists(marker):
         return 'file://' + lm_dir
@@ -139,13 +141,13 @@ def _ensure_lm_dataset(vocab):
     # Token sequences as fixed-shape int32 rows: the long-context flagship's
     # input through the SAME Parquet -> tensor-reader path as images.
     schema = Unischema('LMBenchSchema', [
-        UnischemaField('tokens', np.int32, (_LM_SEQ,), NdarrayCodec(), False),
+        UnischemaField('tokens', np.int32, (seq,), NdarrayCodec(), False),
     ])
     rng = np.random.default_rng(11)
 
     def rows():
-        for _ in range(_LM_ROWS):
-            yield {'tokens': rng.integers(0, vocab, _LM_SEQ, dtype=np.int32)}
+        for _ in range(n_rows):
+            yield {'tokens': rng.integers(0, vocab, seq, dtype=np.int32)}
 
     write_dataset('file://' + lm_dir, schema, rows(), rows_per_row_group=256)
     return 'file://' + lm_dir
@@ -186,9 +188,10 @@ def _child_lm(workers):
     batch = int(os.environ.get('BENCH_LM_BATCH', '8')) * n_devices
     scan_k = max(1, int(os.environ.get('BENCH_LM_SCAN_K', '8')))
     measure_iters = max(1, int(os.environ.get('BENCH_LM_STEPS', '48')) // scan_k)
-    t = _LM_SEQ - 1
+    seq = int(os.environ.get('BENCH_LM_SEQ', str(_LM_SEQ)))
+    t = seq - 1
 
-    url = _ensure_lm_dataset(vocab)
+    url = _ensure_lm_dataset(vocab, seq)
     model = TransformerLM(vocab_size=vocab, d_model=d_model,
                           num_heads=n_heads, num_layers=n_layers, max_len=t,
                           attention='flash' if platform == 'tpu' else 'dense')
@@ -232,7 +235,7 @@ def _child_lm(workers):
 
             def group():
                 sb = next(it)
-                return sb.tokens.reshape(scan_k, batch, _LM_SEQ)
+                return sb.tokens.reshape(scan_k, batch, seq)
 
             for _ in range(2):                        # compile + warm cache
                 params, opt_state, losses = train_scan(params, opt_state,
@@ -1045,9 +1048,11 @@ def _record_attempt(attempt, inet):
         # Throughput slots keep the best rate (a contended late-round grant
         # must not displace a healthy earlier one); certification slots
         # (pipeline/flash) stay latest-wins.
+        lm_rate = lambda v: v.get('lm_tokens_per_sec_per_chip') or 0  # noqa: E731
         rate_of = {'imagenet_vit': lambda v: _sustained_best(v)[0],
-                   'lm': lambda v: v.get('lm_tokens_per_sec_per_chip') or 0}
-        for key in ('pipeline', 'flash_attention', 'imagenet_vit', 'lm'):
+                   'lm': lm_rate, 'lm_long': lm_rate}
+        for key in ('pipeline', 'flash_attention', 'imagenet_vit', 'lm',
+                    'lm_long'):
             val = attempt.get(key)
             if isinstance(val, dict) and val.get('platform') == 'tpu':
                 if key in rate_of:
@@ -1154,6 +1159,15 @@ def probe_now(workers, probe_timeouts):
     if lm is not None and lm.get('platform') == 'cpu':
         lm, lerr = None, 'child fell back to cpu platform'
     attempt['lm'] = lm if lm is not None else lerr
+    # Long-context variant: T=8192 through the flash kernels, smaller batch.
+    lml, llerr = _run_child('lm', [str(workers)], timeout_s=900,
+                            extra_env={'BENCH_LM_SEQ': '8193',
+                                       'BENCH_LM_BATCH': '2',
+                                       'BENCH_LM_SCAN_K': '4',
+                                       'BENCH_LM_STEPS': '16'})
+    if lml is not None and lml.get('platform') == 'cpu':
+        lml, llerr = None, 'child fell back to cpu platform'
+    attempt['lm_long'] = lml if lml is not None else llerr
     # Pallas flash attention on the real chip (correctness + fwd/bwd
     # timing) — the kernels are interpreter-validated in CI but only a
     # grant can certify them compiled; failure is non-fatal.
@@ -1426,7 +1440,8 @@ def _fold_opportunistic_and_print(result):
     # Auxiliary TPU measurements (loader-only pipeline rate, flash-attention
     # certification, ViT-on-real-data): prefer a recorded TPU result over a
     # CPU fallback run.
-    for key in ('pipeline', 'flash_attention', 'imagenet_vit', 'lm'):
+    for key in ('pipeline', 'flash_attention', 'imagenet_vit', 'lm',
+                'lm_long'):
         recorded = opp.get('best_' + key)
         live = result.get(key)
         live_is_tpu = (isinstance(live, dict)
