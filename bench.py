@@ -190,10 +190,15 @@ def _child_lm(workers):
     measure_iters = max(1, int(os.environ.get('BENCH_LM_STEPS', '48')) // scan_k)
     seq = int(os.environ.get('BENCH_LM_SEQ', str(_LM_SEQ)))
     t = seq - 1
+    # >0: Switch MoE MLPs (top-1 routing). NOT the dense FLOP basis: the
+    # dense-dispatch einsums and the capacity padding are real retired
+    # FLOPs, accounted below so lm_mfu stays honest across variants.
+    moe = int(os.environ.get('BENCH_LM_MOE', '0'))
 
     url = _ensure_lm_dataset(vocab, seq)
     model = TransformerLM(vocab_size=vocab, d_model=d_model,
                           num_heads=n_heads, num_layers=n_layers, max_len=t,
+                          moe_experts=moe,
                           attention='flash' if platform == 'tpu' else 'dense')
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, t), jnp.int32))
@@ -211,6 +216,17 @@ def _child_lm(workers):
             x, y = tokens[:, :-1], tokens[:, 1:]
 
             def loss_fn(p):
+                if moe:
+                    # Switch load-balance loss (models/moe.py:14-16): without
+                    # it top-1 routing collapses onto few experts and the
+                    # bench would measure a degenerate configuration.
+                    logits, mods = model.apply(p, x,
+                                               mutable=['intermediates'])
+                    aux = sum(jax.tree_util.tree_leaves(
+                        mods['intermediates']))
+                    ce = optax.softmax_cross_entropy_with_integer_labels(
+                        logits, y).mean()
+                    return ce + 1e-2 * aux
                 logits = model.apply(p, x)
                 return optax.softmax_cross_entropy_with_integer_labels(
                     logits, y).mean()
@@ -251,11 +267,20 @@ def _child_lm(workers):
             stats = loader.stats
     steps = measure_iters * scan_k
     tok_rate = batch * t * steps / elapsed
-    # Analytic fwd FLOPs/token: per layer 2*(12*d^2 + T*d) MACs->FLOPs —
-    # qkvo 4d^2 + 4x MLP 8d^2 + TWO causal-average attention matmuls
-    # (QK^T and AV at T/2 each), plus the vocab head.
-    fwd_flops_token = 2 * (n_layers * (12 * d_model * d_model
-                                       + t * d_model)
+    # Analytic fwd FLOPs/token: per layer 2*(4d^2 + T*d + mlp) MACs->FLOPs —
+    # qkvo 4d^2 + TWO causal-average attention matmuls (QK^T and AV at T/2
+    # each) + the MLP — plus the vocab head. Dense MLP: 8d^2. Switch MoE
+    # (models/moe.py): expert matmuls run E*C slots per T tokens (capacity
+    # padding) and the dense-dispatch/combine einsums cost E*C*d each —
+    # all real retired FLOPs, so the MoE basis must include them.
+    if moe:
+        capacity = max(1, int(-(-t * 1.25 // moe)))
+        mlp_macs = (8 * d_model * d_model * moe * capacity // t
+                    + 2 * moe * capacity * d_model)
+    else:
+        mlp_macs = 8 * d_model * d_model
+    fwd_flops_token = 2 * (n_layers * (4 * d_model * d_model + t * d_model
+                                       + mlp_macs)
                            + d_model * vocab)
     peak = _peak_bf16_flops(jax.devices()[0]) if platform != 'cpu' else None
     mfu = (_mfu(fwd_flops_token, tok_rate / n_devices, peak)
@@ -272,7 +297,7 @@ def _child_lm(workers):
                       'layers': n_layers, 'heads': n_heads, 'seq': t,
                       'batch_per_chip': batch // n_devices,
                       'scan_microbatches': scan_k, 'steps': steps,
-                      'attention': model.attention,
+                      'attention': model.attention, 'moe_experts': moe,
                       'fwd_flops_per_token': fwd_flops_token},
     }))
 
@@ -1050,9 +1075,9 @@ def _record_attempt(attempt, inet):
         # (pipeline/flash) stay latest-wins.
         lm_rate = lambda v: v.get('lm_tokens_per_sec_per_chip') or 0  # noqa: E731
         rate_of = {'imagenet_vit': lambda v: _sustained_best(v)[0],
-                   'lm': lm_rate, 'lm_long': lm_rate}
+                   'lm': lm_rate, 'lm_long': lm_rate, 'lm_moe': lm_rate}
         for key in ('pipeline', 'flash_attention', 'imagenet_vit', 'lm',
-                    'lm_long'):
+                    'lm_long', 'lm_moe'):
             val = attempt.get(key)
             if isinstance(val, dict) and val.get('platform') == 'tpu':
                 if key in rate_of:
@@ -1168,6 +1193,16 @@ def probe_now(workers, probe_timeouts):
     if lml is not None and lml.get('platform') == 'cpu':
         lml, llerr = None, 'child fell back to cpu platform'
     attempt['lm_long'] = lml if lml is not None else llerr
+    # Switch-MoE variant (top-1 routing). Kept small: the routed scan's
+    # compile through the tunnel is the dominant cost, and a probe child
+    # that cannot finish inside its timeout records nothing.
+    lmm, lmerr = _run_child('lm', [str(workers)], timeout_s=900,
+                            extra_env={'BENCH_LM_MOE': '4',
+                                       'BENCH_LM_LAYERS': '4',
+                                       'BENCH_LM_STEPS': '16'})
+    if lmm is not None and lmm.get('platform') == 'cpu':
+        lmm, lmerr = None, 'child fell back to cpu platform'
+    attempt['lm_moe'] = lmm if lmm is not None else lmerr
     # Pallas flash attention on the real chip (correctness + fwd/bwd
     # timing) — the kernels are interpreter-validated in CI but only a
     # grant can certify them compiled; failure is non-fatal.
@@ -1441,7 +1476,7 @@ def _fold_opportunistic_and_print(result):
     # certification, ViT-on-real-data): prefer a recorded TPU result over a
     # CPU fallback run.
     for key in ('pipeline', 'flash_attention', 'imagenet_vit', 'lm',
-                'lm_long'):
+                'lm_long', 'lm_moe'):
         recorded = opp.get('best_' + key)
         live = result.get(key)
         live_is_tpu = (isinstance(live, dict)
