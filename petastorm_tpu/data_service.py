@@ -541,6 +541,11 @@ class DataServer(object):
     def served_chunks(self):
         return self._served_chunks
 
+    def wait(self, timeout=None):
+        """Block until the stream is fully served (end protocol complete).
+        Returns True once done, False on timeout — serving continues."""
+        return self._serving_done.wait(timeout)
+
     def stop(self):
         self._stop.set()
         # Stop the reader FIRST: it unblocks a serve thread parked inside

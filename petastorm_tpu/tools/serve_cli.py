@@ -1,0 +1,122 @@
+"""``petastorm-tpu-serve`` — run a data-service decode tier from the shell.
+
+Operational counterpart of :func:`petastorm_tpu.data_service.serve_dataset`:
+starts one server process that reads, decodes, and streams a dataset to
+remote trainers (``RemoteReader``), so a CPU decode tier can be deployed
+with a process supervisor or container entry point instead of custom
+Python. Prints one JSON line with the bound endpoints (trainers dial
+``data_endpoint``), then serves until the stream completes or SIGINT/
+SIGTERM. Role parity: the reference keeps decode inside the training
+process (``reader.py:50``); the disaggregated tier is this repo's
+TPU-first extension — trainer hosts spend their cores on staging, not
+jpeg decode.
+"""
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description='Serve a petastorm_tpu dataset to remote trainers')
+    parser.add_argument('dataset_url')
+    parser.add_argument('--bind', default='tcp://*:5555',
+                        help='zmq data endpoint (default tcp://*:5555; '
+                             'control/rpc default to the next two ports)')
+    parser.add_argument('--fields', nargs='*', default=None,
+                        help='schema field names/regexes (default: all)')
+    parser.add_argument('--workers', type=int, default=4)
+    parser.add_argument('--epochs', type=int, default=1,
+                        help='epochs to serve; 0 = infinite')
+    parser.add_argument('--cache-type', default='null',
+                        choices=['null', 'memory', 'disk'])
+    parser.add_argument('--shuffle-row-groups', action='store_true')
+    parser.add_argument('--seed', type=int, default=None)
+    parser.add_argument('--sndhwm', type=int, default=4,
+                        help='per-consumer chunk buffer (backpressure)')
+    parser.add_argument('--batch-reader', action='store_true',
+                        help='serve a plain-Parquet store via '
+                             'make_batch_reader instead of the decoded '
+                             'tensor reader')
+    parser.add_argument('--auth-key-file', default=None,
+                        help='file whose bytes key the stream MACs '
+                             '(consumers pass the same auth_key)')
+    parser.add_argument('--snapshot-path', default=None,
+                        help='arm periodic self-snapshots (crash recovery)')
+    parser.add_argument('--snapshot-every', type=int, default=16)
+    parser.add_argument('--resume', default=None, metavar='SNAPSHOT',
+                        help='restart from a snapshot written by a '
+                             'previous --snapshot-path run')
+    parser.add_argument('--drain-grace', type=float, default=5.0,
+                        help='seconds to keep sockets open after the '
+                             'stream is served: lets zmq flush queued '
+                             'chunks and the END broadcast reach slow '
+                             'consumers before teardown (default 5)')
+    args = parser.parse_args(argv)
+
+    from petastorm_tpu.data_service import serve_dataset
+
+    auth_key = None
+    if args.auth_key_file:
+        # Verbatim file bytes: stripping would silently alter binary keys
+        # whose edge bytes are ASCII whitespace, and the consumers MAC
+        # with the raw bytes they loaded.
+        with open(args.auth_key_file, 'rb') as f:
+            auth_key = f.read()
+
+    if (args.snapshot_path or args.resume) and args.workers != 1:
+        # Crash recovery dedupes by (server_id, seq): resume must re-produce
+        # chunks in the original order, which needs a single-worker reader
+        # (serve_dataset docstring contract).
+        print('petastorm-tpu-serve: snapshot/resume requires deterministic '
+              'chunk order; forcing --workers 1 (was {})'.format(args.workers),
+              file=sys.stderr, flush=True)
+        args.workers = 1
+
+    reader_kwargs = {
+        'workers_count': args.workers,
+        'num_epochs': None if args.epochs == 0 else args.epochs,
+        'cache_type': args.cache_type,
+        'shuffle_row_groups': args.shuffle_row_groups,
+    }
+    if args.seed is not None:
+        reader_kwargs['seed'] = args.seed
+    if args.fields:
+        reader_kwargs['schema_fields'] = args.fields
+    if args.batch_reader:
+        from petastorm_tpu import make_batch_reader
+        reader_kwargs['reader_factory'] = make_batch_reader
+
+    # Handlers first: a supervisor's SIGTERM during a slow dataset open
+    # must request clean teardown, not take the default kill and orphan
+    # pool workers.
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+
+    server = serve_dataset(args.dataset_url, args.bind,
+                           sndhwm=args.sndhwm, auth_key=auth_key,
+                           snapshot_path=args.snapshot_path,
+                           snapshot_every=args.snapshot_every,
+                           snapshot_resume=args.resume, **reader_kwargs)
+    print(json.dumps({'data_endpoint': server.data_endpoint,
+                      'control_endpoint': server.control_endpoint,
+                      'rpc_endpoint': server.rpc_endpoint}), flush=True)
+
+    # wait() fires when the READER is exhausted — up to sndhwm chunks can
+    # still sit in the zmq send queue and the END broadcast keeps repeating
+    # for slow joiners, so hold the sockets open for a drain grace before
+    # stop() (which closes with linger=0, discarding anything queued).
+    while not stop.is_set():
+        if server.wait(0.5):
+            stop.wait(args.drain_grace)
+            break
+    server.stop()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -34,6 +34,7 @@ setup(
             'petastorm-tpu-metadata=petastorm_tpu.etl.metadata_cli:metadata_util_main',
             'petastorm-tpu-copy-dataset=petastorm_tpu.tools.copy_dataset:main',
             'petastorm-tpu-throughput=petastorm_tpu.benchmark.cli:main',
+            'petastorm-tpu-serve=petastorm_tpu.tools.serve_cli:main',
         ],
     },
 )

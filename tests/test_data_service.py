@@ -826,3 +826,28 @@ def test_shared_stream_state_in_job_checkpoint(tmp_path):
     assert back['server_states'] == state['server_states']
     np.testing.assert_array_equal(back['consumers'][0]['pending'][0]['sid'],
                                   np.arange(4))
+
+
+def test_serve_cli_end_to_end(service_dataset):
+    """petastorm-tpu-serve: shell-launched server prints its endpoints as a
+    JSON line, a RemoteReader consumes the full stream, and the process
+    exits 0 on its own once the end protocol completes."""
+    import json
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'petastorm_tpu.tools.serve_cli',
+         service_dataset, '--bind', 'tcp://127.0.0.1:*', '--workers', '2',
+         '--epochs', '1'],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        endpoints = json.loads(line)
+        with RemoteReader(endpoints['data_endpoint']) as remote:
+            ids = _drain_ids(remote)
+        assert sorted(ids) == list(range(N_ROWS))
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
