@@ -793,8 +793,42 @@ def _child_imagenet(url, workers):
     hbm_cached = None
     if os.environ.get('BENCH_IMAGENET_DEVICE_CACHE', '1') == '1':
         try:
+            bare = None
+            if aug:
+                # Matched in-run baseline for the augmentation-cost claim:
+                # the SAME state (copied before donation), cache build, and
+                # measurement protocol with the bare uint8 cast — dividing
+                # best-slot rates from different grants under different box
+                # load would make the cost ratio noise.
+                state_copy = jax.tree_util.tree_map(
+                    lambda x: jnp.array(x) if hasattr(x, 'dtype') else x,
+                    state)
+
+                def bare_normalize(images_u8):
+                    return images_u8.astype(jnp.float32) / 255.0
+
+                if scan_k > 1:
+                    bare_step = make_scan_train_step(
+                        mesh=mesh, microbatches=scan_k,
+                        preprocess=bare_normalize)
+                else:
+                    bare_inner = make_train_step(mesh=mesh)
+
+                    @partial(jax.jit, donate_argnums=(0,))
+                    def bare_step(state, images_u8, labels):
+                        return bare_inner(state, bare_normalize(images_u8),
+                                          labels)
+
+                bare = _measure_device_cache(
+                    jax, url, workers, batch, scan_k, mesh, bare_step,
+                    state_copy)
             hbm_cached = _measure_device_cache(
                 jax, url, workers, batch, scan_k, mesh, train_step, state)
+            if isinstance(hbm_cached, dict) and isinstance(bare, dict):
+                bare_rate = bare['imagenet_hbm_cached_img_per_sec_per_chip']
+                aug_rate = hbm_cached['imagenet_hbm_cached_img_per_sec_per_chip']
+                hbm_cached['hbm_cached_bare_img_per_sec_per_chip'] = bare_rate
+                hbm_cached['aug_cost_frac'] = round(1 - aug_rate / bare_rate, 4)
         except Exception as e:  # noqa: BLE001 - auxiliary metric, stay loud
             hbm_cached = 'skipped: {}'.format(e)
 
@@ -1047,6 +1081,24 @@ def _set_headline(result, inet, source=None):
         result['headline_source'] = source
 
 
+# Auxiliary measurement slots recorded per probe attempt. Throughput slots
+# promote by rate (a contended late-round grant must not displace a healthy
+# earlier record); certification slots (flash) stay latest-wins.
+_AUX_SLOT_KEYS = ('pipeline', 'flash_attention', 'imagenet_vit',
+                  'imagenet_aug', 'lm', 'lm_long', 'lm_moe')
+
+
+def _aux_rate(key, val):
+    """Promotion rate for a throughput aux slot; None = latest-wins."""
+    if key in ('lm', 'lm_long', 'lm_moe'):
+        return val.get('lm_tokens_per_sec_per_chip') or 0
+    if key in ('imagenet_vit', 'imagenet_aug'):
+        return _sustained_best(val)[0]
+    if key == 'pipeline':
+        return val.get('pipeline_img_per_sec') or 0
+    return None
+
+
 def _record_attempt(attempt, inet):
     """Append an attempt (and fold a successful measurement into ``best``)
     with load-append-save under an flock — probe_now runs take 30+ min
@@ -1070,19 +1122,13 @@ def _record_attempt(attempt, inet):
         # Track the auxiliary TPU measurements separately: the best-imagenet
         # attempt may predate them, and the end-of-round fold must be able
         # to carry them even when the pool is dead at bench time.
-        # Throughput slots keep the best rate (a contended late-round grant
-        # must not displace a healthy earlier one); certification slots
-        # (pipeline/flash) stay latest-wins.
-        lm_rate = lambda v: v.get('lm_tokens_per_sec_per_chip') or 0  # noqa: E731
-        rate_of = {'imagenet_vit': lambda v: _sustained_best(v)[0],
-                   'lm': lm_rate, 'lm_long': lm_rate, 'lm_moe': lm_rate}
-        for key in ('pipeline', 'flash_attention', 'imagenet_vit', 'lm',
-                    'lm_long', 'lm_moe'):
+        for key in _AUX_SLOT_KEYS:
             val = attempt.get(key)
             if isinstance(val, dict) and val.get('platform') == 'tpu':
-                if key in rate_of:
+                rate = _aux_rate(key, val)
+                if rate is not None:
                     prev = data.get('best_' + key)
-                    if prev and rate_of[key](prev) >= rate_of[key](val):
+                    if prev and (_aux_rate(key, prev) or 0) >= rate:
                         continue
                 data['best_' + key] = {'measured_at': attempt['started_at'],
                                        **val}
@@ -1108,6 +1154,21 @@ def _refold_best():
                 best = {'measured_at': a.get('started_at'),
                         'imagenet': inet}
         data['best'] = best
+        # Aux slots under the same current rules: throughput slots take the
+        # max-rate TPU record across all attempts, certification slots the
+        # latest TPU record.
+        for key in _AUX_SLOT_KEYS:
+            slot = None
+            for a in data['attempts']:
+                val = a.get(key)
+                if not (isinstance(val, dict) and val.get('platform') == 'tpu'):
+                    continue
+                rate = _aux_rate(key, val)
+                if (slot is None or rate is None or
+                        rate > (_aux_rate(key, slot) or 0)):
+                    slot = {'measured_at': a.get('started_at'), **val}
+            if slot is not None:
+                data['best_' + key] = slot
         _save_opportunistic(data)
     return best
 
@@ -1208,6 +1269,18 @@ def probe_now(workers, probe_timeouts):
     # grant can certify them compiled; failure is non-fatal.
     fa, faerr = _run_child('flashattn', [], timeout_s=900)
     attempt['flash_attention'] = fa if fa is not None else faerr
+    # Full on-device Inception augmentation with a matched in-run bare
+    # baseline (aug_cost_frac): provenance for the "augmentation costs ~4%"
+    # claim. LAST in the sequence — an auxiliary number must not consume a
+    # flaky grant's remaining lease ahead of the model/kernel slots.
+    aug, aerr = _run_child(
+        'imagenet', [imagenet_url, str(workers)], timeout_s=600,
+        extra_env={'BENCH_IMAGENET_AUG': '1',
+                   'BENCH_IMAGENET_WARMUP': '4',
+                   'BENCH_IMAGENET_STEPS': '16'})
+    if aug is not None and aug.get('platform') == 'cpu':
+        aug, aerr = None, 'child fell back to cpu platform'
+    attempt['imagenet_aug'] = aug if aug is not None else aerr
     data = _record_attempt(attempt, inet)
     print(json.dumps({'probe_now': attempt['outcome'],
                       'attempts_logged': len(data['attempts']),
@@ -1475,8 +1548,7 @@ def _fold_opportunistic_and_print(result):
     # Auxiliary TPU measurements (loader-only pipeline rate, flash-attention
     # certification, ViT-on-real-data): prefer a recorded TPU result over a
     # CPU fallback run.
-    for key in ('pipeline', 'flash_attention', 'imagenet_vit', 'lm',
-                'lm_long', 'lm_moe'):
+    for key in _AUX_SLOT_KEYS:
         recorded = opp.get('best_' + key)
         live = result.get(key)
         live_is_tpu = (isinstance(live, dict)
