@@ -673,7 +673,14 @@ def _child_imagenet(url, workers):
     mesh = make_mesh({'data': n_devices}) if n_devices > 1 else None
     batch = batch * n_devices
 
-    model = model_cls(num_classes=1000)
+    model_kwargs = {'num_classes': 1000}
+    model_name = os.environ.get('BENCH_IMAGENET_MODEL', 'resnet50')
+    if model_name != 'vit':
+        # 'space_to_depth' rearranges 2x2 pixel blocks into channels before
+        # an equivalent 4x4/1 stem conv — the MLPerf ResNet-on-TPU stem
+        # (C=3 starves the MXU's 128-lane tiling in the classic 7x7/2).
+        model_kwargs['stem'] = os.environ.get('BENCH_IMAGENET_STEM', 'conv7')
+    model = model_cls(**model_kwargs)
     state = create_train_state(jax.random.PRNGKey(0), model,
                                (1, _IMAGE_SIZE, _IMAGE_SIZE, 3),
                                mesh=mesh, learning_rate=0.1)
@@ -753,7 +760,8 @@ def _child_imagenet(url, workers):
         'prefetch': prefetch,
         'stage_chunks': stage_chunks,
         'fence_per_group': fence,
-        'model': os.environ.get('BENCH_IMAGENET_MODEL', 'resnet50'),
+        'model': model_name,
+        'stem': model_kwargs.get('stem'),
         'warmup_steps': warmup_iters * scan_k,
         'measure_steps': measure_iters * scan_k,
         'native_parquet': os.environ.get('PETASTORM_TPU_NATIVE_PARQUET', 'auto'),

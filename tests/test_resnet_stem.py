@@ -1,0 +1,56 @@
+"""ResNet stem variants: the classic 7x7/2 and the space-to-depth 4x4/1
+(MLPerf ResNet-on-TPU transform — 2x2 pixel blocks into channels so C=3
+stops starving the MXU's lane tiling). Both must produce the same feature
+geometry and train."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from petastorm_tpu.models import resnet
+from petastorm_tpu.models.train import create_train_state, make_train_step
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.mark.parametrize('stem', ['conv7', 'space_to_depth'])
+def test_stem_trains_and_matches_geometry(stem):
+    model = resnet.ResNetTiny(num_classes=10, stem=stem)
+    state = create_train_state(jax.random.PRNGKey(0), model, (1, 32, 32, 3),
+                               learning_rate=0.1)
+    step = make_train_step()   # already jitted with state donation
+    img = jnp.asarray(np.random.default_rng(0).normal(size=(8, 32, 32, 3)),
+                      jnp.float32)
+    lab = jnp.asarray(np.zeros(8), jnp.int32)
+    losses = []
+    for _ in range(5):
+        state, m = step(state, img, lab)
+        losses.append(float(m['loss']))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (stem, losses)
+
+
+def test_stem_output_shapes_agree():
+    imgs = jnp.ones((2, 32, 32, 3), jnp.float32)
+    logits = {}
+    for stem in ('conv7', 'space_to_depth'):
+        model = resnet.ResNetTiny(num_classes=10, stem=stem)
+        variables = model.init(jax.random.PRNGKey(0), imgs, train=False)
+        logits[stem] = model.apply(variables, imgs, train=False,
+                                   mutable=False)
+    assert logits['conv7'].shape == logits['space_to_depth'].shape == (2, 10)
+
+
+def test_stem_rejects_odd_input():
+    model = resnet.ResNetTiny(num_classes=10, stem='space_to_depth')
+    with pytest.raises(ValueError, match='even'):
+        model.init(jax.random.PRNGKey(0), jnp.ones((1, 33, 33, 3)),
+                   train=False)
+
+
+def test_unknown_stem_rejected():
+    model = resnet.ResNetTiny(num_classes=10, stem='nope')
+    with pytest.raises(ValueError, match='unknown stem'):
+        model.init(jax.random.PRNGKey(0), jnp.ones((1, 32, 32, 3)),
+                   train=False)
