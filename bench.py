@@ -1190,6 +1190,31 @@ def probe_now(workers, probe_timeouts):
     best recorded TPU result into its JSON, so a pool that was alive at
     minute 40 still produces the round's hardware number even if it is dead
     at minute 660. Run this early, mid, and late in the round."""
+    # Single-flight: overlapping probe-now runs would claim terminals and
+    # contend each other's measurements. A non-blocking flock HELD for the
+    # probe's duration is atomic (no check-then-write race) and the kernel
+    # releases it on ANY process death (no stale-pid modes) — the same
+    # mechanism _record_attempt uses for the artifact itself. Cron/loop
+    # callers can fire blindly; a skip is benign and exits 0.
+    import fcntl
+
+    lock = open(_OPPORTUNISTIC_PATH + '.probe_lock', 'w')
+    try:
+        fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        lock.close()
+        print(json.dumps({'probe_now':
+                          'skipped: another probe-now holds the lock'}))
+        return 0
+    try:
+        lock.write(str(os.getpid()))
+        lock.flush()
+        return _probe_now_locked(workers, probe_timeouts)
+    finally:
+        lock.close()
+
+
+def _probe_now_locked(workers, probe_timeouts):
     attempt = {'started_at': _utcnow(), 'probes': []}
     granted = False
     for t in probe_timeouts:

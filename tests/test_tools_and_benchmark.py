@@ -376,3 +376,28 @@ def test_bench_lm_child_smoke(tmp_path):
     assert out['lm_tokens_per_sec_per_chip'] > 0
     assert out['lm_config']['attention'] == 'dense'
     assert out['lm_final_loss'] > 0
+
+
+def test_probe_now_single_flight(tmp_path, monkeypatch, capsys):
+    """A held probe lock makes --probe-now skip benignly (exit 0) instead
+    of double-claiming a terminal; the lock dies with its holder, so a
+    fresh run proceeds and records an attempt."""
+    import fcntl
+    import json
+
+    bench = _import_bench(monkeypatch)
+    art = tmp_path / 'opp.json'
+    monkeypatch.setattr(bench, '_OPPORTUNISTIC_PATH', str(art))
+    holder = open(str(art) + '.probe_lock', 'w')
+    fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    try:
+        assert bench.probe_now(2, [1]) == 0      # benign skip
+        out = capsys.readouterr().out
+        assert 'holds the lock' in out
+        assert not art.exists()                   # no attempt recorded
+    finally:
+        holder.close()                            # releases the flock
+    rc = bench.probe_now(2, [1])
+    assert rc == 1                                # no terminal at 1s timeout
+    data = json.load(open(str(art)))
+    assert data['attempts'][-1]['outcome'].startswith('pool dead')
