@@ -27,7 +27,11 @@ class ArrowWorker(RowGroupWorkerBase):
     """Same args dict as PyDictWorker (see its docstring)."""
 
     def process(self, piece_index, worker_predicate=None, shuffle_row_drop_partition=None):
+        from petastorm_tpu.faults import maybe_inject, rowgroup_fault_key
+
         piece = self.args['row_groups'][piece_index]
+        maybe_inject('decode-corrupt',
+                     key=rowgroup_fault_key(piece.path, piece.row_group))
         table = self._load_table_cached(piece, worker_predicate)
         if table is None or table.num_rows == 0:
             return

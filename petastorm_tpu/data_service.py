@@ -188,12 +188,17 @@ class DataServer(object):
         and served-count, and queues the ring for re-send. The READER
         must separately be built from the snapshot's ``reader_state``
         (``serve_dataset(snapshot_resume=path)`` wires both).
+    :param bind_retry_policy: a custom
+        :class:`petastorm_tpu.retry.RetryPolicy` for the wildcard-bind
+        retry loop (derived control/rpc ports may clash with unrelated
+        sockets); defaults to a short jittered-backoff policy retrying
+        only ``zmq.ZMQError``.
     """
 
     def __init__(self, reader, bind, control_bind=None, rpc_bind=None,
                  sndhwm=4, auth_key=None, snapshot_path=None,
                  snapshot_every=16, snapshot_resume=None,
-                 replay_ring_chunks=None):
+                 replay_ring_chunks=None, bind_retry_policy=None):
         import zmq
 
         if not getattr(reader, 'batched_output', False):
@@ -211,11 +216,15 @@ class DataServer(object):
         # and either derived port may already be taken by an unrelated
         # socket — retry on a fresh wildcard port rather than flaking.
         # Explicit ports get exactly one attempt (the caller chose them).
+        # The loop itself is the shared retry.RetryPolicy (short jittered
+        # backoff so two servers racing for the same derived ports don't
+        # re-collide in lockstep); only zmq bind errors are retryable —
+        # _bind_once re-raises anything else untouched.
         wildcard = bind.rstrip().endswith(':*')
         derives_ports = control_bind is None or rpc_bind is None
         attempts = 16 if wildcard and derives_ports else 1
-        last_error = None
-        for _ in range(attempts):
+
+        def _bind_once():
             self._data_sock = self._context.socket(zmq.PUSH)
             self._ctrl_sock = None
             self._rpc_sock = None
@@ -233,19 +242,22 @@ class DataServer(object):
                                 else _next_port_endpoint(actual, 2))
                 self._rpc_sock = self._context.socket(zmq.REP)
                 self._rpc_sock.bind(rpc_endpoint)
-                last_error = None
-                break
-            except Exception as e:
+                return actual
+            except Exception:
                 # Close whatever bound so the ports don't stay held by the
-                # shared zmq context; only bind clashes are retryable.
+                # shared zmq context.
                 for sock in (self._data_sock, self._ctrl_sock, self._rpc_sock):
                     if sock is not None:
                         sock.close(linger=0)
-                if not isinstance(e, zmq.ZMQError):
-                    raise
-                last_error = e
-        if last_error is not None:
-            raise last_error
+                raise
+
+        if bind_retry_policy is None:
+            from petastorm_tpu.retry import RetryPolicy
+            bind_retry_policy = RetryPolicy(
+                max_attempts=attempts, base_delay_s=0.01, max_delay_s=0.25,
+                retry_exceptions=(zmq.ZMQError,))
+        actual = bind_retry_policy.call(_bind_once,
+                                        retry_call_name='data-service-bind')
         self.data_endpoint = _connectable(actual)
         self.control_endpoint = _connectable(
             self._ctrl_sock.getsockopt(zmq.LAST_ENDPOINT).decode())

@@ -1,7 +1,36 @@
 """Exception types for petastorm_tpu.
 
 Parity: reference ``petastorm/errors.py`` (NoDataAvailableError) plus decode
-errors from ``petastorm/utils.py:50``.
+errors from ``petastorm/utils.py:50``; the robustness layer (worker
+supervision + poison row-group quarantine) adds its own failure types.
+
+Exception hierarchy::
+
+    PetastormTpuError                     base class for everything we raise
+    ├── NoDataAvailableError              sharding/filtering left no row-groups
+    ├── SchemaError                       schema definition/inference problems
+    ├── DecodeFieldError                  a field value failed codec decode
+    ├── WorkerLostError                   a pool worker process died and the
+    │                                     respawn budget is exhausted
+    ├── RowGroupQuarantinedError          decode/IO failures exceeded the
+    │                                     reader's ``error_budget`` (or a
+    │                                     quarantine arrived with no budget
+    │                                     configured)
+    └── PodAbortError                     a pod peer died/desynced; defined
+                                          in ``parallel/pod_guard.py``
+
+Related errors defined elsewhere (not under the base class because they
+pre-date it or mirror stdlib types): ``hdfs.HdfsConnectError`` (IOError),
+``hdfs.MaxFailoversExceeded`` (RuntimeError),
+``retry.RetryDeadlineExceeded``, and the pool-protocol sentinels
+``workers.EmptyResultError`` / ``workers.TimeoutWaitingForResultError``.
+
+Failure-handling contract (see ``docs/failure_model.rst``): transient
+filesystem errors retry (``retry.RetryPolicy``); a dead worker process is
+respawned within a restart budget and its in-flight row-groups re-ventilated
+(``WorkerLostError`` past the budget); a row-group that keeps failing to
+decode is quarantined when the reader opts in via ``error_budget``
+(``RowGroupQuarantinedError`` once the budget is spent).
 """
 
 
@@ -25,3 +54,29 @@ class DecodeFieldError(PetastormTpuError):
 
 class SchemaError(PetastormTpuError):
     """Raised for schema definition / inference problems."""
+
+
+class WorkerLostError(PetastormTpuError):
+    """A worker process died mid-epoch and the pool's restart budget is
+    exhausted (or respawn itself failed). The message carries which workers
+    died, their exit codes, and the row-group items that were in flight."""
+
+
+class RowGroupQuarantinedError(PetastormTpuError):
+    """Poison row-group failures exceeded the reader's ``error_budget``.
+
+    ``quarantined`` holds the per-row-group records accumulated before the
+    budget ran out (also available from ``Reader.diagnostics()`` while the
+    budget holds).
+    """
+
+    def __init__(self, message, quarantined=None):
+        super(RowGroupQuarantinedError, self).__init__(message)
+        self.quarantined = list(quarantined or [])
+
+
+#: Failure classes a worker may *quarantine* (skip-and-record the row-group)
+#: instead of crashing the epoch, when the reader opted in via
+#: ``error_budget``. Deliberately narrow: data/IO problems qualify;
+#: programming errors (TypeError, KeyError, ...) always surface.
+QUARANTINE_EXCEPTION_TYPES = (DecodeFieldError, IOError, OSError)

@@ -1,0 +1,252 @@
+"""Deterministic, process-safe fault injection for the input pipeline.
+
+The pipeline's fault tolerance (worker respawn, poison row-group quarantine,
+retry backoff) is only trustworthy if it can be *proven* — so every failure
+mode it claims to survive has an injection point here, activated through the
+``PETASTORM_TPU_FAULTS`` environment variable. The env var is the activation
+channel on purpose: worker processes are **spawned** (never forked,
+``workers/exec_in_new_process.py``) and inherit the parent's environment, so
+a single setting pierces every process-pool boundary without any extra
+plumbing. ``tests/test_chaos.py`` drives every site.
+
+Spec syntax (semicolon-separated sites, colon-separated ``key=value`` params)::
+
+    PETASTORM_TPU_FAULTS="decode-corrupt:p=0.3:seed=7;fs-read-error:max=2"
+
+Sites and their effects when they fire:
+
+=================  ========================================================
+``fs-read-error``  raise ``IOError`` at the row-group read / filesystem call
+``fs-read-delay``  sleep ``delay`` seconds at the same points
+``decode-corrupt`` raise ``DecodeFieldError`` before codec decode
+``worker-kill``    ``SIGKILL`` the current (worker) process
+``queue-stall``    sleep ``delay`` seconds before publishing a result
+=================  ========================================================
+
+Params (all optional):
+
+* ``p`` — selection probability in ``[0, 1]`` (default 1.0). When the
+  injection site provides a **key** (e.g. ``"<path>:<row_group>"``), selection
+  is a pure hash of ``(seed, site, key)`` — the *same* keys fire in every
+  process, every epoch, and every ordering, which is what lets a test assert
+  "exactly those k row-groups were quarantined". Without a key, selection
+  draws from a per-process ``random.Random(seed ^ hash(site))`` stream.
+* ``seed`` — selection seed (default 0).
+* ``max`` — at most N fires per process (default unlimited).
+* ``delay`` — sleep seconds for the delay/stall sites (default 0.05).
+* ``token`` — filesystem path making the site fire **at most once across all
+  processes**: the first process to atomically create the token file
+  (``O_CREAT|O_EXCL``) fires, everyone else skips. This is how
+  ``worker-kill`` kills one worker of a pool instead of every respawn
+  (a per-process ``max`` cannot express that).
+
+Every fire logs a warning and emits an instant event on the global tracer
+(:func:`petastorm_tpu.trace.get_global_tracer`), so injected faults are
+visible on the same chrome://tracing timeline as the stalls they cause.
+"""
+
+import hashlib
+import logging
+import os
+import random
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = 'PETASTORM_TPU_FAULTS'
+
+#: Sites whose effect is a sleep rather than an error.
+_DELAY_SITES = ('fs-read-delay', 'queue-stall')
+
+_DEFAULT_DELAY_S = 0.05
+
+
+class FaultSpec(object):
+    """Parsed configuration of one injection site."""
+
+    def __init__(self, site, p=1.0, seed=0, max_fires=None, delay_s=_DEFAULT_DELAY_S,
+                 token=None):
+        self.site = site
+        self.p = float(p)
+        self.seed = int(seed)
+        self.max_fires = max_fires if max_fires is None else int(max_fires)
+        self.delay_s = float(delay_s)
+        self.token = token
+
+    @classmethod
+    def parse(cls, text):
+        """``"site:k=v:k=v"`` -> FaultSpec."""
+        parts = [p.strip() for p in text.strip().split(':') if p.strip()]
+        if not parts:
+            raise ValueError('empty fault spec')
+        site, kwargs = parts[0], {}
+        renames = {'p': 'p', 'seed': 'seed', 'max': 'max_fires',
+                   'delay': 'delay_s', 'token': 'token'}
+        for param in parts[1:]:
+            key, sep, value = param.partition('=')
+            if not sep or key not in renames:
+                raise ValueError(
+                    'bad fault param {!r} in {!r} (expected one of {})'.format(
+                        param, text, sorted(renames)))
+            kwargs[renames[key]] = value
+        return cls(site, **kwargs)
+
+    def __repr__(self):
+        return ('FaultSpec({s.site!r}, p={s.p}, seed={s.seed}, '
+                'max_fires={s.max_fires}, delay_s={s.delay_s}, '
+                'token={s.token!r})'.format(s=self))
+
+
+def _key_selected(seed, site, key, p):
+    """Deterministic (process-independent) selection: hash fraction < p."""
+    digest = hashlib.md5('{}:{}:{}'.format(seed, site, key).encode()).digest()
+    fraction = int.from_bytes(digest[:8], 'little') / float(1 << 64)
+    return fraction < p
+
+
+class FaultInjector(object):
+    """Holds the parsed specs plus per-process fire counters/streams."""
+
+    def __init__(self, specs):
+        self._specs = {s.site: s for s in specs}
+        self._fired = {}
+        self._streams = {}
+        # Injection sites run concurrently on ThreadPool worker threads;
+        # the max-fires budget and the per-site RNG stream are
+        # check-then-mutate state that must not race or the promised
+        # deterministic fire counts drift.
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_string(cls, text):
+        if not text or not text.strip():
+            return cls([])
+        return cls([FaultSpec.parse(part)
+                    for part in text.split(';') if part.strip()])
+
+    @property
+    def active_sites(self):
+        return sorted(self._specs)
+
+    def spec(self, site):
+        return self._specs.get(site)
+
+    def selected(self, site, key):
+        """Non-consuming deterministic predicate: would ``key`` be selected
+        at ``site``? (Tests use this to compute expected fault sets; ignores
+        ``max``/``token`` budgets.)"""
+        spec = self._specs.get(site)
+        if spec is None:
+            return False
+        return _key_selected(spec.seed, site, key, spec.p)
+
+    def _claim_token(self, spec):
+        try:
+            fd = os.open(spec.token, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        except OSError as e:  # unwritable token dir: fail open (no injection)
+            logger.warning('fault token %r not claimable: %s', spec.token, e)
+            return False
+        with os.fdopen(fd, 'w') as f:
+            f.write('pid={}\n'.format(os.getpid()))
+        return True
+
+    def should_fire(self, site, key=None):
+        """Decide-and-consume: True when ``site`` fires for this call."""
+        spec = self._specs.get(site)
+        if spec is None:
+            return False
+        with self._lock:
+            if spec.max_fires is not None \
+                    and self._fired.get(site, 0) >= spec.max_fires:
+                return False
+            if key is not None:
+                if not _key_selected(spec.seed, site, key, spec.p):
+                    return False
+            elif spec.p < 1.0:
+                stream = self._streams.get(site)
+                if stream is None:
+                    stream = self._streams[site] = random.Random(
+                        '{}:{}'.format(spec.seed, site))
+                if stream.random() >= spec.p:
+                    return False
+            if spec.token is not None and not self._claim_token(spec):
+                return False
+            self._fired[site] = self._fired.get(site, 0) + 1
+            return True
+
+    def inject(self, site, key=None):
+        """Fire ``site``'s effect if selected; no-op otherwise."""
+        if not self._specs:
+            return
+        if not self.should_fire(site, key):
+            return
+        spec = self._specs[site]
+        self._trace(site, key)
+        if site in _DELAY_SITES:
+            logger.warning('fault injection: %s key=%r sleeping %.3fs',
+                           site, key, spec.delay_s)
+            time.sleep(spec.delay_s)
+            return
+        if site == 'worker-kill':
+            logger.warning('fault injection: worker-kill SIGKILLing pid %d',
+                           os.getpid())
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+            return  # pragma: no cover - unreachable
+        logger.warning('fault injection: %s key=%r raising', site, key)
+        if site == 'decode-corrupt':
+            from petastorm_tpu.errors import DecodeFieldError
+            raise DecodeFieldError(
+                'injected fault: decode-corrupt (key={!r})'.format(key))
+        raise IOError('injected fault: {} (key={!r})'.format(site, key))
+
+    @staticmethod
+    def _trace(site, key):
+        from petastorm_tpu.trace import get_global_tracer
+        get_global_tracer().instant('fault:{}'.format(site), cat='fault')
+
+
+_cached = (None, None)  # (env string, FaultInjector)
+_cached_lock = threading.Lock()
+
+
+def get_injector():
+    """The process-wide injector, re-parsed whenever the env var changes
+    (tests flip ``PETASTORM_TPU_FAULTS`` between readers in one process).
+
+    Lock-free on the steady-state path: tuple rebinding is atomic, so the
+    common no-faults case is one env read + string compare + tuple read —
+    ``maybe_inject`` sits on per-result hot paths and must not serialize
+    decode threads on a global lock."""
+    global _cached
+    text = os.environ.get(ENV_VAR, '')
+    cached = _cached
+    if cached[0] == text:
+        return cached[1]
+    with _cached_lock:
+        if _cached[0] != text:
+            _cached = (text, FaultInjector.from_string(text))
+        return _cached[1]
+
+
+def maybe_inject(site, key=None):
+    """The one-liner injection sites call. Near-zero cost when inactive
+    (one env read + string compare)."""
+    get_injector().inject(site, key)
+
+
+def faults_active():
+    return bool(get_injector().active_sites)
+
+
+def rowgroup_fault_key(piece_path, row_group):
+    """Selection key for row-group-targeted sites.
+
+    Keyed by file *basename* + row-group index, not the absolute path: the
+    same logical dataset then draws the same fault set wherever it is
+    mounted (and tests computing expected sets stay deterministic across
+    tmp directories)."""
+    return '{}:{}'.format(os.path.basename(str(piece_path)), row_group)

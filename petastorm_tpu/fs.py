@@ -144,47 +144,70 @@ class RetryingFilesystemWrapper(object):
         'created', 'modified', 'size',
     ))
 
+    #: Hard cap on any single backoff sleep (jittered exponential growth
+    #: stops here; see ``retry.RetryPolicy``).
+    MAX_BACKOFF_S = 2.0
+
     def __init__(self, fs, retries=2, retry_exceptions=(IOError, OSError),
-                 backoff_s=0.1, on_retry=None, extra_retry_methods=()):
+                 backoff_s=0.1, on_retry=None, extra_retry_methods=(),
+                 retry_policy=None):
         """:param retries: extra attempts after the first failure (2 matches
             the reference's ``MAX_NAMENODES=2`` failover budget).
         :param on_retry: optional ``f(method_name, attempt, exception)`` hook
             (used by tests to count failovers, and handy for metrics).
         :param extra_retry_methods: additional method names to retry (e.g.
-            ``('rm',)`` when idempotent deletes are acceptable)."""
+            ``('rm',)`` when idempotent deletes are acceptable).
+        :param retry_policy: a fully custom :class:`petastorm_tpu.retry
+            .RetryPolicy`; when given it overrides ``retries``/
+            ``retry_exceptions``/``backoff_s``. The default policy uses
+            capped **full-jitter** exponential backoff — a pod of hosts that
+            all hit the same transient error must not retry in lockstep."""
+        from petastorm_tpu.retry import RetryPolicy
+
         self._fs = fs
-        self._retries = int(retries)
-        self._retry_exceptions = tuple(retry_exceptions)
-        self._backoff_s = backoff_s
         self._on_retry = on_retry
         self._retry_methods = self.RETRY_METHODS | frozenset(extra_retry_methods)
+        if retry_policy is not None:
+            self._policy = retry_policy
+        else:
+            self._policy = RetryPolicy(
+                max_attempts=int(retries) + 1,
+                base_delay_s=backoff_s or 0.0,
+                # Never clamp below what the caller explicitly asked for: a
+                # backoff_s raised above the default cap (e.g. for a
+                # rate-limited store) must still be reachable.
+                max_delay_s=max(self.MAX_BACKOFF_S, backoff_s or 0.0),
+                retry_exceptions=tuple(retry_exceptions),
+                on_retry=self._policy_on_retry)
+
+    def _policy_on_retry(self, name, attempt, exc, delay_s):
+        # Adapt the policy's 4-arg hook to this wrapper's documented 3-arg
+        # ``f(method_name, attempt, exception)`` contract.
+        if self._on_retry is not None:
+            self._on_retry(name, attempt, exc)
 
     @property
     def wrapped(self):
         return self._fs
+
+    @property
+    def retry_policy(self):
+        return self._policy
 
     def __getattr__(self, name):
         attr = getattr(self._fs, name)
         if name not in self._retry_methods or not callable(attr):
             return attr
 
+        def attempt_once(*args, **kwargs):
+            from petastorm_tpu.faults import maybe_inject
+            maybe_inject('fs-read-delay', key=name)
+            maybe_inject('fs-read-error', key=name)
+            return attr(*args, **kwargs)
+
         def call_with_retry(*args, **kwargs):
-            import time
-            last = None
-            for attempt in range(self._retries + 1):
-                try:
-                    return attr(*args, **kwargs)
-                except self._retry_exceptions as e:
-                    last = e
-                    if attempt == self._retries:
-                        break
-                    if self._on_retry is not None:
-                        self._on_retry(name, attempt, e)
-                    logger.warning('Filesystem %s() failed (%s); retry %d/%d',
-                                   name, e, attempt + 1, self._retries)
-                    if self._backoff_s:
-                        time.sleep(self._backoff_s * (2 ** attempt))
-            raise last
+            kwargs['retry_call_name'] = name
+            return self._policy.call(attempt_once, *args, **kwargs)
 
         return call_with_retry
 

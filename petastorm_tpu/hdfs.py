@@ -146,6 +146,13 @@ class HANamenodeFilesystem(object):
     #: Extra attempts after the first failure (reference namenode.py:152).
     MAX_FAILOVER_ATTEMPTS = 2
 
+    #: Backoff for the failover retry loop (``retry.RetryPolicy``): a
+    #: flapping namenode pair must not be hammered in a tight loop, so each
+    #: failover sleeps a full-jittered, capped exponential delay. The
+    #: reference failed over with no delay at all (``namenode.py:146-238``).
+    FAILOVER_BASE_DELAY_S = 0.05
+    FAILOVER_MAX_DELAY_S = 1.0
+
     def __init__(self, connect_fn, namenodes, failover_exceptions=(IOError, OSError)):
         """:param connect_fn: picklable ``host:port -> filesystem`` callable.
         :param namenodes: list of ``host:port`` strings (typically 2).
@@ -185,6 +192,14 @@ class HANamenodeFilesystem(object):
         raise HdfsConnectError('Unable to connect to any namenode of {}'
                                .format(self._namenodes))
 
+    def _failover_policy(self, on_retry):
+        from petastorm_tpu.retry import RetryPolicy
+        return RetryPolicy(max_attempts=self.MAX_FAILOVER_ATTEMPTS + 1,
+                           base_delay_s=self.FAILOVER_BASE_DELAY_S,
+                           max_delay_s=self.FAILOVER_MAX_DELAY_S,
+                           retry_exceptions=self._failover_exceptions,
+                           on_retry=on_retry)
+
     def __getattr__(self, name):
         if name.startswith('_'):
             raise AttributeError(name)
@@ -192,19 +207,33 @@ class HANamenodeFilesystem(object):
         if not callable(attr):
             return attr
 
+        def call_on_current(*args, **kwargs):
+            # Re-resolve on self._fs: a failover may have swapped it.
+            return getattr(self._fs, name)(*args, **kwargs)
+
         def call_with_failover(*args, **kwargs):
             failures = []
-            while len(failures) <= self.MAX_FAILOVER_ATTEMPTS:
-                try:
-                    # Re-resolve on self._fs: a failover may have swapped it.
-                    return getattr(self._fs, name)(*args, **kwargs)
-                except self._failover_exceptions as e:
-                    failures.append(e)
-                    if len(failures) <= self.MAX_FAILOVER_ATTEMPTS:
-                        logger.warning('HDFS %s() failed on %s (%s); failing over',
-                                       name, self.current_namenode, e)
-                        self._connect_next()
-            raise MaxFailoversExceeded(failures, self.MAX_FAILOVER_ATTEMPTS, name)
+
+            def on_retry(label, attempt, exc, delay_s):
+                failures.append(exc)
+                logger.warning('HDFS %s() failed on %s (%s); failing over '
+                               '(backoff %.3fs)', label, self.current_namenode,
+                               exc, delay_s)
+                self._connect_next()
+
+            policy = self._failover_policy(on_retry)
+            try:
+                kwargs['retry_call_name'] = 'hdfs:{}'.format(name)
+                return policy.call(call_on_current, *args, **kwargs)
+            except HdfsConnectError:
+                # _connect_next (run by the retry hook) found NO namenode
+                # accepting connections — that is "cluster unreachable", not
+                # "failover budget exhausted"; propagate it undisguised.
+                raise
+            except self._failover_exceptions as e:
+                failures.append(e)
+                raise MaxFailoversExceeded(failures, self.MAX_FAILOVER_ATTEMPTS,
+                                           name)
 
         return call_with_failover
 

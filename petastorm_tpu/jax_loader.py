@@ -648,11 +648,33 @@ class JaxLoader(object):
                         break
                     except queue.Full:
                         continue
+                if self._stop.is_set():
+                    return  # don't fetch another batch into a stopping pipe
         except Exception as e:  # noqa: BLE001 - surfaced to consumer
-            if not self._stop.is_set():
-                self._queue.put(e)
+            self._put_stop_aware(e)
             return
-        self._queue.put(_END)
+        self._put_stop_aware(_END)
+
+    def _put_stop_aware(self, obj):
+        # NEVER block indefinitely on the consumer queue: if the consumer is
+        # gone (stop() already drained and moved on) an unbounded put leaks
+        # this staging thread forever — it then holds reader/file objects
+        # whose teardown races its final reads (observed as a pyarrow
+        # segfault under load).
+        while not self._stop.is_set():
+            try:
+                self._queue.put(obj, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+        # Stopping: still attempt one non-blocking put — a consumer already
+        # parked in an untimed queue.get() (stop() called from another
+        # thread) needs the sentinel to wake up; if the queue is full the
+        # consumer isn't blocked and the exhausted flag ends it instead.
+        try:
+            self._queue.put_nowait(obj)
+        except queue.Full:
+            pass
 
     # -- consumer --------------------------------------------------------
 
@@ -770,6 +792,11 @@ class JaxLoader(object):
         wall time since the first fetch). A training loop with
         ``input_stall_frac`` above ~0.05 is input-bound (BASELINE.json's
         <5% target) — raise ``workers_count``/``prefetch`` or speed up decode.
+
+        ``reader_diagnostics`` carries the reader's robustness state through
+        to the training loop: ``worker_respawns`` (dead pool workers that
+        were respawned) and ``quarantined_rowgroups`` (poison row-groups
+        skipped under ``error_budget`` — see ``docs/failure_model.rst``).
         """
         elapsed = (time.perf_counter() - self._first_get_t
                    if self._first_get_t is not None else 0.0)

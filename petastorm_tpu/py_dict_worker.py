@@ -33,9 +33,13 @@ class PyDictWorker(RowGroupWorkerBase):
     _prefer_native_parquet = False  # pyarrow is faster for the to-rows path
 
     def process(self, piece_index, worker_predicate=None, shuffle_row_drop_partition=None):
+        from petastorm_tpu.faults import maybe_inject, rowgroup_fault_key
+
         piece = self.args['row_groups'][piece_index]
         schema = self.args['schema']
         ngram = self.args['ngram']
+        maybe_inject('decode-corrupt',
+                     key=rowgroup_fault_key(piece.path, piece.row_group))
 
         if worker_predicate is not None:
             rows = self._load_rows_with_predicate(piece, worker_predicate)

@@ -103,11 +103,19 @@ def make_reader(dataset_url,
                 storage_options=None,
                 shm_result_ring_bytes=None,
                 resume_state=None,
-                pool_profiling=False):
+                pool_profiling=False,
+                error_budget=None):
     """Reader for datasets materialized with petastorm_tpu codecs.
 
     Parity: reference ``petastorm/reader.py:50-174``. Rejects plain Parquet
     stores (use :func:`make_batch_reader`) — reference ``reader.py:131-135``.
+
+    ``error_budget`` (opt-in) enables poison row-group quarantine: decode/IO
+    failures inside workers skip-and-record the offending row-group
+    (surfaced via ``Reader.diagnostics()['quarantined_rowgroups']``) instead
+    of aborting the epoch, raising ``RowGroupQuarantinedError`` only once
+    the budget — an int count or a float fraction of the epoch's row-group
+    items — is exhausted. See ``docs/failure_model.rst``.
     """
     store = ParquetStore(dataset_url, storage_options)
     try:
@@ -140,7 +148,8 @@ def make_reader(dataset_url,
                   seed=seed, predicate=predicate, rowgroup_selector=rowgroup_selector,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec,
-                  resume_state=resume_state)
+                  resume_state=resume_state,
+                  error_budget=error_budget)
 
 
 def make_tensor_reader(dataset_url,
@@ -160,7 +169,8 @@ def make_tensor_reader(dataset_url,
                        shm_result_ring_bytes=None,
                        resume_state=None,
                        pool_profiling=False,
-                       shuffle_rows_in_chunk=False):
+                       shuffle_rows_in_chunk=False,
+                       error_budget=None):
     """Decoded-columnar reader: the TPU hot path (no reference equivalent).
 
     Like :func:`make_reader` (codecs run, values are decoded) but columnar
@@ -237,7 +247,8 @@ def make_tensor_reader(dataset_url,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec,
                   resume_state=resume_state,
-                  shuffle_rows_in_chunk=shuffle_rows_in_chunk)
+                  shuffle_rows_in_chunk=shuffle_rows_in_chunk,
+                  error_budget=error_budget)
 
 
 def make_batch_reader(dataset_url,
@@ -257,7 +268,8 @@ def make_batch_reader(dataset_url,
                       shm_result_ring_bytes=None,
                       resume_state=None,
                       pool_profiling=False,
-                      shuffle_rows_in_chunk=False):
+                      shuffle_rows_in_chunk=False,
+                      error_budget=None):
     """Columnar batch reader for **any** Parquet store (no codecs needed).
 
     Parity: reference ``petastorm/reader.py:177-289``. Warns when pointed at a
@@ -296,7 +308,102 @@ def make_batch_reader(dataset_url,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec,
                   resume_state=resume_state,
-                  shuffle_rows_in_chunk=shuffle_rows_in_chunk)
+                  shuffle_rows_in_chunk=shuffle_rows_in_chunk,
+                  error_budget=error_budget)
+
+
+class _CallableDict(dict):
+    """Dict that also answers ``()`` returning itself.
+
+    ``Reader.diagnostics`` predates the failure-model work as a property
+    (``reader.diagnostics['x']``); the quarantine API documents the call
+    form (``reader.diagnostics()['quarantined_rowgroups']``). Supporting
+    both costs three lines and breaks nobody.
+    """
+
+    def __call__(self):
+        return self
+
+
+class QuarantineLog(object):
+    """Consumer-side record of quarantined row-group items + error budget.
+
+    The budget counts **unique** quarantined ventilated items (row-group x
+    drop-partition): a stably-poison row-group consumes one unit no matter
+    how many epochs re-ventilate it (re-quarantines bump the record's
+    ``occurrences`` instead), so a multi-epoch or infinite-epoch run doesn't
+    burn its whole budget on the same bad bytes. ``budget`` may be:
+
+    * ``None`` — quarantine disabled (workers raise, epoch aborts: the
+      pre-existing behavior);
+    * an int >= 0 — that many distinct items are absorbed; one more raises;
+    * a float in (0, 1) — fraction of the epoch's ventilated items.
+    """
+
+    def __init__(self, budget, total_items, row_groups):
+        import threading
+        self._lock = threading.Lock()
+        self._row_groups = row_groups
+        self._records = []
+        self._by_item = {}
+        self.enabled = budget is not None
+        if budget is None:
+            self._max = 0
+        elif isinstance(budget, bool):
+            raise ValueError('error_budget must be None, an int >= 0, or a '
+                             'fraction in (0, 1); got {!r}'.format(budget))
+        elif isinstance(budget, int) and budget >= 0:
+            self._max = budget
+        elif isinstance(budget, float) and 0 < budget < 1:
+            self._max = int(budget * total_items)
+        else:
+            # Floats >= 1 are ambiguous (1.0 could mean "100% of items" or
+            # "one item") — refuse rather than guess.
+            raise ValueError(
+                'error_budget must be None, an int >= 0, or a fraction in '
+                '(0, 1); got {!r}'.format(budget))
+        self.budget = self._max
+
+    def record(self, quarantine):
+        """Pool sink: record the quarantine; raise once the budget is spent."""
+        from petastorm_tpu.errors import RowGroupQuarantinedError
+
+        entry = {'worker_id': quarantine.worker_id,
+                 'error': quarantine.error,
+                 'occurrences': 1}
+        item = quarantine.item if isinstance(quarantine.item, dict) else {}
+        piece_index = item.get('piece_index')
+        entry['piece_index'] = piece_index
+        if 'shuffle_row_drop_partition' in item:
+            entry['shuffle_row_drop_partition'] = item['shuffle_row_drop_partition']
+        if piece_index is not None and 0 <= piece_index < len(self._row_groups):
+            piece = self._row_groups[piece_index]
+            entry['path'] = piece.path
+            entry['row_group'] = piece.row_group
+        item_key = (piece_index, item.get('shuffle_row_drop_partition'))
+        with self._lock:
+            known = self._by_item.get(item_key) if piece_index is not None else None
+            if known is not None:
+                known['occurrences'] += 1
+                return  # same poison item, another epoch: budget already spent
+            self._records.append(entry)
+            if piece_index is not None:
+                self._by_item[item_key] = entry
+            over_budget = len(self._records) > self._max
+            snapshot = list(self._records)
+        logger.warning('Quarantined row-group %s (%d/%d of error budget used)',
+                       entry.get('path', piece_index), len(snapshot), self._max)
+        if over_budget:
+            raise RowGroupQuarantinedError(
+                'error_budget exhausted: {} row-group item(s) quarantined, '
+                'budget is {}. Latest: {} ({})'.format(
+                    len(snapshot), self._max, entry.get('path', piece_index),
+                    entry['error']),
+                quarantined=snapshot)
+
+    def snapshot(self):
+        with self._lock:
+            return [dict(e) for e in self._records]
 
 
 def _describe_filter(obj):
@@ -325,7 +432,7 @@ class Reader(object):
                  seed=None, predicate=None, rowgroup_selector=None,
                  num_epochs=1, cur_shard=None, shard_count=None,
                  cache=None, transform_spec=None, ngram=None, resume_state=None,
-                 shuffle_rows_in_chunk=False):
+                 shuffle_rows_in_chunk=False, error_budget=None):
         self._store = store
         self.stored_schema = stored_schema
         self.ngram = ngram
@@ -414,6 +521,10 @@ class Reader(object):
             'decode_threads': max(1, (os.cpu_count() or 4) // max(1, self._pool_workers_count())),
             'shuffle_rows_in_chunk': bool(shuffle_rows_in_chunk),
             'shuffle_seed': seed,
+            # Poison row-group quarantine (docs/failure_model.rst): when the
+            # reader carries an error budget, workers skip-and-report
+            # decode/IO failures instead of crashing the epoch.
+            'quarantine_poison_rowgroups': error_budget is not None,
         }
 
         items = []
@@ -423,6 +534,11 @@ class Reader(object):
                               'worker_predicate': worker_predicate,
                               'shuffle_row_drop_partition': (
                                   drop_partition, shuffle_row_drop_partitions)})
+
+        self._quarantine_log = QuarantineLog(error_budget, len(items),
+                                             self._row_groups)
+        if error_budget is not None:
+            self._workers_pool.quarantine_sink = self._quarantine_log.record
 
         self._ventilator = ConcurrentVentilator(
             ventilate_fn=None,  # bound by pool.start
@@ -583,7 +699,14 @@ class Reader(object):
 
     @property
     def diagnostics(self):
-        return self._workers_pool.diagnostics
+        """Pool health + quarantine state. Usable both as a mapping
+        (``reader.diagnostics['x']``) and called
+        (``reader.diagnostics()['quarantined_rowgroups']``)."""
+        diag = _CallableDict(self._workers_pool.diagnostics)
+        diag['quarantined_rowgroups'] = self._quarantine_log.snapshot()
+        diag['error_budget'] = (self._quarantine_log.budget
+                                if self._quarantine_log.enabled else None)
+        return diag
 
     def __enter__(self):
         return self

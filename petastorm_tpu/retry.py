@@ -1,0 +1,159 @@
+"""Unified retry policy: exponential backoff, full jitter, cap, deadline.
+
+Before this module the codebase had three hand-rolled retry loops with three
+different behaviors:
+
+* ``fs.RetryingFilesystemWrapper`` — pure ``backoff_s * 2**attempt`` sleeps
+  with no jitter and no cap, which on a TPU pod synchronizes retry storms
+  across hosts (every host that saw the same transient GCS error retries at
+  the same instant, re-creating the overload that caused the error);
+* ``hdfs.HANamenodeFilesystem`` — immediate namenode failover with no
+  backoff at all (a flapping namenode pair gets hammered in a tight loop);
+* ``data_service.DataServer`` — a fixed-attempt bind loop with no delay.
+
+All three now delegate to :class:`RetryPolicy`, which implements the
+standard *capped exponential backoff with full jitter* (the AWS
+architecture-blog recipe: ``sleep = uniform(0, min(cap, base * 2**attempt))``)
+plus an overall deadline and an ``on_retry`` observability hook. tf.data
+service and MinatoLoader (PAPERS.md) both treat transient input-tier failure
+as a first-class event; a single policy object makes the behavior uniform,
+testable (inject a fake ``sleep``/``rng``) and tunable in one place.
+
+Module-level counters record every retry so ``bench.py`` can surface
+retry-rate regressions in BENCH_*.json.
+"""
+
+import logging
+import random
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+_counters_lock = threading.Lock()
+_retry_counters = {}
+
+
+def _count_retry(name):
+    with _counters_lock:
+        _retry_counters[name] = _retry_counters.get(name, 0) + 1
+
+
+def retry_counters():
+    """Snapshot of ``{loop_name: retries_this_process}`` (bench telemetry)."""
+    with _counters_lock:
+        return dict(_retry_counters)
+
+
+def reset_retry_counters():
+    with _counters_lock:
+        _retry_counters.clear()
+
+
+class RetryDeadlineExceeded(Exception):
+    """The overall ``deadline_s`` elapsed before the call succeeded.
+
+    Carries the last underlying exception as ``__cause__``.
+    """
+
+
+class RetryPolicy(object):
+    """Capped exponential backoff with full jitter.
+
+    The policy object is stateless across calls (safe to share between
+    threads and reuse for many calls); per-call state lives on the stack.
+
+    :param max_attempts: total attempts, including the first (>= 1).
+    :param base_delay_s: backoff base; the attempt-``k`` retry sleeps
+        ``uniform(0, min(max_delay_s, base_delay_s * 2**k))`` (full jitter).
+    :param max_delay_s: hard cap on any single sleep.
+    :param deadline_s: overall wall-clock budget across all attempts; when
+        the next sleep would cross it the call fails with
+        :class:`RetryDeadlineExceeded` (chaining the last error).
+    :param jitter: ``'full'`` (default) or ``'none'`` (deterministic sleeps —
+        only for tests; production jitter prevents synchronized retry storms).
+    :param retry_exceptions: exception classes that are retried; anything
+        else propagates immediately.
+    :param on_retry: ``f(name, attempt, exception, delay_s)`` called before
+        each sleep (attempt is 0-based). Used by tests and metrics.
+    :param sleep: injectable sleep function (tests).
+    :param rng: injectable ``random.Random`` (tests); defaults to a private
+        seeded-from-os instance so concurrent policies don't share state.
+    """
+
+    def __init__(self, max_attempts=3, base_delay_s=0.1, max_delay_s=5.0,
+                 deadline_s=None, jitter='full',
+                 retry_exceptions=(IOError, OSError), on_retry=None,
+                 sleep=time.sleep, rng=None):
+        if max_attempts < 1:
+            raise ValueError('max_attempts must be >= 1, got {}'.format(max_attempts))
+        if jitter not in ('full', 'none'):
+            raise ValueError("jitter must be 'full' or 'none', got {!r}".format(jitter))
+        self.max_attempts = int(max_attempts)
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.deadline_s = deadline_s
+        self.jitter = jitter
+        self.retry_exceptions = tuple(retry_exceptions)
+        self.on_retry = on_retry
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+
+    def compute_delay(self, attempt):
+        """Sleep seconds before retry number ``attempt`` (0-based). Never
+        exceeds ``max_delay_s``."""
+        cap = min(self.max_delay_s, self.base_delay_s * (2 ** attempt))
+        if cap <= 0:
+            return 0.0
+        if self.jitter == 'full':
+            return self._rng.uniform(0, cap)
+        return cap
+
+    def call(self, fn, *args, **kwargs):
+        """Run ``fn(*args, **kwargs)`` under this policy.
+
+        Keyword-only extras (consumed, not forwarded; prefixed so they can
+        never collide with the wrapped function's own kwargs):
+
+        * ``retry_call_name`` — label for logs/counters/hooks (default: fn
+          name);
+        * ``retry_call_hook`` — per-call override of the instance
+          ``on_retry`` hook.
+
+        Raises the last underlying exception once attempts are exhausted, or
+        :class:`RetryDeadlineExceeded` when the deadline cuts retries short.
+        """
+        name = kwargs.pop('retry_call_name', None) or getattr(fn, '__name__', 'call')
+        on_retry = kwargs.pop('retry_call_hook', None) or self.on_retry
+        start = time.monotonic()
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_exceptions as e:
+                if attempt + 1 >= self.max_attempts:
+                    raise
+                delay = self.compute_delay(attempt)
+                if self.deadline_s is not None:
+                    elapsed = time.monotonic() - start
+                    if elapsed + delay > self.deadline_s:
+                        raise RetryDeadlineExceeded(
+                            '{}: retry deadline of {}s exhausted after {} '
+                            'attempts'.format(name, self.deadline_s,
+                                              attempt + 1)) from e
+                _count_retry(name)
+                if on_retry is not None:
+                    on_retry(name, attempt, e, delay)
+                logger.warning('%s failed (%s); retry %d/%d in %.3fs',
+                               name, e, attempt + 1, self.max_attempts - 1,
+                               delay)
+                if delay:
+                    self._sleep(delay)
+                attempt += 1
+
+    def wrap(self, fn, name=None):
+        """``fn`` -> retried ``fn`` (same signature)."""
+        def wrapped(*args, **kwargs):
+            kwargs['retry_call_name'] = name or getattr(fn, '__name__', 'call')
+            return self.call(fn, *args, **kwargs)
+        return wrapped

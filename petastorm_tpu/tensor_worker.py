@@ -65,8 +65,12 @@ class TensorWorker(RowGroupWorkerBase):
     """
 
     def process(self, piece_index, worker_predicate=None, shuffle_row_drop_partition=None):
+        from petastorm_tpu.faults import maybe_inject, rowgroup_fault_key
+
         piece = self.args['row_groups'][piece_index]
         schema = self.args['schema']
+        maybe_inject('decode-corrupt',
+                     key=rowgroup_fault_key(piece.path, piece.row_group))
         timings = {}
 
         def load():

@@ -80,6 +80,25 @@ class Tracer(object):
         return path
 
 
+_global_tracer = None
+
+
+def set_global_tracer(tracer):
+    """Install a process-wide tracer that instrumentation points with no
+    Tracer argument (e.g. fault-injection sites in ``faults.py``) report to.
+    Pass ``None`` to reset. Returns the previous global tracer."""
+    global _global_tracer
+    previous = _global_tracer
+    _global_tracer = tracer
+    return previous
+
+
+def get_global_tracer():
+    """The tracer installed by :func:`set_global_tracer`, or a shared
+    :class:`NullTracer` when none is set (call sites never branch)."""
+    return _global_tracer if _global_tracer is not None else _NULL_TRACER
+
+
 class _NullSpan(object):
     def __enter__(self):
         return self
@@ -98,3 +117,6 @@ class NullTracer(object):
 
     def instant(self, name, cat='pipeline'):
         pass
+
+
+_NULL_TRACER = NullTracer()

@@ -3,6 +3,14 @@
 Parity: reference ``petastorm/workers_pool/`` — sentinel messages
 (``workers_pool/__init__.py:16-26``), ``WorkerBase`` protocol
 (``worker_base.py:18-35``), thread/process/dummy pools, ventilator.
+
+Robustness extensions (no reference equivalent): item-processed acks carry
+``(worker_id, seq)`` so the process pools can supervise workers and
+re-ventilate a dead worker's in-flight items (``supervision.py``), and
+workers may *quarantine* a poison row-group (skip-and-record instead of
+crashing the epoch) when the reader opted in via ``error_budget`` — the
+:class:`RowGroupQuarantined` control message flows back to the consumer,
+which enforces the budget.
 """
 
 
@@ -15,7 +23,86 @@ class TimeoutWaitingForResultError(Exception):
 
 
 class VentilatedItemProcessedMessage(object):
-    """Sentinel a worker publishes after fully processing one ventilated item."""
+    """Sentinel a worker publishes after fully processing one ventilated item.
+
+    ``worker_id``/``seq`` identify which worker finished which dispatched
+    item (``None`` from pools that don't track assignment, e.g. threads).
+    """
+
+    def __init__(self, worker_id=None, seq=None):
+        self.worker_id = worker_id
+        self.seq = seq
+
+
+class RowGroupQuarantined(object):
+    """Control message: a worker skipped a poison ventilated item.
+
+    Published INSTEAD of crashing when the reader opted in via
+    ``error_budget`` and the failure is one of
+    ``errors.QUARANTINE_EXCEPTION_TYPES``. Counts as an item-processed ack
+    for in-flight bookkeeping; the consumer side routes it to the pool's
+    ``quarantine_sink`` (the reader's budget), which raises
+    ``RowGroupQuarantinedError`` once the budget is spent.
+
+    ``item`` is a pickle-safe summary of the ventilated kwargs (the raw
+    kwargs may close over un-picklable predicates/transforms).
+    """
+
+    def __init__(self, worker_id, item, error, traceback_str, seq=None):
+        self.worker_id = worker_id
+        self.item = item
+        self.error = error
+        self.traceback_str = traceback_str
+        self.seq = seq
+
+
+def _summarize_item(args, kwargs):
+    """Pickle/JSON-safe description of a ventilated item."""
+    summary = {}
+    if isinstance(kwargs, dict):
+        for key in ('piece_index', 'shuffle_row_drop_partition'):
+            value = kwargs.get(key)
+            if isinstance(value, (int, str)) or (
+                    isinstance(value, tuple)
+                    and all(isinstance(v, int) for v in value)):
+                summary[key] = value
+    if not summary and args:
+        summary['args'] = repr(args)[:120]
+    return summary
+
+
+def quarantine_record_for(worker, exc, args, kwargs):
+    """``RowGroupQuarantined`` for this failure, or ``None`` when it must
+    surface as a fatal error (reader didn't opt in, or the exception class
+    is not a data/IO failure)."""
+    worker_args = getattr(worker, 'args', None)
+    if not (isinstance(worker_args, dict)
+            and worker_args.get('quarantine_poison_rowgroups')):
+        return None
+    from petastorm_tpu.errors import QUARANTINE_EXCEPTION_TYPES
+    if not isinstance(exc, QUARANTINE_EXCEPTION_TYPES):
+        return None
+    import traceback
+    return RowGroupQuarantined(
+        worker_id=getattr(worker, 'worker_id', None),
+        item=_summarize_item(args, kwargs),
+        error='{}: {}'.format(type(exc).__name__, exc),
+        traceback_str=traceback.format_exc())
+
+
+def deliver_quarantine(pool, record):
+    """Route a quarantine record to the pool's sink; raise when no budget is
+    configured (a record with no sink means a worker quarantined something
+    the consumer never opted into — surface it loudly)."""
+    sink = getattr(pool, 'quarantine_sink', None)
+    if sink is None:
+        from petastorm_tpu.errors import RowGroupQuarantinedError
+        raise RowGroupQuarantinedError(
+            'worker {} quarantined {} ({}) but no quarantine sink/error '
+            'budget is configured'.format(record.worker_id, record.item,
+                                          record.error),
+            quarantined=[record])
+    sink(record)
 
 
 class WorkerBase(object):

@@ -5,10 +5,12 @@ debugging, deterministic tests, and profiler-friendly in-main-thread
 execution (``dummy_pool.py:24-25``).
 """
 
+import threading
 from collections import deque
 
-from petastorm_tpu.workers import (EmptyResultError,
-                                   VentilatedItemProcessedMessage)
+from petastorm_tpu.workers import (EmptyResultError, RowGroupQuarantined,
+                                   VentilatedItemProcessedMessage,
+                                   deliver_quarantine, quarantine_record_for)
 
 
 class DummyPool(object):
@@ -24,6 +26,14 @@ class DummyPool(object):
         self._worker = None
         self._ventilator = None
         self._stopped = False
+        # Serializes item processing (consumer thread) against worker
+        # shutdown (often another thread, e.g. JaxLoader.stop() while its
+        # staging thread is mid-decode): closing parquet file handles under
+        # an in-flight read segfaults inside pyarrow.
+        self._work_lock = threading.Lock()
+        self._shutdown_done = False
+        #: Set by the Reader when ``error_budget`` is enabled.
+        self.quarantine_sink = None
 
     def start(self, worker_class, worker_args=None, ventilator=None):
         self._worker = worker_class(0, self._results.append, worker_args)
@@ -38,11 +48,20 @@ class DummyPool(object):
 
     def get_results(self):
         while True:
+            if self._stopped and not self._results:
+                # Stop requested from another thread: don't start decoding
+                # further items whose file handles are about to be closed.
+                raise EmptyResultError()
             while self._results:
                 result = self._results.popleft()
                 if isinstance(result, VentilatedItemProcessedMessage):
                     if self._ventilator is not None:
                         self._ventilator.processed_item()
+                    continue
+                if isinstance(result, RowGroupQuarantined):
+                    if self._ventilator is not None:
+                        self._ventilator.processed_item()
+                    deliver_quarantine(self, result)
                     continue
                 if isinstance(result, Exception):
                     raise result
@@ -71,20 +90,35 @@ class DummyPool(object):
                 continue
             args, kwargs = self._ventilated.popleft()
             try:
-                self._worker.process(*args, **kwargs)
+                with self._work_lock:
+                    if self._shutdown_done:
+                        raise EmptyResultError()
+                    self._worker.process(*args, **kwargs)
                 self._results.append(VentilatedItemProcessedMessage())
+            except EmptyResultError:
+                raise
             except Exception as e:  # noqa: BLE001 - parity: exceptions surface to consumer
-                self._results.append(e)
+                record = quarantine_record_for(self._worker, e, args, kwargs)
+                self._results.append(record if record is not None else e)
 
     def stop(self):
         self._stopped = True
         if self._ventilator is not None:
             self._ventilator.stop()
-        if self._worker is not None:
-            self._worker.shutdown()
+        # Worker shutdown (closes parquet handles) waits for any in-flight
+        # process() call on the consuming thread — see _work_lock.
+        self._shutdown_worker()
+
+    def _shutdown_worker(self):
+        if self._worker is None:
+            return
+        with self._work_lock:
+            if not self._shutdown_done:
+                self._shutdown_done = True
+                self._worker.shutdown()
 
     def join(self):
-        pass
+        self._shutdown_worker()
 
     @property
     def diagnostics(self):

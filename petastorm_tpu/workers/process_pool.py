@@ -11,6 +11,22 @@ shutdown rebroadcasting FINISHED (``:287-304``).
 
 On TPU-VM hosts this pool sidesteps the GIL for CPU-bound python decode;
 spawning keeps libtpu/JAX client state out of data workers.
+
+Robustness extensions over the reference (``supervision.py`` has the full
+rationale):
+
+* **per-worker PUSH sockets** (the reference shares one PUSH across all
+  workers): round-robin dispatch with *known* assignment, so the pool can
+  tell which row-group items a dead worker took down with it;
+* **steady-state supervision**: ``get_results`` polls worker liveness,
+  respawns a dead worker within ``max_worker_restarts``, re-ventilates
+  its in-flight items (seq-deduped — exactly-once delivery), and raises
+  :class:`~petastorm_tpu.errors.WorkerLostError` past the budget;
+* **poison row-group quarantine**: a worker skips-and-reports a failing
+  item instead of crashing when the reader opted in (``workers/__init__``);
+* socket writes are confined to the consumer thread (ventilation goes
+  through per-worker pending queues) so respawn can swap sockets without
+  racing the ventilator thread.
 """
 
 import logging
@@ -22,10 +38,15 @@ import time
 import dill
 import zmq
 
-from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
-                                   VentilatedItemProcessedMessage)
+from petastorm_tpu.workers import (EmptyResultError, RowGroupQuarantined,
+                                   TimeoutWaitingForResultError,
+                                   VentilatedItemProcessedMessage,
+                                   quarantine_record_for)
 from petastorm_tpu.workers.exec_in_new_process import exec_in_new_process
 from petastorm_tpu.workers.serializers import PickleSerializer
+from petastorm_tpu.workers.supervision import (DEFAULT_MAX_WORKER_RESTARTS,
+                                               InFlightRegistry,
+                                               SupervisedPoolMixin)
 
 logger = logging.getLogger(__name__)
 
@@ -43,23 +64,44 @@ class _WorkerError(object):
         self.traceback_str = traceback_str
 
 
-class ProcessPool(object):
+class ProcessPool(SupervisedPoolMixin):
+    _pool_kind = 'Worker'
+
     def __init__(self, workers_count, results_queue_size=50, serializer=None,
-                 zmq_copy_buffers=True):
+                 zmq_copy_buffers=True,
+                 max_worker_restarts=DEFAULT_MAX_WORKER_RESTARTS):
+        """:param max_worker_restarts: total worker respawns tolerated over
+        the pool's lifetime before a further death raises
+        :class:`~petastorm_tpu.errors.WorkerLostError`."""
         self._workers_count = workers_count
         self._results_queue_size = results_queue_size
         self._serializer = serializer or PickleSerializer()
         self._zmq_copy_buffers = zmq_copy_buffers
+        self._init_supervision(max_worker_restarts)
 
         self._context = None
-        self._ventilator_send = None
+        self._worker_sockets = []
+        self._worker_ports = []
+        self._pending_sends = []
+        self._send_lock = threading.Lock()
         self._control_sender = None
         self._results_receiver = None
+        self._control_port = None
+        self._results_port = None
         self._processes = []
+        self._worker_class = None
+        self._worker_args = None
         self._ventilator = None
         self._ventilated_unprocessed = 0
         self._count_lock = threading.Lock()
         self._stopped = False
+        self._registry = None
+        # Data/error messages pulled off the results socket during a
+        # dead-worker rescue drain; served (in order) before fresh polls.
+        self._rescued = []
+        #: Set by the Reader when ``error_budget`` is enabled; receives
+        #: RowGroupQuarantined records (and raises when the budget is spent).
+        self.quarantine_sink = None
 
     @property
     def workers_count(self):
@@ -69,21 +111,23 @@ class ProcessPool(object):
         if self._processes:
             raise RuntimeError('ProcessPool already started')
         self._context = zmq.Context()
+        self._worker_class = worker_class
+        self._worker_args = worker_args
+        self._registry = InFlightRegistry(self._workers_count)
 
-        self._ventilator_send = self._context.socket(zmq.PUSH)
-        ventilator_port = self._ventilator_send.bind_to_random_port('tcp://127.0.0.1')
         self._control_sender = self._context.socket(zmq.PUB)
-        control_port = self._control_sender.bind_to_random_port('tcp://127.0.0.1')
+        self._control_port = self._control_sender.bind_to_random_port('tcp://127.0.0.1')
         self._results_receiver = self._context.socket(zmq.PULL)
         self._results_receiver.set(zmq.RCVHWM, self._results_queue_size)
-        results_port = self._results_receiver.bind_to_random_port('tcp://127.0.0.1')
+        self._results_port = self._results_receiver.bind_to_random_port('tcp://127.0.0.1')
 
         for worker_id in range(self._workers_count):
-            process = exec_in_new_process(
-                _worker_bootstrap, worker_class, worker_id, worker_args,
-                ventilator_port, control_port, results_port,
-                type(self._serializer), os.getpid())
-            self._processes.append(process)
+            sock = self._context.socket(zmq.PUSH)
+            port = sock.bind_to_random_port('tcp://127.0.0.1')
+            self._worker_sockets.append(sock)
+            self._worker_ports.append(port)
+            self._pending_sends.append([])
+            self._processes.append(self._spawn_worker(worker_id, port))
 
         # Startup barrier (parity: process_pool.py:208-214).
         started = 0
@@ -93,49 +137,161 @@ class ProcessPool(object):
                 self.stop()
                 raise RuntimeError('Timed out waiting for {} worker processes to start '
                                    '({} started)'.format(self._workers_count, started))
-            if self._results_receiver.poll(1000):
+            if self._rescued:
+                # A death during startup drains the results socket; peers'
+                # startup acks land in the stash and must still count.
+                message = self._rescued.pop(0)
+            elif self._results_receiver.poll(1000):
                 message = self._results_receiver.recv_multipart()
-                control = pickle.loads(message[0])
-                if control == _WORKER_STARTED:
-                    started += 1
+            else:
+                self._check_worker_health(force=True)
+                continue
+            control = pickle.loads(message[0])
+            if control == _WORKER_STARTED:
+                started += 1
+            elif isinstance(control, _WorkerError):
+                self.stop()
+                self.join()
+                logger.error('Worker traceback:\n%s', control.traceback_str)
+                raise control.exception
 
         self._ventilator = ventilator
         if ventilator is not None:
             ventilator._ventilate_fn = self.ventilate
             ventilator.start()
 
+    def _spawn_worker(self, worker_id, ventilator_port):
+        return exec_in_new_process(
+            _worker_bootstrap, self._worker_class, worker_id, self._worker_args,
+            ventilator_port, self._control_port, self._results_port,
+            type(self._serializer), os.getpid())
+
     def ventilate(self, *args, **kwargs):
         with self._count_lock:
             self._ventilated_unprocessed += 1
+        seq, slot = self._registry.assign((args, kwargs))
         # dill, not pickle: ventilated items may close over lambdas
         # (predicates/transforms), same as worker_args in exec_in_new_process.
-        self._ventilator_send.send(dill.dumps((args, kwargs)))
+        # No socket write here — ventilate() runs on the ventilator thread,
+        # but the per-worker sockets belong to the consumer thread (which
+        # may close/recreate them on respawn). The consumer flushes pending
+        # sends on every get_results poll iteration.
+        self._enqueue_work(slot, dill.dumps((seq, args, kwargs)))
+
+    def _enqueue_work(self, slot, payload):
+        with self._send_lock:
+            self._pending_sends[slot].append(payload)
+
+    def _flush_pending(self):
+        """Consumer-thread-only: push queued work onto worker sockets."""
+        for slot, sock in enumerate(self._worker_sockets):
+            while True:
+                with self._send_lock:
+                    if not self._pending_sends[slot]:
+                        break
+                    payload = self._pending_sends[slot][0]
+                try:
+                    sock.send(payload, flags=zmq.DONTWAIT)
+                except zmq.Again:
+                    break  # worker not connected yet / HWM reached; later
+                with self._send_lock:
+                    self._pending_sends[slot].pop(0)
 
     def get_results(self, timeout=_DEFAULT_TIMEOUT_S):
         deadline = time.monotonic() + timeout if timeout is not None else None
         while True:
-            if self._results_receiver.poll(50):
+            self._flush_pending()
+            self._check_worker_health()
+            if self._rescued:
+                message = self._rescued.pop(0)
+                control = pickle.loads(message[0])
+            elif self._results_receiver.poll(50):
                 message = self._results_receiver.recv_multipart()
                 control = pickle.loads(message[0])
+            else:
+                message = None
+            if message is not None:
                 if control == _WORKER_STARTED:
                     continue
                 if isinstance(control, VentilatedItemProcessedMessage):
-                    with self._count_lock:
-                        self._ventilated_unprocessed -= 1
-                    if self._ventilator is not None:
-                        self._ventilator.processed_item()
+                    self._on_item_processed(control.seq)
+                    continue
+                if isinstance(control, RowGroupQuarantined):
+                    if self._on_item_processed(control.seq):
+                        self._handle_quarantine(control)
                     continue
                 if isinstance(control, _WorkerError):
                     self.stop()
                     self.join()
                     logger.error('Worker traceback:\n%s', control.traceback_str)
                     raise control.exception
-                # Data message: payload in the second frame.
+                if isinstance(control, tuple) and control and control[0] == 'data':
+                    seq, chunk_index = control[1], control[2]
+                    if not self._registry.mark_delivered(seq, chunk_index):
+                        logger.warning('Dropping duplicate data for seq %s '
+                                       'chunk %s (respawn replay)', seq,
+                                       chunk_index)
+                        continue
+                    return self._serializer.deserialize(message[1])
+                # Legacy untagged payload (custom workers publishing through
+                # an old-style bootstrap).
                 return self._serializer.deserialize(message[1])
             if self._all_done():
                 raise EmptyResultError()
             if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutWaitingForResultError()
+                raise TimeoutWaitingForResultError(self._timeout_details(timeout))
+
+    # --- worker supervision: transport hooks (SupervisedPoolMixin) ---------
+
+    def _rescue_dead_worker_output(self, slot):
+        """Drain the shared results socket before re-ventilating the dead
+        worker's items: acks/quarantines it managed to send must land first,
+        or a completed (or already-quarantined) item would be needlessly
+        reprocessed — and a stale quarantine could burn a budget unit for a
+        row-group the replacement then successfully delivers. Data and
+        error messages are stashed (in order) for get_results. A short
+        quiet-period poll catches messages still in the zmq io thread; a
+        straggler that slips past is still delivery-safe via the
+        (seq, chunk) dedup. The drain is bounded (time + message count) so
+        live workers' ongoing output can't grow the stash without limit."""
+        drain_deadline = time.monotonic() + 0.25
+        max_stash = len(self._rescued) + 2 * self._results_queue_size
+        while (time.monotonic() < drain_deadline
+               and len(self._rescued) < max_stash
+               and self._results_receiver.poll(25)):
+            message = self._results_receiver.recv_multipart()
+            control = pickle.loads(message[0])
+            if control == _WORKER_STARTED:
+                # Must not be swallowed: a death during the startup barrier
+                # drains here, and the barrier still needs to count peers'
+                # startup acks (it consumes _rescued first).
+                self._rescued.append(message)
+                continue
+            if isinstance(control, VentilatedItemProcessedMessage):
+                self._on_item_processed(control.seq)
+                continue
+            if isinstance(control, RowGroupQuarantined):
+                if self._on_item_processed(control.seq):
+                    self._handle_quarantine(control)
+                continue
+            self._rescued.append(message)
+
+    def _discard_pending_work(self, slot):
+        with self._send_lock:
+            self._pending_sends[slot] = []
+
+    def _respawn_worker_transport(self, slot):
+        # The old socket may hold queued-but-undelivered work; those items
+        # are all registered in flight (and about to be requeued), so drop
+        # the socket outright (pending queue already discarded by the mixin).
+        self._worker_sockets[slot].close(linger=0)
+        sock = self._context.socket(zmq.PUSH)
+        port = sock.bind_to_random_port('tcp://127.0.0.1')
+        self._worker_sockets[slot] = sock
+        self._worker_ports[slot] = port
+        self._processes[slot] = self._spawn_worker(slot, port)
+
+    # --- lifecycle ---------------------------------------------------------
 
     def _all_done(self):
         # `completed` must be observed FIRST (see thread_pool._all_done).
@@ -149,8 +305,11 @@ class ProcessPool(object):
         if self._ventilator is not None:
             self._ventilator.stop()
         self._stopped = True
-        if self._control_sender is not None:
-            self._control_sender.send(_CONTROL_FINISHED)
+        if self._control_sender is not None and not self._control_sender.closed:
+            try:
+                self._control_sender.send(_CONTROL_FINISHED)
+            except zmq.ZMQError:  # already torn down (stop after join)
+                pass
 
     def join(self):
         # Slow-joiner-safe shutdown: rebroadcast FINISHED until every worker
@@ -166,22 +325,53 @@ class ProcessPool(object):
             while self._results_receiver.poll(0):
                 self._results_receiver.recv_multipart()
             time.sleep(_JOIN_REBROADCAST_INTERVAL_S)
-        for sock in (self._ventilator_send, self._control_sender, self._results_receiver):
+        for sock in ([self._control_sender, self._results_receiver]
+                     + self._worker_sockets):
             if sock is not None:
                 sock.close(linger=_SOCKET_LINGER_MS)
         if self._context is not None:
             self._context.term()
         self._processes = []
+        self._worker_sockets = []
+        self._pending_sends = []
 
     @property
     def diagnostics(self):
         with self._count_lock:
-            return {'ventilated_unprocessed': self._ventilated_unprocessed,
-                    'workers_count': self._workers_count}
+            unprocessed = self._ventilated_unprocessed
+        diag = {'ventilated_unprocessed': unprocessed,
+                'workers_count': self._workers_count}
+        diag.update(self._supervision_diagnostics())
+        return diag
 
     @property
     def results_qsize(self):
         return 0  # unknown for zmq transport
+
+
+def _run_worker_item(worker, seq, args, kwargs, send_control):
+    """Shared per-item execution: process, ack, or quarantine/fail.
+
+    Returns a `_WorkerError` to report, or None when handled.
+    """
+    import traceback
+
+    from petastorm_tpu.faults import maybe_inject
+
+    maybe_inject('worker-kill')
+    try:
+        worker.process(*args, **kwargs)
+        send_control(VentilatedItemProcessedMessage(worker.worker_id, seq))
+    except Exception as e:  # noqa: BLE001
+        record = quarantine_record_for(worker, e, args, kwargs)
+        if record is not None:
+            record.seq = seq
+            logger.warning('Worker %s quarantining item %s: %s',
+                           worker.worker_id, record.item, record.error)
+            send_control(record)
+            return None
+        return _WorkerError(e, traceback.format_exc())
+    return None
 
 
 def _worker_bootstrap(worker_class, worker_id, worker_args,
@@ -192,6 +382,8 @@ def _worker_bootstrap(worker_class, worker_id, worker_args,
     Parity: reference ``process_pool.py:334-417``.
     """
     import traceback
+
+    from petastorm_tpu.faults import maybe_inject
 
     serializer = serializer_type()
     context = zmq.Context()
@@ -206,18 +398,26 @@ def _worker_bootstrap(worker_class, worker_id, worker_args,
 
     _start_orphan_watchdog(parent_pid)
 
+    current_seq = [None, 0]  # [item seq, chunk index within the item]
+
     def publish(data):
-        results_sender.send_multipart([pickle.dumps('data'), serializer.serialize(data)])
+        maybe_inject('queue-stall')
+        header = ('data', current_seq[0], current_seq[1])
+        current_seq[1] += 1
+        results_sender.send_multipart([pickle.dumps(header),
+                                       serializer.serialize(data)])
+
+    def send_control(obj):
+        results_sender.send_multipart([pickle.dumps(obj), b''])
 
     worker = worker_class(worker_id, publish, worker_args)
     try:
         worker.initialize()
     except Exception as e:  # noqa: BLE001
-        results_sender.send_multipart([
-            pickle.dumps(_WorkerError(e, traceback.format_exc())), b''])
+        send_control(_WorkerError(e, traceback.format_exc()))
         return
 
-    results_sender.send_multipart([pickle.dumps(_WORKER_STARTED), b''])
+    send_control(_WORKER_STARTED)
 
     poller = zmq.Poller()
     poller.register(work_receiver, zmq.POLLIN)
@@ -229,14 +429,12 @@ def _worker_bootstrap(worker_class, worker_id, worker_args,
                 if control_receiver.recv() == _CONTROL_FINISHED:
                     break
             if socks.get(work_receiver) == zmq.POLLIN:
-                args, kwargs = dill.loads(work_receiver.recv())
-                try:
-                    worker.process(*args, **kwargs)
-                    results_sender.send_multipart([
-                        pickle.dumps(VentilatedItemProcessedMessage()), b''])
-                except Exception as e:  # noqa: BLE001
-                    results_sender.send_multipart([
-                        pickle.dumps(_WorkerError(e, traceback.format_exc())), b''])
+                seq, args, kwargs = dill.loads(work_receiver.recv())
+                current_seq[0], current_seq[1] = seq, 0
+                error = _run_worker_item(worker, seq, args, kwargs, send_control)
+                if error is not None:
+                    send_control(error)
+                current_seq[0] = None
     finally:
         worker.shutdown()
         for sock in (work_receiver, control_receiver, results_sender):

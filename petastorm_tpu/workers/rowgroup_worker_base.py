@@ -89,6 +89,10 @@ class RowGroupWorkerBase(WorkerBase):
         buffers import zero-copy (Arrow C Data Interface). Falls back to
         pyarrow for remote stores, nested columns, or build failure.
         """
+        from petastorm_tpu.faults import maybe_inject, rowgroup_fault_key
+        fault_key = rowgroup_fault_key(piece.path, piece.row_group)
+        maybe_inject('fs-read-delay', key=fault_key)
+        maybe_inject('fs-read-error', key=fault_key)
         if self._native_parquet_enabled():
             indices = self._leaf_indices(piece.path, columns)
             if indices is not None:

@@ -5,10 +5,15 @@ ZeroMQ design, ``workers_pool/process_pool.py:52-74``) but the worker <->
 consumer channels are mmap'd SPSC rings (``native/src/shm_ring.cc``): no
 sockets, no syscalls on the steady-state path, single memcpy per message.
 
-Channel layout per worker i:
-  work ring  ``/pst_<pid>_<uid>_i_in``   parent -> worker, pickled (args, kwargs)
-  result ring ``/pst_<pid>_<uid>_i_out`` worker -> parent, 1-byte tag + payload
-    tag b'C': pickled control (started / item-processed / error)
+Channel layout per worker i (generation g — bumped on every respawn):
+  work ring  ``/pst_<pid>_<uid>_i_g<g>_in``   parent -> worker, pickled
+             (seq, args, kwargs)
+  result ring ``/pst_<pid>_<uid>_i_g<g>_out`` worker -> parent, 1-byte tag +
+             payload
+    tag b'C': pickled control (started / item-processed / quarantine / error)
+    tag b'S': two little-endian int64s — (item seq, chunk index) of the data
+              payload that follows (separate tiny message so large payloads
+              need no re-copy; seq -1 = untagged publish)
     tag b'D': serializer payload (row-group data), possibly final chunk
     tag b'P': non-final chunk of a payload larger than half the ring
               (chunks are contiguous per ring — SPSC ordering — so the
@@ -17,28 +22,47 @@ Channel layout per worker i:
 FINISHED broadcast = setting the control flag word on both rings; blocked ring
 writes abort with RingClosed so shutdown can't deadlock on a full ring
 (the reference needs an explicit drain loop for this, ``process_pool.py:287-304``).
+
+Worker supervision mirrors :class:`ProcessPool` (see ``supervision.py``):
+round-robin dispatch with known assignment, dead-worker detection inside
+``get_results``, respawn-with-fresh-rings within ``max_worker_restarts``,
+re-ventilation of the dead worker's in-flight items, and seq-based duplicate
+suppression. On a death the old result ring is drained first — complete
+messages the dead worker managed to publish are preserved (and their acks
+processed) before the ring is discarded, which keeps delivery exactly-once.
+All ring writes happen on the consumer thread (ventilation goes through
+pending queues) so respawn can swap rings without racing the ventilator.
 """
 
 import logging
 import os
 import pickle
+import struct
 import threading
 import time
 import uuid
+from collections import deque
 
 import dill
 
-from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
+from petastorm_tpu.workers import (EmptyResultError, RowGroupQuarantined,
+                                   TimeoutWaitingForResultError,
                                    VentilatedItemProcessedMessage)
 from petastorm_tpu.workers.exec_in_new_process import exec_in_new_process
-from petastorm_tpu.workers.process_pool import _start_orphan_watchdog, _WorkerError
+from petastorm_tpu.workers.process_pool import (_run_worker_item,
+                                                _start_orphan_watchdog,
+                                                _WorkerError)
 from petastorm_tpu.workers.serializers import PickleSerializer
+from petastorm_tpu.workers.supervision import (DEFAULT_MAX_WORKER_RESTARTS,
+                                               InFlightRegistry,
+                                               SupervisedPoolMixin)
 
 logger = logging.getLogger(__name__)
 
 _WORKER_STARTED = '__worker_started__'
 _FLAG_FINISHED = 1
 _TAG_CONTROL = b'C'
+_TAG_SEQ = b'S'
 _TAG_DATA = b'D'
 _TAG_PARTIAL = b'P'  # chunk of an oversized data payload; 'D' terminates it
 _DEFAULT_TIMEOUT_S = 60
@@ -52,30 +76,51 @@ def shm_transport_available():
     return shm_ring.available()
 
 
-class ShmProcessPool(object):
+def _ring_names(base, worker_id, generation):
+    prefix = '{}_{}_g{}'.format(base, worker_id, generation)
+    return prefix + '_in', prefix + '_out'
+
+
+class ShmProcessPool(SupervisedPoolMixin):
     """Drop-in alternative to ProcessPool; rings instead of zmq sockets.
 
     :param result_ring_bytes: per-worker results ring capacity. Decoded
         row-groups must fit in half of this (ring message limit).
+    :param max_worker_restarts: total worker respawns tolerated before a
+        further death raises :class:`~petastorm_tpu.errors.WorkerLostError`.
     """
 
+    _pool_kind = 'Shm worker'
+
     def __init__(self, workers_count, results_queue_size=50, serializer=None,
-                 result_ring_bytes=_DEFAULT_RESULT_RING_BYTES):
+                 result_ring_bytes=_DEFAULT_RESULT_RING_BYTES,
+                 max_worker_restarts=DEFAULT_MAX_WORKER_RESTARTS):
         self._workers_count = workers_count
         self._serializer = serializer or PickleSerializer()
         self._result_ring_bytes = result_ring_bytes
+        self._init_supervision(max_worker_restarts)
         del results_queue_size  # bounded by ring bytes, not message count
 
+        self._base = None
+        self._generations = []
         self._work_rings = []
         self._result_rings = []
+        self._pending_sends = []
+        self._send_lock = threading.Lock()
         self._processes = []
+        self._worker_class = None
+        self._worker_args = None
         self._ventilator = None
         self._ventilated_unprocessed = 0
         self._count_lock = threading.Lock()
         self._stopped = False
-        self._next_worker = 0
         self._poll_cursor = 0
-        self._partials = {}  # ring index -> accumulated 'P' chunks
+        self._partials = {}   # slot -> accumulated 'P' chunks
+        self._ring_seq = {}   # slot -> announced (seq, chunk_idx) of the next 'D'
+        self._drained = deque()  # messages rescued off dead workers' rings
+        self._registry = None
+        #: Set by the Reader when ``error_budget`` is enabled.
+        self.quarantine_sink = None
 
     @property
     def workers_count(self):
@@ -86,18 +131,19 @@ class ShmProcessPool(object):
 
         if self._processes:
             raise RuntimeError('ShmProcessPool already started')
-        base = '/pst_{}_{}'.format(os.getpid(), uuid.uuid4().hex[:8])
+        self._worker_class = worker_class
+        self._worker_args = worker_args
+        self._registry = InFlightRegistry(self._workers_count)
+        self._base = '/pst_{}_{}'.format(os.getpid(), uuid.uuid4().hex[:8])
+        self._generations = [0] * self._workers_count
         for worker_id in range(self._workers_count):
-            self._work_rings.append(
-                ShmRing.create('{}_{}_in'.format(base, worker_id), _WORK_RING_BYTES))
+            in_name, out_name = _ring_names(self._base, worker_id, 0)
+            self._work_rings.append(ShmRing.create(in_name, _WORK_RING_BYTES))
             self._result_rings.append(
-                ShmRing.create('{}_{}_out'.format(base, worker_id),
-                               self._result_ring_bytes))
+                ShmRing.create(out_name, self._result_ring_bytes))
+            self._pending_sends.append([])
         for worker_id in range(self._workers_count):
-            process = exec_in_new_process(
-                _shm_worker_bootstrap, worker_class, worker_id, worker_args,
-                base, type(self._serializer), os.getpid())
-            self._processes.append(process)
+            self._processes.append(self._spawn_worker(worker_id))
 
         started = 0
         deadline = time.monotonic() + _STARTUP_TIMEOUT_S
@@ -111,9 +157,8 @@ class ShmProcessPool(object):
             if message is None:
                 self._check_workers_alive()
                 continue
-            tag, payload = message
-            if tag == _TAG_CONTROL:
-                control = pickle.loads(payload)
+            if message[0] == 'control':
+                control = pickle.loads(message[1])
                 if control == _WORKER_STARTED:
                     started += 1
                 elif isinstance(control, _WorkerError):
@@ -126,6 +171,14 @@ class ShmProcessPool(object):
             ventilator._ventilate_fn = self.ventilate
             ventilator.start()
 
+    def _spawn_worker(self, worker_id):
+        in_name, out_name = _ring_names(self._base, worker_id,
+                                        self._generations[worker_id])
+        return exec_in_new_process(
+            _shm_worker_bootstrap, self._worker_class, worker_id,
+            self._worker_args, in_name, out_name, type(self._serializer),
+            os.getpid())
+
     def _check_workers_alive(self):
         dead = [p.pid for p in self._processes if p.poll() is not None]
         if dead:
@@ -135,42 +188,91 @@ class ShmProcessPool(object):
     def ventilate(self, *args, **kwargs):
         with self._count_lock:
             self._ventilated_unprocessed += 1
-        # Round-robin dispatch (zmq PUSH does the same across peers).
-        ring = self._work_rings[self._next_worker % self._workers_count]
-        self._next_worker += 1
-        # dill: work items may close over lambdas (predicates/transforms)
-        ring.write(dill.dumps((args, kwargs)), timeout_ms=-1)
+        seq, slot = self._registry.assign((args, kwargs))
+        # dill: work items may close over lambdas (predicates/transforms).
+        # No ring write here — rings are SPSC and belong to the consumer
+        # thread (which swaps them on respawn); it flushes pending sends on
+        # every poll iteration.
+        self._enqueue_work(slot, dill.dumps((seq, args, kwargs)))
 
-    def _poll_once(self, timeout_ms):
-        """One sweep over all result rings; returns (tag, payload) or None.
+    def _enqueue_work(self, slot, payload):
+        with self._send_lock:
+            self._pending_sends[slot].append(payload)
 
-        Reassembles chunked payloads: 'P' chunks accumulate per ring until
-        the terminating 'D' arrives (chunks never interleave within one
-        ring — it's SPSC).
+    def _flush_pending(self):
+        """Consumer-thread-only: push queued work onto the work rings."""
+        from petastorm_tpu.native.shm_ring import RingClosed, RingTimeout
+
+        for slot, ring in enumerate(self._work_rings):
+            while True:
+                with self._send_lock:
+                    if not self._pending_sends[slot]:
+                        break
+                    payload = self._pending_sends[slot][0]
+                try:
+                    ring.write(payload, timeout_ms=0)
+                except (RingTimeout, RingClosed):
+                    break  # full ring or tearing down; retry next iteration
+                with self._send_lock:
+                    self._pending_sends[slot].pop(0)
+
+    # --- result-ring reading ----------------------------------------------
+
+    def _read_ring_once(self, slot):
+        """One message off worker ``slot``'s result ring.
+
+        Returns ``None`` (nothing complete), ``('again',)`` (absorbed a
+        seq/partial frame — poll the same ring again), ``('control',
+        payload)``, or ``('data', (seq, chunk_idx) | None, payload)`` with
+        chunked payloads reassembled (chunks never interleave within one
+        ring — SPSC).
         """
         from petastorm_tpu.native.shm_ring import RingClosed
 
+        ring = self._result_rings[slot]
+        try:
+            message = ring.read(timeout_ms=0)
+        except RingClosed:
+            return None
+        if message is None:
+            return None
+        tag = bytes(message[:1])
+        if tag == _TAG_SEQ:
+            self._ring_seq[slot] = struct.unpack('<qq', bytes(message[1:17]))
+            return ('again',)
+        if tag == _TAG_PARTIAL:
+            self._partials.setdefault(slot, []).append(message[1:])
+            return ('again',)
+        if tag == _TAG_DATA:
+            payload = message[1:]
+            pending = self._partials.pop(slot, None)
+            if pending is not None:
+                pending.append(payload)
+                payload = memoryview(b''.join(pending))
+            return ('data', self._ring_seq.pop(slot, None), payload)
+        if tag == _TAG_CONTROL:
+            return ('control', message[1:])
+        raise RuntimeError('Unexpected shm ring tag {!r}'.format(tag))
+
+    def _poll_once(self, timeout_ms):
+        """One complete message from any ring (or the rescue queue):
+        ``('control', payload)`` / ``('data', seq, payload)`` / None."""
+        if self._drained:
+            return self._drained.popleft()
         deadline = time.monotonic() + timeout_ms / 1000.0
         while True:
             for _ in range(self._workers_count):
-                ring_index = self._poll_cursor % self._workers_count
-                ring = self._result_rings[ring_index]
+                slot = self._poll_cursor % self._workers_count
+                # Advance BEFORE reading so a successful read doesn't pin the
+                # sweep on one busy ring (round-robin fairness: the other
+                # workers' bounded rings must keep draining or they stall).
                 self._poll_cursor += 1
-                try:
-                    message = ring.read(timeout_ms=0)
-                except RingClosed:
-                    continue
-                if message is None:
-                    continue
-                tag, payload = message[:1], message[1:]
-                if tag == _TAG_PARTIAL:
-                    self._partials.setdefault(ring_index, []).append(payload)
-                    continue
-                pending = self._partials.pop(ring_index, None)
-                if pending is not None and tag == _TAG_DATA:
-                    pending.append(payload)
-                    payload = memoryview(b''.join(pending))
-                return tag, payload
+                while True:
+                    message = self._read_ring_once(slot)
+                    if message is None or message[0] != 'again':
+                        break
+                if message is not None:
+                    return message
             if time.monotonic() >= deadline:
                 return None
             time.sleep(0.001)
@@ -178,19 +280,29 @@ class ShmProcessPool(object):
     def get_results(self, timeout=_DEFAULT_TIMEOUT_S):
         deadline = time.monotonic() + timeout if timeout is not None else None
         while True:
+            self._flush_pending()
+            self._check_worker_health()
             message = self._poll_once(timeout_ms=50)
             if message is not None:
-                tag, payload = message
-                if tag == _TAG_DATA:
+                if message[0] == 'data':
+                    _, header, payload = message
+                    seq, chunk_index = header if header else (None, 0)
+                    if seq is not None and seq >= 0 \
+                            and not self._registry.mark_delivered(seq, chunk_index):
+                        logger.warning('Dropping duplicate data for seq %s '
+                                       'chunk %s (respawn replay)', seq,
+                                       chunk_index)
+                        continue
                     return self._serializer.deserialize(payload)
-                control = pickle.loads(payload)
+                control = pickle.loads(message[1])
                 if control == _WORKER_STARTED:
                     continue
                 if isinstance(control, VentilatedItemProcessedMessage):
-                    with self._count_lock:
-                        self._ventilated_unprocessed -= 1
-                    if self._ventilator is not None:
-                        self._ventilator.processed_item()
+                    self._on_item_processed(control.seq)
+                    continue
+                if isinstance(control, RowGroupQuarantined):
+                    if self._on_item_processed(control.seq):
+                        self._handle_quarantine(control)
                     continue
                 if isinstance(control, _WorkerError):
                     self.stop()
@@ -201,7 +313,57 @@ class ShmProcessPool(object):
             if self._all_done():
                 raise EmptyResultError()
             if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutWaitingForResultError()
+                raise TimeoutWaitingForResultError(self._timeout_details(timeout))
+
+    # --- worker supervision: transport hooks (SupervisedPoolMixin) ---------
+
+    def _rescue_dead_worker_output(self, slot):
+        """Drain the dead worker's result ring before discarding it: complete
+        messages (incl. acks) survive — a torn trailing write is invisible to
+        ring.read — so their items won't be needlessly re-ventilated."""
+        while True:
+            message = self._read_ring_once(slot)
+            if message is None:
+                break
+            if message[0] != 'again':
+                self._drained.append(message)
+        self._partials.pop(slot, None)
+        self._ring_seq.pop(slot, None)
+        # Rescued acks must land before the mixin calls take_slot_items so
+        # completed items drop out of the in-flight set. A quarantine counts
+        # as an ack too (workers/__init__) — without this, an item the dead
+        # worker already quarantined would be re-ventilated, re-fail on the
+        # replacement, and have its second quarantine dropped as stale.
+        still_drained = deque()
+        for message in self._drained:
+            if message[0] == 'control':
+                control = pickle.loads(message[1])
+                if isinstance(control, VentilatedItemProcessedMessage):
+                    self._on_item_processed(control.seq)
+                    continue
+                if isinstance(control, RowGroupQuarantined):
+                    if self._on_item_processed(control.seq):
+                        self._handle_quarantine(control)
+                    continue
+            still_drained.append(message)
+        self._drained = still_drained
+
+    def _discard_pending_work(self, slot):
+        with self._send_lock:
+            self._pending_sends[slot] = []
+
+    def _respawn_worker_transport(self, slot):
+        from petastorm_tpu.native.shm_ring import ShmRing
+
+        self._work_rings[slot].close()
+        self._result_rings[slot].close()
+        self._generations[slot] += 1
+        in_name, out_name = _ring_names(self._base, slot, self._generations[slot])
+        self._work_rings[slot] = ShmRing.create(in_name, _WORK_RING_BYTES)
+        self._result_rings[slot] = ShmRing.create(out_name, self._result_ring_bytes)
+        self._processes[slot] = self._spawn_worker(slot)
+
+    # --- lifecycle ---------------------------------------------------------
 
     def _all_done(self):
         # `completed` must be observed FIRST (see thread_pool._all_done).
@@ -235,30 +397,37 @@ class ShmProcessPool(object):
         self._processes = []
         self._work_rings = []
         self._result_rings = []
+        self._pending_sends = []
         self._partials = {}
+        self._ring_seq = {}
+        self._drained = deque()
 
     @property
     def diagnostics(self):
         with self._count_lock:
-            return {'ventilated_unprocessed': self._ventilated_unprocessed,
-                    'workers_count': self._workers_count,
-                    'transport': 'shm_ring'}
+            unprocessed = self._ventilated_unprocessed
+        diag = {'ventilated_unprocessed': unprocessed,
+                'workers_count': self._workers_count,
+                'transport': 'shm_ring'}
+        diag.update(self._supervision_diagnostics())
+        return diag
 
     @property
     def results_qsize(self):
         return sum(1 for ring in self._result_rings if ring.readable_bytes)
 
 
-def _shm_worker_bootstrap(worker_class, worker_id, worker_args, base,
-                          serializer_type, parent_pid):
+def _shm_worker_bootstrap(worker_class, worker_id, worker_args, in_name,
+                          out_name, serializer_type, parent_pid):
     """Entry point of a spawned shm worker process."""
     import traceback
 
+    from petastorm_tpu.faults import maybe_inject
     from petastorm_tpu.native.shm_ring import RingClosed, ShmRing
 
     serializer = serializer_type()
-    work_ring = ShmRing.open('{}_{}_in'.format(base, worker_id))
-    result_ring = ShmRing.open('{}_{}_out'.format(base, worker_id))
+    work_ring = ShmRing.open(in_name)
+    result_ring = ShmRing.open(out_name)
 
     _start_orphan_watchdog(parent_pid)
 
@@ -269,8 +438,16 @@ def _shm_worker_bootstrap(worker_class, worker_id, worker_args, base,
     # safety margin under capacity/2 for framing.
     chunk_limit = max(4096, result_ring.capacity // 2 - 4096)
 
+    current_seq = [None, 0]  # [item seq, chunk index within the item]
+
     def publish(data):
+        maybe_inject('queue-stall')
         payload = serializer.serialize(data)
+        seq = -1 if current_seq[0] is None else current_seq[0]
+        result_ring.write_tagged(_TAG_SEQ,
+                                 struct.pack('<qq', seq, current_seq[1]),
+                                 timeout_ms=-1)
+        current_seq[1] += 1
         view = memoryview(payload)
         while len(view) > chunk_limit:
             result_ring.write_tagged(_TAG_PARTIAL, view[:chunk_limit], timeout_ms=-1)
@@ -293,12 +470,12 @@ def _shm_worker_bootstrap(worker_class, worker_id, worker_args, base,
                 break
             if item is None:
                 continue
-            args, kwargs = dill.loads(item)
-            try:
-                worker.process(*args, **kwargs)
-                send_control(VentilatedItemProcessedMessage())
-            except Exception as e:  # noqa: BLE001
-                send_control(_WorkerError(e, traceback.format_exc()))
+            seq, args, kwargs = dill.loads(item)
+            current_seq[0], current_seq[1] = seq, 0
+            error = _run_worker_item(worker, seq, args, kwargs, send_control)
+            if error is not None:
+                send_control(error)
+            current_seq[0] = None
     except RingClosed:
         pass
     finally:

@@ -1,0 +1,305 @@
+"""Steady-state worker supervision bookkeeping for out-of-process pools.
+
+The ZeroMQ and shm-ring process pools both dispatch ventilated row-group
+items round-robin to per-worker channels. Before this module a worker
+process dying mid-epoch silently stranded whatever items were queued on (or
+being processed by) it: the consumer's ``get_results`` hung until its
+timeout with no clue why. Supervision turns that into a first-class event
+(the tf.data-service stance, PAPERS.md): the pool detects the death, respawns
+the worker within a restart budget, and **re-ventilates exactly the items
+that were in flight on the dead worker** — everything else keeps flowing.
+
+:class:`InFlightRegistry` is the transport-agnostic part: it assigns each
+ventilated item a monotonically increasing sequence number, remembers which
+worker slot holds which items, and suppresses the duplicates that a
+respawn can produce. The duplicate window is real: a worker publishes its
+data chunk(s) *then* the item-processed ack, so a kill between the two
+leaves the parent holding data for an item it must also re-ventilate (it
+cannot know the data made it out). Re-processing then re-publishes the same
+chunks. The registry resolves this exactly-once at **chunk granularity**:
+every publish within an item carries ``(seq, chunk_index)``, a pair that was
+already delivered is dropped on re-arrival, and an ack for a seq that
+already acked is ignored. Chunk indices (rather than a per-seq
+at-most-once rule) keep workers free to publish several results per
+ventilated item — the pre-supervision pool contract. Untagged publishes
+(``seq is None``, e.g. from ``initialize()``) bypass deduplication
+entirely.
+
+Memory stays bounded: a seq's delivery record is forgotten at its first ack
+unless the item was requeued by a respawn (``maybe-dup``), and the
+maybe-dup set is capped by restart-budget x in-flight-items.
+"""
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+
+import dill
+
+logger = logging.getLogger(__name__)
+
+
+class InFlightRegistry(object):
+    """Thread-safe seq assignment + per-slot in-flight item bookkeeping.
+
+    ``ventilate()`` runs on the ventilator thread while acks/data/respawns
+    run on the consumer thread, so every mutation holds one lock.
+    """
+
+    def __init__(self, slots):
+        self._lock = threading.Lock()
+        self._inflight = [OrderedDict() for _ in range(slots)]
+        self._seq_slot = {}
+        self._next_seq = 0
+        self._rr = 0
+        self._delivered = {}   # seq -> set of delivered chunk indices
+        self._maybe_dup = set()
+        self.requeues = 0
+
+    # -- dispatch ----------------------------------------------------------
+
+    def assign(self, item):
+        """New ventilated item -> ``(seq, slot)`` (round-robin)."""
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            slot = self._rr % len(self._inflight)
+            self._rr += 1
+            self._inflight[slot][seq] = item
+            self._seq_slot[seq] = slot
+            return seq, slot
+
+    def requeue(self, seq, item):
+        """Re-dispatch a known seq (after its worker died) -> new slot.
+
+        The seq keeps its identity so late replay of its original data/ack
+        (already in transit when the worker died) is recognized as a
+        duplicate rather than double-delivered.
+        """
+        with self._lock:
+            self._maybe_dup.add(seq)
+            slot = self._rr % len(self._inflight)
+            self._rr += 1
+            self._inflight[slot][seq] = item
+            self._seq_slot[seq] = slot
+            self.requeues += 1
+            return slot
+
+    # -- result-side events ------------------------------------------------
+
+    def ack(self, seq):
+        """Item-processed ack for ``seq``. Returns False for a stale
+        duplicate (replayed ack of an already-completed item) that must NOT
+        decrement in-flight counters again."""
+        with self._lock:
+            slot = self._seq_slot.pop(seq, None)
+            if slot is None:
+                # Stale ack: the replay of a requeued item finished too; its
+                # delivery record can never be consulted again.
+                self._maybe_dup.discard(seq)
+                self._delivered.pop(seq, None)
+                return False
+            self._inflight[slot].pop(seq, None)
+            if seq not in self._maybe_dup:
+                # No replay can exist for a never-requeued item; forget it.
+                self._delivered.pop(seq, None)
+            return True
+
+    def mark_delivered(self, seq, chunk_index):
+        """About to hand chunk ``chunk_index`` of item ``seq`` to the
+        consumer. Returns False when exactly this chunk was already
+        delivered (respawn replay of a chunk that made it out before the
+        worker died) — the caller must drop the message. ``seq=None``
+        (untagged publish) is never deduplicated."""
+        if seq is None:
+            return True
+        with self._lock:
+            chunks = self._delivered.setdefault(seq, set())
+            if chunk_index in chunks:
+                return False
+            chunks.add(chunk_index)
+            return True
+
+    # -- worker death ------------------------------------------------------
+
+    def take_slot_items(self, slot):
+        """All in-flight ``(seq, item)`` pairs of a dead worker, removed from
+        its slot (caller requeues them via :meth:`requeue`)."""
+        with self._lock:
+            items = list(self._inflight[slot].items())
+            self._inflight[slot].clear()
+            for seq, _ in items:
+                self._seq_slot.pop(seq, None)
+            return items
+
+    # -- introspection -----------------------------------------------------
+
+    def in_flight_count(self, slot=None):
+        with self._lock:
+            if slot is not None:
+                return len(self._inflight[slot])
+            return sum(len(d) for d in self._inflight)
+
+    def describe(self):
+        """Human-readable in-flight summary for timeout/lost-worker errors."""
+        with self._lock:
+            per_slot = {}
+            for slot, items in enumerate(self._inflight):
+                if items:
+                    per_slot[slot] = [self.describe_item(item)
+                                      for item in list(items.values())[:4]]
+            return per_slot
+
+    @staticmethod
+    def describe_item(item):
+        args, kwargs = item
+        if isinstance(kwargs, dict) and 'piece_index' in kwargs:
+            return 'piece_index={}'.format(kwargs['piece_index'])
+        return repr(args)[:60]
+
+
+def format_worker_status(processes):
+    """``[(slot, pid, exitcode-or-'alive'), ...]`` for error messages."""
+    status = []
+    for slot, process in enumerate(processes):
+        if process is None:
+            status.append((slot, None, 'never-started'))
+            continue
+        code = process.poll()
+        status.append((slot, process.pid, 'alive' if code is None else code))
+    return status
+
+
+#: Liveness poll throttle inside ``get_results`` (supervised pools).
+HEALTH_CHECK_INTERVAL_S = 0.25
+#: Default worker-respawn budget over a pool's lifetime.
+DEFAULT_MAX_WORKER_RESTARTS = 2
+
+
+class SupervisedPoolMixin(object):
+    """Transport-agnostic half of worker supervision, shared by the ZeroMQ
+    and shm-ring process pools (so their policies cannot drift).
+
+    The concrete pool provides the transport half:
+
+    * ``_rescue_dead_worker_output(slot)`` — salvage whatever complete
+      results the dead worker published before dying (may call
+      ``_on_item_processed`` for rescued acks); best-effort;
+    * ``_discard_pending_work(slot)`` — drop the slot's queued-but-unsent
+      payloads (their items are about to be re-ventilated from the
+      in-flight registry);
+    * ``_respawn_worker_transport(slot)`` — tear down the dead worker's
+      channel, build a fresh one, and spawn the replacement process;
+    * ``_enqueue_work(slot, payload)`` — queue an already-serialized work
+      item for ``slot`` (sent by the consumer thread's flush);
+
+    and the shared state: ``_processes``, ``_registry``
+    (:class:`InFlightRegistry`), ``_stopped``, ``_count_lock``,
+    ``_ventilated_unprocessed``, ``_ventilator``, ``quarantine_sink``,
+    ``_max_worker_restarts``. ``_pool_kind`` labels error messages.
+    """
+
+    _pool_kind = 'Worker'
+
+    def _init_supervision(self, max_worker_restarts):
+        self._max_worker_restarts = max_worker_restarts
+        self._restarts = 0
+        self._last_health_check = 0.0
+
+    # -- result-side bookkeeping ------------------------------------------
+
+    def _on_item_processed(self, seq):
+        """Ack bookkeeping; False for a stale duplicate ack (respawn
+        replay) that must not decrement in-flight counters again."""
+        if seq is not None and not self._registry.ack(seq):
+            logger.warning('Ignoring duplicate item-processed ack for seq %s',
+                           seq)
+            return False
+        with self._count_lock:
+            self._ventilated_unprocessed -= 1
+        if self._ventilator is not None:
+            self._ventilator.processed_item()
+        return True
+
+    def _handle_quarantine(self, record):
+        from petastorm_tpu.workers import deliver_quarantine
+        try:
+            deliver_quarantine(self, record)
+        except Exception:
+            self.stop()
+            self.join()
+            raise
+
+    # -- liveness ----------------------------------------------------------
+
+    def _check_worker_health(self, force=False):
+        """Detect dead workers; respawn within budget and re-ventilate their
+        in-flight items, else raise WorkerLostError."""
+        if self._stopped or not self._processes:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_health_check < HEALTH_CHECK_INTERVAL_S:
+            return
+        self._last_health_check = now
+        for slot, process in enumerate(self._processes):
+            if process is not None and process.poll() is not None:
+                self._handle_dead_worker(slot, process.returncode)
+
+    def _handle_dead_worker(self, slot, exitcode):
+        from petastorm_tpu.errors import WorkerLostError
+        from petastorm_tpu.trace import get_global_tracer
+
+        get_global_tracer().instant('worker-lost:{}'.format(slot), cat='fault')
+        self._rescue_dead_worker_output(slot)
+        # Discard the slot's unsent payloads BEFORE snapshotting its
+        # in-flight items: the ventilator thread may assign a new item to
+        # this slot at any moment, and this order guarantees such an item is
+        # either (a) enqueued after the discard — its payload survives and
+        # flushes to the replacement worker — or (b) captured by
+        # take_slot_items below and requeued. Were the discard to happen
+        # after the snapshot, an item landing in between would be silently
+        # dropped and hang the epoch. The overlap of (a) and (b) can
+        # double-send an item; the (seq, chunk) delivery dedup absorbs that.
+        self._discard_pending_work(slot)
+        self._restarts += 1
+        stranded = self._registry.take_slot_items(slot)
+        if self._restarts > self._max_worker_restarts:
+            details = ('{} {} (pid {}) exited with code {} and the restart '
+                       'budget ({}) is exhausted. Worker status: {}. Stranded '
+                       'in-flight items: {}.'.format(
+                           self._pool_kind, slot, self._processes[slot].pid,
+                           exitcode, self._max_worker_restarts,
+                           format_worker_status(self._processes),
+                           [self._registry.describe_item(item)
+                            for _, item in stranded[:6]]))
+            self.stop()
+            raise WorkerLostError(details)
+
+        logger.warning('%s %d exited with code %s mid-epoch; respawning '
+                       '(%d/%d restarts used), re-ventilating %d in-flight '
+                       'item(s)', self._pool_kind, slot, exitcode,
+                       self._restarts, self._max_worker_restarts,
+                       len(stranded))
+        self._respawn_worker_transport(slot)
+        for seq, item in stranded:
+            new_slot = self._registry.requeue(seq, item)
+            self._enqueue_work(new_slot, dill.dumps((seq,) + item))
+
+    def _timeout_details(self, timeout):
+        status = format_worker_status(self._processes)
+        alive = [(slot, pid) for slot, pid, state in status if state == 'alive']
+        dead = [(slot, pid, state) for slot, pid, state in status if state != 'alive']
+        return ('No results for {}s. Workers alive: {}; dead: {}. Items in '
+                'flight: {} (per-worker sample: {}). Respawns used: {}/{}.'
+                .format(timeout, alive, dead,
+                        self._registry.in_flight_count(),
+                        self._registry.describe(), self._restarts,
+                        self._max_worker_restarts))
+
+    def _supervision_diagnostics(self):
+        diag = {'worker_respawns': self._restarts,
+                'max_worker_restarts': self._max_worker_restarts}
+        if self._registry is not None:
+            diag['items_in_flight'] = self._registry.in_flight_count()
+        return diag

@@ -12,8 +12,10 @@ import pstats
 import queue
 import threading
 
-from petastorm_tpu.workers import (EmptyResultError, TimeoutWaitingForResultError,
-                                   VentilatedItemProcessedMessage)
+from petastorm_tpu.workers import (EmptyResultError, RowGroupQuarantined,
+                                   TimeoutWaitingForResultError,
+                                   VentilatedItemProcessedMessage,
+                                   deliver_quarantine, quarantine_record_for)
 
 _DEFAULT_RESULTS_QUEUE_SIZE = 50
 _VENTILATION_POLL_TIMEOUT_S = 0.001
@@ -57,7 +59,8 @@ class WorkerThread(threading.Thread):
                 except _WorkerTerminationRequested:
                     return
                 except Exception as e:  # noqa: BLE001 - surfaces to consumer
-                    self._pool._put_result(e)
+                    record = quarantine_record_for(self._worker, e, args, kwargs)
+                    self._pool._put_result(record if record is not None else e)
         except _WorkerTerminationRequested:
             return
         finally:
@@ -78,6 +81,9 @@ class ThreadPool(object):
         self._profiling_enabled = profiling_enabled
         self._ventilated_unprocessed = 0
         self._count_lock = threading.Lock()
+        #: Set by the Reader when ``error_budget`` is enabled; receives
+        #: RowGroupQuarantined records (and raises when the budget is spent).
+        self.quarantine_sink = None
 
     @property
     def workers_count(self):
@@ -104,6 +110,8 @@ class ThreadPool(object):
     def _put_result(self, data):
         # Stop-aware bounded put (parity: thread_pool.py:200-214): never block
         # forever on a full queue if the pool is being stopped.
+        from petastorm_tpu.faults import maybe_inject
+        maybe_inject('queue-stall')
         while True:
             if self._stop_event.is_set():
                 raise _WorkerTerminationRequested()
@@ -130,6 +138,20 @@ class ThreadPool(object):
                     self._ventilated_unprocessed -= 1
                 if self._ventilator is not None:
                     self._ventilator.processed_item()
+                continue
+            if isinstance(result, RowGroupQuarantined):
+                # Quarantine counts as item-processed (the row-group is
+                # skipped, not retried); the sink enforces the budget.
+                with self._count_lock:
+                    self._ventilated_unprocessed -= 1
+                if self._ventilator is not None:
+                    self._ventilator.processed_item()
+                try:
+                    deliver_quarantine(self, result)
+                except Exception:
+                    self.stop()
+                    self.join()
+                    raise
                 continue
             if isinstance(result, Exception):
                 self.stop()

@@ -132,7 +132,13 @@ def partitioned_synthetic_dataset(tmp_path_factory):
 
 
 def pytest_configure(config):
+    # Also declared in pytest.ini; registering here too keeps direct
+    # `pytest tests/...` invocations from other rootdirs warning-free.
     config.addinivalue_line('markers', 'processpool: spawns real worker processes (slower)')
+    config.addinivalue_line(
+        'markers',
+        'chaos: fault-injection tests (tests/test_chaos.py) driving '
+        'PETASTORM_TPU_FAULTS sites and worker-kill recovery.')
     config.addinivalue_line(
         'markers',
         'slow: heavyweight tests (interpret-mode Pallas, transformer/MoE/'

@@ -1,0 +1,507 @@
+"""Chaos suite: drives every fault-injection site and proves the pipeline's
+fault-tolerance claims (ISSUE 1 acceptance criteria).
+
+(a) a SIGKILLed pool worker is respawned and its in-flight row-groups are
+    re-delivered exactly once;
+(b) with ``error_budget`` set, injected decode corruption in k row-groups
+    yields a completed epoch with exactly those k row-groups quarantined in
+    ``Reader.diagnostics()['quarantined_rowgroups']``;
+(c) with the budget exhausted or unset, the same injection raises within one
+    batch;
+(d) all unified retry loops (fs, hdfs failover, data-service bind) back off
+    with jitter under injected transient errors — asserted via the
+    RetryPolicy on-retry hook, with no sleep longer than the cap.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader, make_tensor_reader
+from petastorm_tpu.codecs import ScalarCodec
+from petastorm_tpu.errors import (DecodeFieldError, RowGroupQuarantinedError,
+                                  WorkerLostError)
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.faults import ENV_VAR, FaultSpec, get_injector
+from petastorm_tpu.retry import RetryPolicy, retry_counters
+from petastorm_tpu.storage import ParquetStore
+from petastorm_tpu.unischema import Unischema, UnischemaField
+from petastorm_tpu.workers import EmptyResultError, TimeoutWaitingForResultError, WorkerBase
+from petastorm_tpu.workers.process_pool import ProcessPool
+from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+
+pytestmark = pytest.mark.chaos
+
+ROWS = 40
+ROWS_PER_GROUP = 5
+
+ChaosSchema = Unischema('ChaosSchema', [
+    UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False),
+])
+
+
+@pytest.fixture(scope='module')
+def chaos_dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('chaos') / 'dataset'
+    url = 'file://' + str(path)
+    write_dataset(url, ChaosSchema, [{'id': i} for i in range(ROWS)],
+                  rows_per_row_group=ROWS_PER_GROUP)
+
+    class _Dataset(object):
+        pass
+
+    ds = _Dataset()
+    ds.url = url
+    ds.pieces = ParquetStore(url).row_groups()
+    return ds
+
+
+def _read_all_ids(reader):
+    return sorted(int(row.id) for row in reader)
+
+
+# ---------------------------------------------------------------------------
+# (a) worker death -> respawn -> exactly-once redelivery
+# ---------------------------------------------------------------------------
+
+@pytest.mark.processpool
+@pytest.mark.parametrize('pool_type', ['process-zmq', 'process-shm'])
+def test_sigkill_worker_respawns_and_redelivers_exactly_once(chaos_dataset, pool_type):
+    if pool_type == 'process-shm':
+        from petastorm_tpu.workers.shm_process_pool import shm_transport_available
+        if not shm_transport_available():
+            pytest.skip('native shm transport unavailable')
+    with make_reader(chaos_dataset.url, reader_pool_type=pool_type,
+                     workers_count=2, num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        it = iter(reader)
+        ids = [int(next(it).id) for _ in range(3)]
+        victim = reader._workers_pool._processes[0]
+        os.kill(victim.pid, signal.SIGKILL)
+        ids.extend(int(row.id) for row in it)
+        diagnostics = reader.diagnostics()
+        assert diagnostics['worker_respawns'] == 1
+    # Exactly once: no loss, no duplicates.
+    assert sorted(ids) == list(range(ROWS))
+
+
+@pytest.mark.processpool
+def test_worker_kill_injection_site_respawns(chaos_dataset, tmp_path, monkeypatch):
+    """The worker-kill site SIGKILLs one worker from the inside (token file =
+    at-most-once across all pool processes, so the respawn survives)."""
+    token = tmp_path / 'kill.token'
+    monkeypatch.setenv(ENV_VAR, 'worker-kill:token={}'.format(token))
+    with make_reader(chaos_dataset.url, reader_pool_type='process-zmq',
+                     workers_count=2, num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        ids = _read_all_ids(reader)
+        assert reader.diagnostics()['worker_respawns'] == 1
+    assert token.exists()  # the injection actually fired
+    assert ids == list(range(ROWS))
+
+
+class _EchoWorker(WorkerBase):
+    def process(self, value):
+        self.publish_func([value])
+
+
+@pytest.mark.processpool
+def test_worker_lost_error_when_restart_budget_exhausted():
+    pool = ProcessPool(2, max_worker_restarts=0)
+    ventilator = ConcurrentVentilator(None, [{'value': i} for i in range(200)],
+                                      iterations=1)
+    pool.start(_EchoWorker, None, ventilator)
+    try:
+        pool.get_results()
+        os.kill(pool._processes[0].pid, signal.SIGKILL)
+        with pytest.raises(WorkerLostError, match='restart budget'):
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    pool.get_results(timeout=5)
+                except EmptyResultError:
+                    break
+    finally:
+        pool.stop()
+        pool.join()
+
+
+class _SleepyWorker(WorkerBase):
+    def process(self, value):
+        time.sleep(4)
+        self.publish_func([value])
+
+
+@pytest.mark.processpool
+def test_get_results_timeout_reports_worker_and_inflight_state():
+    """Satellite: a timeout explains itself — which workers are alive/dead
+    and what was in flight — instead of a bare exception."""
+    pool = ProcessPool(1)
+    ventilator = ConcurrentVentilator(None, [{'value': 1}], iterations=1)
+    pool.start(_SleepyWorker, None, ventilator)
+    try:
+        with pytest.raises(TimeoutWaitingForResultError) as exc_info:
+            pool.get_results(timeout=0.5)
+        message = str(exc_info.value)
+        assert 'alive' in message
+        assert 'Items in flight: 1' in message
+        assert 'Respawns used: 0' in message
+    finally:
+        for process in pool._processes:
+            process.kill()
+        pool.stop()
+        pool.join()
+
+
+# ---------------------------------------------------------------------------
+# (b) + (c) poison row-group quarantine under an error budget
+# ---------------------------------------------------------------------------
+
+def _expected_corrupt(pieces):
+    from petastorm_tpu.faults import rowgroup_fault_key
+
+    injector = get_injector()
+    return {(p.path, p.row_group) for p in pieces
+            if injector.selected('decode-corrupt',
+                                 rowgroup_fault_key(p.path, p.row_group))}
+
+
+@pytest.mark.parametrize('pool_type', ['thread', 'dummy'])
+def test_decode_corrupt_quarantines_exactly_the_injected_rowgroups(
+        chaos_dataset, monkeypatch, pool_type):
+    monkeypatch.setenv(ENV_VAR, 'decode-corrupt:p=0.3:seed=2')
+    expected = _expected_corrupt(chaos_dataset.pieces)
+    assert 0 < len(expected) < len(chaos_dataset.pieces)  # seed sanity
+
+    with make_reader(chaos_dataset.url, reader_pool_type=pool_type,
+                     workers_count=2, num_epochs=1, shuffle_row_groups=False,
+                     error_budget=len(chaos_dataset.pieces)) as reader:
+        ids = _read_all_ids(reader)
+        quarantined = reader.diagnostics()['quarantined_rowgroups']
+
+    assert {(e['path'], e['row_group']) for e in quarantined} == expected
+    assert all('decode-corrupt' in e['error'] for e in quarantined)
+    surviving = ROWS - len(expected) * ROWS_PER_GROUP
+    assert len(ids) == surviving
+
+
+@pytest.mark.processpool
+def test_quarantine_via_process_pool_tensor_reader(chaos_dataset, monkeypatch):
+    """Quarantine records cross the process-pool boundary (tensor path)."""
+    monkeypatch.setenv(ENV_VAR, 'decode-corrupt:p=0.3:seed=2')
+    expected = _expected_corrupt(chaos_dataset.pieces)
+    with make_tensor_reader(chaos_dataset.url, reader_pool_type='process-zmq',
+                            workers_count=2, num_epochs=1,
+                            shuffle_row_groups=False,
+                            error_budget=1.0 - 1e-9) as reader:
+        rows = sum(len(chunk.id) for chunk in reader)
+        quarantined = reader.diagnostics()['quarantined_rowgroups']
+    assert {(e['path'], e['row_group']) for e in quarantined} == expected
+    assert rows == ROWS - len(expected) * ROWS_PER_GROUP
+
+
+def test_budget_counts_unique_items_across_epochs(chaos_dataset, monkeypatch):
+    """A stably-poison row-group consumes ONE budget unit no matter how many
+    epochs re-ventilate it (re-quarantines bump `occurrences` instead)."""
+    monkeypatch.setenv(ENV_VAR, 'decode-corrupt:p=0.3:seed=2')
+    expected = _expected_corrupt(chaos_dataset.pieces)
+    with make_reader(chaos_dataset.url, reader_pool_type='thread',
+                     workers_count=2, num_epochs=3, shuffle_row_groups=False,
+                     error_budget=len(expected)) as reader:
+        ids = [int(row.id) for row in reader]
+        quarantined = reader.diagnostics()['quarantined_rowgroups']
+    assert len(quarantined) == len(expected)  # unique records, not 3x
+    assert all(e['occurrences'] == 3 for e in quarantined)
+    assert len(ids) == 3 * (ROWS - len(expected) * ROWS_PER_GROUP)
+
+
+def test_registry_dedup_is_chunk_granular():
+    """Respawn dedup must not impose at-most-one-publish-per-item: replayed
+    chunks drop, new chunks of the same item deliver, untagged publishes
+    (seq=None) always deliver."""
+    from petastorm_tpu.workers.supervision import InFlightRegistry
+
+    registry = InFlightRegistry(2)
+    seq, slot = registry.assign((('x',), {}))
+    assert registry.mark_delivered(seq, 0)      # chunk 0 delivered
+    assert not registry.mark_delivered(seq, 0)  # replay of chunk 0 -> drop
+    assert registry.mark_delivered(seq, 1)      # chunk 1 is new -> deliver
+    assert registry.mark_delivered(None, 0)     # untagged: never deduped
+    assert registry.mark_delivered(None, 0)
+    # After the (only) ack of a never-requeued item the record is dropped.
+    assert registry.ack(seq)
+    assert not registry.ack(seq)  # stale duplicate
+
+
+def test_hdfs_cluster_unreachable_not_masked_as_failover_budget():
+    """HdfsConnectError (no namenode accepts) must propagate undisguised,
+    not be re-wrapped as MaxFailoversExceeded."""
+    from test_hdfs_ha import _MockConnector
+
+    from petastorm_tpu.hdfs import HANamenodeFilesystem, HdfsConnectError
+
+    connector = _MockConnector(fail_calls_by_nn={'nn1:8020': 100})
+    fs = HANamenodeFilesystem(connector, ['nn1:8020', 'nn2:8020'])
+    # After construction, make every namenode refuse reconnection.
+    connector.refuse = ('nn1:8020', 'nn2:8020')
+    connector.fail_calls_by_nn['nn2:8020'] = 100
+    with pytest.raises(HdfsConnectError):
+        fs.ls('/d')
+
+
+def test_unset_budget_raises_within_one_batch(chaos_dataset, monkeypatch):
+    monkeypatch.setenv(ENV_VAR, 'decode-corrupt:p=0.3:seed=2')
+    with make_reader(chaos_dataset.url, reader_pool_type='thread',
+                     workers_count=2, num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        with pytest.raises(DecodeFieldError, match='injected fault'):
+            for _ in reader:
+                pass
+
+
+def test_exhausted_budget_raises_rowgroup_quarantined(chaos_dataset, monkeypatch):
+    monkeypatch.setenv(ENV_VAR, 'decode-corrupt:p=0.3:seed=2')
+    expected = _expected_corrupt(chaos_dataset.pieces)
+    budget = len(expected) - 1
+    with make_reader(chaos_dataset.url, reader_pool_type='thread',
+                     workers_count=2, num_epochs=1, shuffle_row_groups=False,
+                     error_budget=budget) as reader:
+        with pytest.raises(RowGroupQuarantinedError, match='error_budget exhausted') as exc_info:
+            for _ in reader:
+                pass
+    assert len(exc_info.value.quarantined) == budget + 1
+
+
+def test_ambiguous_error_budget_rejected(chaos_dataset):
+    """Floats >= 1 (and bools) are ambiguous — refuse rather than guess."""
+    for bad in (1.0, 2.5, True, -1):
+        with pytest.raises(ValueError, match='error_budget'):
+            make_reader(chaos_dataset.url, reader_pool_type='dummy',
+                        num_epochs=1, error_budget=bad)
+
+
+def test_quarantine_disabled_by_default(chaos_dataset):
+    """No injection, no budget: nothing quarantined, everything delivered."""
+    with make_reader(chaos_dataset.url, reader_pool_type='thread',
+                     workers_count=2, num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        ids = _read_all_ids(reader)
+        assert reader.diagnostics()['quarantined_rowgroups'] == []
+        assert reader.diagnostics()['error_budget'] is None
+    assert ids == list(range(ROWS))
+
+
+# ---------------------------------------------------------------------------
+# (d) unified retry loops: backoff with jitter, capped
+# ---------------------------------------------------------------------------
+
+def test_fs_retry_backs_off_with_jitter_under_injection(tmp_path, monkeypatch):
+    import fsspec
+
+    from petastorm_tpu.fs import RetryingFilesystemWrapper
+
+    (tmp_path / 'probe.txt').write_text('x')
+    monkeypatch.setenv(ENV_VAR, 'fs-read-error:max=2')
+    events = []
+    sleeps = []
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.05, max_delay_s=0.2,
+                         retry_exceptions=(IOError, OSError),
+                         on_retry=lambda name, attempt, exc, delay:
+                         events.append((name, attempt, delay)),
+                         sleep=sleeps.append)
+    fs = RetryingFilesystemWrapper(fsspec.filesystem('file'),
+                                   retry_policy=policy)
+    assert fs.exists(str(tmp_path / 'probe.txt'))
+    # Two injected transient failures -> two retries, then success.
+    assert [(name, attempt) for name, attempt, _ in events] == \
+        [('exists', 0), ('exists', 1)]
+    assert sleeps == [delay for _, _, delay in events]
+    for _, attempt, delay in events:
+        assert 0.0 <= delay <= min(0.2, 0.05 * 2 ** attempt)
+
+
+def test_fs_retry_delays_are_jittered():
+    """Full jitter: two policies with different RNG streams draw different
+    delays for the same attempt schedule (a fixed 2**n ladder would not)."""
+    import random
+
+    delays = []
+    for seed in (1, 2):
+        policy = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=10.0,
+                             rng=random.Random(seed), sleep=lambda s: None)
+        attempt_delays = [policy.compute_delay(a) for a in range(4)]
+        delays.append(attempt_delays)
+        # Monotone cap: every draw stays under base * 2**attempt.
+        for attempt, delay in enumerate(attempt_delays):
+            assert 0.0 <= delay <= 0.1 * 2 ** attempt
+    assert delays[0] != delays[1]
+
+
+def test_hdfs_failover_backs_off_through_retry_policy():
+    from test_hdfs_ha import _MockConnector
+
+    from petastorm_tpu.hdfs import HANamenodeFilesystem
+
+    class RecordingHA(HANamenodeFilesystem):
+        def __init__(self, *args, **kwargs):
+            self.retry_events = []
+            super(RecordingHA, self).__init__(*args, **kwargs)
+
+        def _failover_policy(self, on_retry):
+            policy = super(RecordingHA, self)._failover_policy(on_retry)
+            inner = policy.on_retry
+
+            def recording_hook(name, attempt, exc, delay):
+                self.retry_events.append((name, attempt, delay))
+                inner(name, attempt, exc, delay)
+
+            policy.on_retry = recording_hook
+            policy._sleep = lambda s: None  # no real sleeping in tests
+            return policy
+
+    connector = _MockConnector(fail_calls_by_nn={'nn1:8020': 1})
+    fs = RecordingHA(connector, ['nn1:8020', 'nn2:8020'])
+    assert fs.ls('/d') == ['nn2:8020:/d']
+    assert [(name, attempt) for name, attempt, _ in fs.retry_events] == \
+        [('hdfs:ls', 0)]
+    for _, attempt, delay in fs.retry_events:
+        assert 0.0 <= delay <= min(RecordingHA.FAILOVER_MAX_DELAY_S,
+                                   RecordingHA.FAILOVER_BASE_DELAY_S * 2 ** attempt)
+
+
+def test_data_service_bind_retries_through_policy(chaos_dataset):
+    """A transient port clash on the derived control port is retried (with
+    backoff) through the shared RetryPolicy instead of flaking."""
+    import socket as pysocket
+
+    import zmq
+
+    from petastorm_tpu.data_service import DataServer
+
+    # Find a port triple (p, p+1, p+2) we can use, then occupy p+1 so the
+    # FIRST bind attempt fails on the derived control port.
+    blocker = None
+    data_port = None
+    for candidate in range(23500, 60000, 17):
+        try:
+            probes = []
+            for offset in range(3):
+                probe = pysocket.socket()
+                probe.bind(('127.0.0.1', candidate + offset))
+                probes.append(probe)
+            for probe in probes:
+                probe.close()
+            blocker = pysocket.socket()
+            blocker.bind(('127.0.0.1', candidate + 1))
+            blocker.listen(1)
+            data_port = candidate
+            break
+        except OSError:
+            for probe in probes:
+                probe.close()
+            continue
+    assert data_port is not None, 'no free port triple found'
+
+    events = []
+    sleeps = []
+
+    def on_retry(name, attempt, exc, delay):
+        events.append((name, attempt, delay))
+        blocker.close()  # the clash is transient: next attempt succeeds
+
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.02, max_delay_s=0.25,
+                         retry_exceptions=(zmq.ZMQError,), on_retry=on_retry,
+                         sleep=sleeps.append)
+    reader = make_tensor_reader(chaos_dataset.url, reader_pool_type='dummy',
+                                num_epochs=1, shuffle_row_groups=False)
+    server = DataServer(reader, 'tcp://127.0.0.1:{}'.format(data_port),
+                        bind_retry_policy=policy)
+    try:
+        assert events and events[0][0] == 'data-service-bind'
+        assert all(0.0 <= delay <= 0.25 for _, _, delay in events)
+        assert sleeps == [delay for _, _, delay in events]
+        assert server.data_endpoint.endswith(':{}'.format(data_port))
+    finally:
+        server.stop()
+
+
+def test_retry_counters_accumulate(monkeypatch):
+    from petastorm_tpu import retry as retry_module
+
+    monkeypatch.setattr(retry_module, '_retry_counters', {})
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.0, sleep=lambda s: None)
+    state = {'calls': 0}
+
+    def flaky():
+        state['calls'] += 1
+        if state['calls'] < 3:
+            raise IOError('transient')
+        return 'ok'
+
+    assert policy.call(flaky, retry_call_name='unit') == 'ok'
+    assert retry_counters()['unit'] == 2
+
+
+def test_retry_deadline_cuts_retries_short():
+    from petastorm_tpu.retry import RetryDeadlineExceeded
+
+    policy = RetryPolicy(max_attempts=100, base_delay_s=50.0, jitter='none',
+                         deadline_s=0.5, sleep=lambda s: None)
+    with pytest.raises(RetryDeadlineExceeded):
+        policy.call(lambda: (_ for _ in ()).throw(IOError('x')),
+                    retry_call_name='deadline-unit')
+
+
+# ---------------------------------------------------------------------------
+# harness mechanics: spec parsing, determinism, delay sites, tracing
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parsing():
+    spec = FaultSpec.parse('decode-corrupt:p=0.25:seed=9:max=3:delay=0.2')
+    assert (spec.site, spec.p, spec.seed, spec.max_fires, spec.delay_s) == \
+        ('decode-corrupt', 0.25, 9, 3, 0.2)
+    with pytest.raises(ValueError, match='bad fault param'):
+        FaultSpec.parse('decode-corrupt:frequency=1')
+
+
+def test_fault_selection_is_deterministic_and_seed_sensitive(monkeypatch):
+    monkeypatch.setenv(ENV_VAR, 'decode-corrupt:p=0.5:seed=1')
+    first = {k for k in 'abcdefghij'
+             if get_injector().selected('decode-corrupt', k)}
+    again = {k for k in 'abcdefghij'
+             if get_injector().selected('decode-corrupt', k)}
+    assert first == again  # pure function of (seed, site, key)
+    monkeypatch.setenv(ENV_VAR, 'decode-corrupt:p=0.5:seed=2')
+    other_seed = {k for k in 'abcdefghij'
+                  if get_injector().selected('decode-corrupt', k)}
+    assert first != other_seed
+
+
+def test_delay_sites_slow_but_do_not_fail(chaos_dataset, monkeypatch):
+    from petastorm_tpu.trace import Tracer, set_global_tracer
+
+    monkeypatch.setenv(ENV_VAR, 'fs-read-delay:delay=0.001;queue-stall:delay=0.001:max=2')
+    tracer = Tracer()
+    previous = set_global_tracer(tracer)
+    try:
+        with make_reader(chaos_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         shuffle_row_groups=False) as reader:
+            ids = _read_all_ids(reader)
+        assert ids == list(range(ROWS))
+        fault_events = [e for e in tracer.events if e['cat'] == 'fault']
+        names = {e['name'] for e in fault_events}
+        assert 'fault:fs-read-delay' in names
+        assert 'fault:queue-stall' in names
+    finally:
+        set_global_tracer(previous)
+
+
+def test_faults_inactive_without_env(monkeypatch):
+    monkeypatch.delenv(ENV_VAR, raising=False)
+    injector = get_injector()
+    assert injector.active_sites == []
+    injector.inject('decode-corrupt', key='anything')  # no-op, no raise
