@@ -383,6 +383,18 @@ def _robustness_counters(stats):
     }
 
 
+def _staging_counters(stats):
+    """Staging-engine health for a stage profile (ISSUE 2): per-stage busy
+    seconds, assemble/dispatch co-activity (``overlap_frac`` — 0.0 was the
+    PROFILE_r05 finding this engine exists to fix), and arena recycling
+    (``arena_alloc`` must stay near zero after warmup while ``arena_reuse``
+    climbs; ``arena_wait_s`` is assembler backpressure)."""
+    return {k: stats.get(k, 0) for k in
+            ('assemble_s', 'dispatch_s', 'overlap_s', 'overlap_frac',
+             'overlap_frac_total', 'ready_wait_s', 'arena_reuse',
+             'arena_alloc', 'arena_wait_s')}
+
+
 def _child_pipeline(url, workers):
     """Loader-only pipeline capacity (VERDICT r4 #2): the same tensor reader +
     JaxLoader path as the imagenet child but with NO train step — measures how
@@ -403,12 +415,16 @@ def _child_pipeline(url, workers):
     warm_batches = max(1, int(os.environ.get(
         'BENCH_PIPELINE_WARMUP', str(_IMAGENET_ROWS // batch + 2))))
     measure_batches = int(os.environ.get('BENCH_PIPELINE_BATCHES', '32'))
+    # prefetch > 0 engages the pipelined staging engine (recycled arenas +
+    # assemble/dispatch overlap — the ISSUE 2 tentpole); 0 recovers the old
+    # serial consumer-staging measurement for comparison.
+    prefetch = int(os.environ.get('BENCH_PIPELINE_PREFETCH', '2'))
     reader = make_tensor_reader(url, schema_fields=['image', 'label'],
                                 reader_pool_type='thread', workers_count=workers,
                                 num_epochs=None, shuffle_row_groups=True, seed=0,
                                 cache_type='memory')
     with reader:
-        with JaxLoader(reader, batch, prefetch=0) as loader:
+        with JaxLoader(reader, batch, prefetch=prefetch) as loader:
             it = iter(loader)
             # Warm through one epoch: decoded RAM cache fills, so the
             # steady-state number isolates pipeline mechanics from first-
@@ -430,12 +446,15 @@ def _child_pipeline(url, workers):
     profile = {k: round(t_read.get(k, 0) - t_read0.get(k, 0), 4)
                for k in ('read_s', 'decode_s', 'cache_s')}
     profile['stage_dispatch_s'] = stats['stage_dispatch_s']
+    profile['consumer_wait_s'] = stats['wait_s']
     profile['wall_s'] = round(elapsed, 4)
+    profile.update(_staging_counters(stats))
     profile.update(_robustness_counters(stats))
     print(json.dumps({
         'pipeline_img_per_sec': round(batch * measure_batches / elapsed, 2),
         'pipeline_cold_img_per_sec': round(cold_rate, 2),
         'pipeline_batch': batch,
+        'pipeline_prefetch': prefetch,
         'pipeline_stage_profile': profile,
         'platform': jax.devices()[0].platform}))
 
@@ -632,6 +651,21 @@ def _peak_bf16_flops(device):
 # per layer 2*(4*T*d^2 + 2*T^2*d + 8*T*d^2) with T=197, plus patchify
 # (196*384*768 MACs) and the 1000-way head = ~6.2e9 fwd FLOPs.
 _MODEL_FWD_FLOPS = {'resnet50': 4.09e9, 'resnet18': 1.82e9, 'vit': 6.2e9}
+
+# The space_to_depth stem retires more stem MACs than the classic 7x7/2 it
+# replaces (4x4 conv over the 2x2-packed 112x112x12 input: 4*4*12*64 =
+# 12288 MACs per output pixel vs 7*7*3*64 = 9408), so the s2d variant's
+# MFU must use its own FLOP basis or cross-stem comparisons are ~2% off
+# (ADVICE r5 #3). Published resnet counts assume conv7; add the delta.
+_S2D_STEM_EXTRA_FLOPS = 2 * (12288 - 9408) * 112 * 112
+
+
+def _model_fwd_flops(model_name, stem):
+    """Analytic forward FLOPs for (model, stem), or None when unknown."""
+    fwd = _MODEL_FWD_FLOPS.get(model_name)
+    if fwd is not None and stem == 'space_to_depth':
+        fwd += _S2D_STEM_EXTRA_FLOPS
+    return fwd
 
 # Training retires ~3x the forward FLOPs (fwd + bwd at 2x) — the standard
 # analytic-MFU convention; an intentional lower bound (ignores batch norm
@@ -868,6 +902,7 @@ def _child_imagenet(url, workers):
     stage_profile['stage_dispatch_s'] = stats['stage_dispatch_s']
     stage_profile['consumer_wait_s'] = stats['wait_s']
     stage_profile['wall_s'] = round(elapsed, 4)
+    stage_profile.update(_staging_counters(stats))
     stage_profile.update(_robustness_counters(stats))
     train_steps = measure_iters * scan_k
     rate = superbatch * measure_iters / elapsed
@@ -878,7 +913,7 @@ def _child_imagenet(url, workers):
     # a known model; otherwise mfu_note says why it is absent.
     mfu = None
     mfu_note = None
-    fwd_flops = _MODEL_FWD_FLOPS.get(config['model'])
+    fwd_flops = _model_fwd_flops(config['model'], config.get('stem'))
     peak = _peak_bf16_flops(jax.devices()[0]) if platform != 'cpu' else None
     if platform == 'cpu':
         mfu_note = 'cpu run: no chip peak to normalize against'
@@ -899,6 +934,7 @@ def _child_imagenet(url, workers):
         'mfu_basis': ({'fwd_flops_per_img': fwd_flops,
                        'train_multiplier': _TRAIN_FLOP_MULT,
                        'peak_bf16_flops_per_chip': peak,
+                       'stem': config.get('stem'),
                        'device_kind': getattr(jax.devices()[0],
                                               'device_kind', '')}
                       if mfu is not None else mfu_note),
@@ -1121,7 +1157,15 @@ def _aux_rate(key, val):
     """Promotion rate for a throughput aux slot; None = latest-wins."""
     if key in ('lm', 'lm_long', 'lm_moe'):
         return val.get('lm_tokens_per_sec_per_chip') or 0
-    if key in ('imagenet_vit', 'imagenet_aug'):
+    if key == 'imagenet_aug':
+        # The slot exists for the matched-baseline augmentation-cost claim:
+        # a record whose bare-baseline child failed (no aug_cost_frac) must
+        # never displace a slightly slower record that carries the
+        # provenance (ADVICE r5 #1) — rank it 0.
+        if val.get('aug_cost_frac') is None:
+            return 0
+        return _sustained_best(val)[0]
+    if key == 'imagenet_vit':
         return _sustained_best(val)[0]
     if key == 'pipeline':
         return val.get('pipeline_img_per_sec') or 0
@@ -1174,6 +1218,14 @@ def _refold_best():
     with open(_OPPORTUNISTIC_PATH + '.lock', 'w') as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
         data = _load_opportunistic()
+        if not data['attempts'] and os.path.exists(_OPPORTUNISTIC_PATH):
+            # The artifact exists but loaded empty (corrupt/truncated JSON):
+            # refolding would overwrite a possibly hand-recoverable attempt
+            # log with {'attempts': [], 'best': None} — refuse to save
+            # (ADVICE r5 #2).
+            print('refold-best: {} exists but no attempts parse; refusing to '
+                  'overwrite it'.format(_OPPORTUNISTIC_PATH), file=sys.stderr)
+            return None
         best = None
         for a in data['attempts']:
             inet = a.get('imagenet')
@@ -1219,7 +1271,10 @@ def probe_now(workers, probe_timeouts):
     # callers can fire blindly; a skip is benign and exits 0.
     import fcntl
 
-    lock = open(_OPPORTUNISTIC_PATH + '.probe_lock', 'w')
+    # Open in append mode: mode 'w' would truncate the HOLDER's recorded
+    # pid the moment a second probe merely attempts the lock (ADVICE r5
+    # #4) — only the process that actually wins the flock may rewrite it.
+    lock = open(_OPPORTUNISTIC_PATH + '.probe_lock', 'a')
     try:
         fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
     except OSError:
@@ -1228,6 +1283,8 @@ def probe_now(workers, probe_timeouts):
                           'skipped: another probe-now holds the lock'}))
         return 0
     try:
+        lock.seek(0)
+        lock.truncate()
         lock.write(str(os.getpid()))
         lock.flush()
         return _probe_now_locked(workers, probe_timeouts)

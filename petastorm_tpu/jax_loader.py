@@ -14,8 +14,10 @@ SURVEY.md §7.6:
   * device staging: ``jax.make_array_from_process_local_data`` onto a
     ``Mesh``-sharded layout (each pod host contributes its disjoint reader
     shard), or plain ``device_put`` single-chip,
-  * a double-buffered background prefetcher so host->HBM transfer of batch
-    N+1 hides under XLA step N.
+  * a pipelined staging engine (``staging.py``): batch assembly into
+    recycled host arenas overlapped with a bounded window of in-flight
+    ``device_put``s, so collate of batch N+1 hides under the transfer of
+    batch N and host->HBM transfer of batch N+1 hides under XLA step N.
 """
 
 import logging
@@ -31,6 +33,12 @@ from petastorm_tpu.utils import cached_namedtuple
 logger = logging.getLogger(__name__)
 
 _END = object()
+
+
+def _never_ready():
+    """Fallback readiness probe for array types without ``is_ready`` —
+    the engine then waits via the blocking ``ready_fn`` instead."""
+    return False
 
 # Fields smaller than this stage as one put even under stage_chunks>1:
 # chunking a 1KB label column costs k round trips for nothing.
@@ -127,7 +135,7 @@ def _sanitize_array(array, x64=False):
 def iter_numpy_batches(reader, batch_size, shape_policies=None,
                        shuffling_queue_capacity=0, min_after_dequeue=None,
                        seed=None, last_batch='drop', x64=False,
-                       strict_fields=False):
+                       strict_fields=False, batch_buffers=None, views_ok=True):
     """Yield dicts of numpy arrays with exact leading dim ``batch_size``.
 
     Works over both row readers (``make_reader``) and batch readers
@@ -138,6 +146,16 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
     nullable-declared field that is never actually null) — pass
     ``schema_fields`` excluding it, or a TransformSpec redeclaring it
     non-nullable, to proceed.
+
+    ``batch_buffers`` (the staging engine's arena hookup): a callable
+    ``spec -> dict of arrays or None`` (``spec``: {name: (shape, dtype)})
+    providing preallocated output buffers; batches are then collated into
+    those buffers in place (``np.copyto``/``out=``) instead of allocating
+    with ``np.stack``/``np.concatenate``, and the provider pairs each
+    yielded batch with its backing arena (``ArenaPool.claim_pending``).
+    ``views_ok=False`` additionally forces batches that would be zero-copy
+    chunk views into the buffers — transfer backends that don't alias host
+    memory prefer stable recycled buffers over views.
     """
     if last_batch not in ('drop', 'pad', 'partial'):
         raise ValueError("last_batch must be drop|pad|partial, got {!r}".format(last_batch))
@@ -224,15 +242,32 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
             columns.setdefault(name, []).append(value)
         count += 1
 
+    batch_spec = None     # learned from the first emitted batch (arena hookup)
+    arenas_effective = True   # until a whole batch proves un-stackable
+
     def emit_batches(final=False):
-        nonlocal columns, count
+        nonlocal columns, count, batch_spec, arenas_effective
         while count >= batch_size:
+            out_bufs = (batch_buffers(batch_spec)
+                        if batch_buffers is not None and batch_spec
+                        and arenas_effective else None)
             batch = {}
             for name in field_names:
+                buf = out_bufs.get(name) if out_bufs is not None else None
                 batch[name] = _stack_column(columns[name][:batch_size], name,
-                                            shape_policies, x64)
+                                            shape_policies, x64, out=buf)
                 columns[name] = columns[name][batch_size:]
             count -= batch_size
+            if batch_spec is None:
+                batch_spec = {name: (arr.shape, arr.dtype)
+                              for name, arr in batch.items()}
+            elif out_bufs is not None:
+                # Row dtypes that always need a sanitize conversion (e.g.
+                # int64 rows into an int32 spec) can never stack into the
+                # arena: if no field used its buffer, claiming an arena per
+                # batch is pure overhead — stop asking for them.
+                arenas_effective = any(batch[name] is out_bufs[name]
+                                       for name in field_names)
             yield batch
         if final and count:
             if last_batch == 'drop':
@@ -258,7 +293,9 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
         # unused BatchingTableQueue re-chunker
         # (``pyarrow_helpers/batching_table_queue.py:20-79``).
         yield from _iter_block_batches(reader, batch_size, shape_policies,
-                                       last_batch, x64, strict_fields)
+                                       last_batch, x64, strict_fields,
+                                       batch_buffers=batch_buffers,
+                                       views_ok=views_ok)
         return
 
     for sample in reader:
@@ -297,17 +334,26 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
 
 
 def _iter_block_batches(reader, batch_size, shape_policies, last_batch, x64,
-                        strict_fields):
+                        strict_fields, batch_buffers=None, views_ok=True):
     """Fixed-size batches assembled from column blocks (no per-row Python).
 
     Chunks (one per row-group) are sanitized once on arrival; batches are
     built from leading-dim slices — a contiguous view when one chunk covers
-    the batch, else one ``np.concatenate`` memcpy.
+    the batch (``views_ok``), else collated into a recycled arena slice
+    (``batch_buffers``) or, without an arena provider, one
+    ``np.concatenate``-equivalent memcpy into a fresh buffer.
+
+    Ownership: each chunk carries the reader's block-handoff marker
+    (``last_chunk_private`` — see ``TensorWorker``). Shared (cache-
+    resident) blocks are only ever *copied from*; a whole private chunk
+    that exactly covers a batch may instead be handed out directly (its
+    buffer is unshared, so downstream may keep or alias it freely without
+    ever corrupting the cache).
     """
     shape_policies = dict(shape_policies or {})
     field_names = None
     dropped = []
-    chunks = []          # list of dicts name -> array (sanitized, same length)
+    chunks = []   # list of [dict name -> sanitized array, private_bool]
     have = 0
 
     def densify(name, arr):
@@ -357,39 +403,69 @@ def _iter_block_batches(reader, batch_size, shape_policies, last_batch, x64,
                 sorted(dropped)))
         return names
 
+    def out_buffers(n, head):
+        """A destination for ``n`` collated rows: an arena from the
+        provider when available (recycled, zero allocations), else fresh."""
+        spec = {name: ((n,) + head[name].shape[1:], head[name].dtype)
+                for name in field_names}
+        out = batch_buffers(spec) if batch_buffers is not None else None
+        if out is None:
+            out = {name: np.empty(shape, dtype)
+                   for name, (shape, dtype) in spec.items()}
+        return out
+
     def take(n):
-        """Pop ``n`` leading rows across chunks -> dict of arrays."""
+        """Pop ``n`` leading rows across chunks -> dict of arrays.
+
+        Zero-copy single-chunk fast paths first (a leading-dim view when
+        ``views_ok``; whole-chunk handout when the chunk is private);
+        otherwise collate into ``out_buffers`` slice by slice via
+        ``np.copyto`` — shared chunks are only ever read.
+        """
         nonlocal have
-        parts = {name: [] for name in field_names}
-        need = n
+        head, head_private = chunks[0]
+        rows = len(head[field_names[0]])
+        if rows == n and (views_ok or head_private):
+            chunks.pop(0)
+            have -= n
+            return head
+        if rows > n and views_ok:
+            chunks[0][0] = {name: head[name][n:] for name in field_names}
+            have -= n
+            return {name: head[name][:n] for name in field_names}
+        out = out_buffers(n, head)
+        pos, need = 0, n
         while need > 0:
-            head = chunks[0]
+            head, _ = chunks[0]
             rows = len(head[field_names[0]])
-            if rows <= need:
-                for name in field_names:
-                    parts[name].append(head[name])
+            k = min(rows, need)
+            for name in field_names:
+                np.copyto(out[name][pos:pos + k], head[name][:k])
+            if k == rows:
                 chunks.pop(0)
-                need -= rows
             else:
-                for name in field_names:
-                    parts[name].append(head[name][:need])
-                chunks[0] = {name: head[name][need:] for name in field_names}
-                need = 0
+                chunks[0][0] = {name: head[name][k:] for name in field_names}
+            pos += k
+            need -= k
         have -= n
-        return {name: (p[0] if len(p) == 1 else np.concatenate(p))
-                for name, p in ((name, parts[name]) for name in field_names)}
+        return out
 
     for sample in reader:
         if field_names is None:
             field_names = select(sample)
+        private = bool(getattr(reader, 'last_chunk_private', False))
         chunk = {}
+        all_copied = True
         for name in field_names:
-            arr = densify(name, getattr(sample, name))
-            arr = _sanitize_array(arr, x64)
+            source = np.asarray(getattr(sample, name))
+            arr = _sanitize_array(densify(name, source), x64)
             if arr is None:
                 raise ValueError('Field {!r} dtype is not TPU-compatible'.format(name))
             chunk[name] = arr
-        chunks.append(chunk)
+            all_copied = all_copied and arr is not source
+        # densify/sanitize copies (dtype conversion, ragged stack) make the
+        # blocks private even when the reader's came out of a cache.
+        chunks.append([chunk, private or all_copied])
         have += len(chunk[field_names[0]]) if field_names else 0
         while have >= batch_size:
             yield take(batch_size)
@@ -398,13 +474,24 @@ def _iter_block_batches(reader, batch_size, shape_policies, last_batch, x64,
         if last_batch == 'partial':
             yield take(have)
         elif last_batch == 'pad':
-            short = take(have)
-            pad = batch_size - len(short[field_names[0]])
-            yield {name: np.concatenate(
-                [arr] + [arr[-1:]] * pad) for name, arr in short.items()}
+            # Repeat-pad the tail into a full-size buffer. Never in place:
+            # the tail chunk may be a cache-shared block, which is strictly
+            # copy-from (see the ownership marker above).
+            out = out_buffers(batch_size, chunks[0][0])
+            pos = 0
+            while chunks:
+                head, _ = chunks.pop(0)
+                k = len(head[field_names[0]])
+                for name in field_names:
+                    np.copyto(out[name][pos:pos + k], head[name])
+                pos += k
+            for name in field_names:
+                out[name][pos:] = out[name][pos - 1]
+            have = 0
+            yield out
 
 
-def _stack_column(values, name, shape_policies, x64):
+def _stack_column(values, name, shape_policies, x64, out=None):
     if any(v is None for v in values):
         raise ValueError(
             'Field {!r} contains None (nullable) values; fill or drop them with a '
@@ -412,6 +499,20 @@ def _stack_column(values, name, shape_policies, x64):
     policy = shape_policies.get(name)
     if policy is not None:
         values = [policy.apply(v) for v in values]
+    if out is not None:
+        # Arena fast path: when the rows already match the sanitized target
+        # dtype/shape, stack straight into the recycled buffer — no
+        # allocation, and the later sanitize pass is a no-op by
+        # construction. Any mismatch (e.g. int64 rows headed for an int32
+        # buffer) falls through to the allocating path below (reusing the
+        # converted rows).
+        rows = [np.asarray(v) for v in values]
+        if (len(rows) == out.shape[0]
+                and all(r.dtype == out.dtype and r.shape == out.shape[1:]
+                        for r in rows)):
+            np.stack(rows, out=out)
+            return out
+        values = rows
     try:
         stacked = np.stack([np.asarray(v) for v in values])
     except ValueError as e:
@@ -442,8 +543,11 @@ class JaxLoader(object):
         axis (override via ``sharding``).
     :param sharding: explicit ``NamedSharding`` (or dict field->sharding).
     :param prefetch: device batches staged ahead (double-buffering default 2).
-        ``0`` disables the background staging thread entirely: host batches
-        are assembled ahead by the reader's worker pool as usual, but the
+        ``prefetch > 0`` runs the pipelined staging engine — an assemble
+        thread collating into recycled host arenas plus a dispatch thread
+        keeping ``inflight`` transfers in the air (see ``staging.py``).
+        ``0`` disables the staging threads entirely: host batches are
+        assembled ahead by the reader's worker pool as usual, but the
         ``device_put`` happens inline in the consumer thread. Use on
         interconnects where background transfers interleaved with compute
         are pathological (see docs/troubleshoot.rst).
@@ -466,13 +570,26 @@ class JaxLoader(object):
         axon-tunneled v5e); on direct PCIe hosts leave it at 1. Single-
         device targets only — multi-device shardings keep the one-shot
         ``make_array_from_process_local_data`` path.
+    :param arena_depth: host-batch arenas in the staging engine's pool
+        (``prefetch > 0`` only). Batches are collated into these recycled
+        preallocated buffers instead of allocating every batch; an arena
+        returns to the pool once its transfer completed and (on zero-copy
+        backends) the consumer dropped its arrays. Default sizes the pool
+        to ``max(2, prefetch) + inflight + 2``; an exhausted pool briefly
+        backpressures the assembler, then grows (visible as
+        ``stats['arena_alloc']``) rather than deadlocking a consumer that
+        holds many batches (e.g. ``superbatches(k)``).
+    :param inflight: staged batches whose transfers may be in flight
+        before the dispatch stage blocks on the oldest — the window that
+        lets collate of batch N+1 overlap the transfer of batch N
+        (``stats['overlap_frac']``).
     """
 
     def __init__(self, reader, batch_size, mesh=None, sharding=None,
                  batch_axis='data', prefetch=2, shape_policies=None,
                  shuffling_queue_capacity=0, min_after_dequeue=None, seed=None,
                  last_batch='drop', strict_fields=False, echo=1, tracer=None,
-                 stage_chunks=1):
+                 stage_chunks=1, arena_depth=None, inflight=2):
         import jax
 
         if tracer is None:
@@ -513,12 +630,6 @@ class JaxLoader(object):
         if not shuffling_queue_capacity and hasattr(reader, 'enable_row_granular_checkpoint'):
             self._row_granular_ckpt = reader.enable_row_granular_checkpoint()
 
-        self._host_iter = iter_numpy_batches(
-            reader, local_batch, shape_policies=shape_policies,
-            shuffling_queue_capacity=shuffling_queue_capacity,
-            min_after_dequeue=min_after_dequeue, seed=seed,
-            last_batch=last_batch, x64=x64, strict_fields=strict_fields)
-
         if echo < 1:
             raise ValueError('echo must be >= 1, got {}'.format(echo))
         self._echo = int(echo)
@@ -554,12 +665,68 @@ class JaxLoader(object):
         if self._stage_chunks > 1:
             import jax.numpy as jnp
             self._stage_concat = jax.jit(lambda *xs: jnp.concatenate(xs))
-        # Start the stager LAST: it touches the state above immediately.
-        if self._consumer_staging:
-            self._thread = None
-        else:
-            self._thread = threading.Thread(target=self._stage_loop, daemon=True)
-            self._thread.start()
+
+        # Pipelined staging engine (prefetch > 0): an assemble stage that
+        # collates batches into recycled host arenas and a dispatch stage
+        # holding a bounded window of in-flight puts, so collate of batch
+        # N+1 overlaps the transfer of batch N (see ``staging.py``).
+        # ``prefetch == 0`` keeps the inline consumer-staging path: plain
+        # allocation, no arenas, no extra threads.
+        self._thread = None       # kept for back-compat introspection
+        self._engine = None
+        self._arena_pool = None
+        arena_buffers = None
+        views_ok = True
+        host_reader = reader
+        if not self._consumer_staging:
+            from petastorm_tpu.staging import (ArenaPool, MeteredReader,
+                                               OverlapMeter, StagingEngine,
+                                               staging_aliases_host)
+            # Zero-copy backends (CPU) hand out device arrays that ALIAS
+            # host memory: staged chunk views stay the fastest path
+            # (views_ok), and arena recycling must additionally wait for
+            # the consumer to drop its arrays (holds_mode). Copying
+            # backends (real TPU h2d) prefer every batch in a stable
+            # recycled arena — transfers re-use warmed buffers and the
+            # arena is free the moment the put completes.
+            aliasing = self._dlpack_staging or staging_aliases_host(jax)
+            views_ok = aliasing
+            inflight = max(1, int(inflight))
+            if arena_depth is None:
+                arena_depth = max(2, prefetch) + inflight + 2
+            # Blocked time — reader pulls and arena backpressure — reports
+            # as PAUSED assemble time so the overlap metric covers collate
+            # work only (an input- or arena-bound run must not read as
+            # perfect pipelining).
+            meter = OverlapMeter()
+            host_reader = MeteredReader(reader, meter)
+            self._arena_pool = ArenaPool(arena_depth, stop_event=self._stop,
+                                         tracer=self._tracer, meter=meter)
+            arena_buffers = self._arena_pool.get_buffers
+
+        self._host_iter = iter_numpy_batches(
+            host_reader, local_batch, shape_policies=shape_policies,
+            shuffling_queue_capacity=shuffling_queue_capacity,
+            min_after_dequeue=min_after_dequeue, seed=seed,
+            last_batch=last_batch, x64=x64, strict_fields=strict_fields,
+            batch_buffers=arena_buffers, views_ok=views_ok)
+
+        # Start the engine LAST: it touches the state above immediately.
+        if not self._consumer_staging:
+            def ready_fn(staged):
+                jax.block_until_ready(list(staged.values()))
+
+            def is_ready_fn(staged):
+                return all(getattr(v, 'is_ready', _never_ready)()
+                           for v in staged.values())
+
+            self._engine = StagingEngine(
+                host_iter=self._host_iter, stage_fn=self._stage,
+                out_queue=self._queue, stop_event=self._stop,
+                end_sentinel=_END, pool=self._arena_pool, inflight=inflight,
+                ready_fn=ready_fn, is_ready_fn=is_ready_fn,
+                holds_mode=aliasing, tracer=self._tracer,
+                meter=meter).start()
 
     # -- staging thread --------------------------------------------------
 
@@ -610,8 +777,12 @@ class JaxLoader(object):
                     out[name] = self._chunked_put(array, None)
                 elif self._dlpack_staging:
                     # CPU backend: import the host buffer zero-copy via
-                    # DLPack (batch buffers are freshly assembled, never
-                    # mutated after staging, so aliasing is safe). TPU
+                    # DLPack. Aliasing is safe because recycling is
+                    # deferred until the staged arrays are dropped: arena-
+                    # backed batches get GC holds (StagingEngine holds_mode
+                    # — an arena is never refilled while any staged array
+                    # of it is alive), and non-arena batches (chunk views,
+                    # consumer staging) are never written again at all. TPU
                     # backends need the real h2d transfer and take the
                     # device_put branch.
                     try:
@@ -632,49 +803,12 @@ class JaxLoader(object):
         with self._tracer.span('assemble', 'host'):
             return next(self._host_iter)
 
-    def _stage_loop(self):
-        try:
-            while True:
-                try:
-                    host_batch = self._next_host_batch()
-                except StopIteration:
-                    break
-                if self._stop.is_set():
-                    return
-                staged = self._stage(host_batch)
-                while not self._stop.is_set():
-                    try:
-                        self._queue.put(staged, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                if self._stop.is_set():
-                    return  # don't fetch another batch into a stopping pipe
-        except Exception as e:  # noqa: BLE001 - surfaced to consumer
-            self._put_stop_aware(e)
-            return
-        self._put_stop_aware(_END)
-
-    def _put_stop_aware(self, obj):
-        # NEVER block indefinitely on the consumer queue: if the consumer is
-        # gone (stop() already drained and moved on) an unbounded put leaks
-        # this staging thread forever — it then holds reader/file objects
-        # whose teardown races its final reads (observed as a pyarrow
-        # segfault under load).
-        while not self._stop.is_set():
-            try:
-                self._queue.put(obj, timeout=0.1)
-                return
-            except queue.Full:
-                continue
-        # Stopping: still attempt one non-blocking put — a consumer already
-        # parked in an untimed queue.get() (stop() called from another
-        # thread) needs the sentinel to wake up; if the queue is full the
-        # consumer isn't blocked and the exhausted flag ends it instead.
-        try:
-            self._queue.put_nowait(obj)
-        except queue.Full:
-            pass
+    # The staging threads themselves live in ``staging.StagingEngine``
+    # (assemble + dispatch); their stop-aware queue discipline — never
+    # block indefinitely on a consumer that may already be gone — is
+    # inherited from the single-loop stager this engine replaced (a leaked
+    # stager holds reader/file objects whose teardown races its final
+    # reads; observed as a pyarrow segfault under load).
 
     # -- consumer --------------------------------------------------------
 
@@ -784,6 +918,10 @@ class JaxLoader(object):
         with self._stats_lock:
             self._stage_s = 0.0
             self._staged_bytes = 0
+        if self._engine is not None:
+            self._engine.reset_stats()
+        if self._arena_pool is not None:
+            self._arena_pool.reset_stats()
 
     @property
     def stats(self):
@@ -808,6 +946,17 @@ class JaxLoader(object):
                'stage_dispatch_s': round(stage_s, 4),
                'staged_bytes': staged_bytes,
                'reader_diagnostics': self._reader.diagnostics}
+        if self._engine is not None:
+            # Pipeline shape of the staging engine: per-stage busy seconds,
+            # how much of the smaller stage ran concurrently with the other
+            # (overlap_frac — the software-pipelining win), and time spent
+            # fenced on the oldest in-flight transfer (ready_wait_s).
+            out.update(self._engine.stats())
+        if self._arena_pool is not None:
+            # Arena recycling health: after warmup ``arena_alloc`` should
+            # stay flat (near-zero new allocations) with ``arena_reuse``
+            # climbing; ``arena_wait_s`` is assembler backpressure.
+            out.update(self._arena_pool.stats())
         worker_timings = getattr(self._reader, 'stage_timings', None)
         if worker_timings:
             out['worker_stage_timings'] = {
@@ -838,12 +987,14 @@ class JaxLoader(object):
     def stop(self):
         self._stop.set()
         self._exhausted = True
-        # Drain so the stager can exit.
+        # Drain so the staging threads' bounded puts can exit.
         try:
             while True:
                 self._queue.get_nowait()
         except queue.Empty:
             pass
+        if self._engine is not None:
+            self._engine.stop()
         if self._thread is not None:
             self._thread.join(timeout=10)
         self._reader.stop()

@@ -653,6 +653,15 @@ class Reader(object):
         return getattr(self._results_queue_reader, 'stage_timings', {})
 
     @property
+    def last_chunk_private(self):
+        """Ownership of the most recently yielded chunk (tensor path): True
+        when its column blocks are not shared with a cache, so a downstream
+        collate stage may take ownership of them instead of copying. False
+        for readers that don't track ownership — sharing must be assumed."""
+        return bool(getattr(self._results_queue_reader, 'last_chunk_private',
+                            False))
+
+    @property
     def transformed_schema(self):
         """The schema of yielded rows (after any TransformSpec)."""
         return self._transformed_schema

@@ -1,0 +1,615 @@
+"""Pipelined staging engine: recycled host-batch arenas + overlapped
+assemble/dispatch.
+
+PROFILE_r05 shows the steady-state input pipeline is collate/memcpy-bound
+and that staging never overlaps anything (``h2d_overlap_frac`` 0.0,
+``stage_dispatch_s`` + ``consumer_wait_s`` dominating the pipeline wall).
+This module is the fix, in the tf.data (arXiv:2101.12127) / MinatoLoader
+(arXiv:2509.10712) shape: software pipelining between batch assembly and
+device dispatch, plus buffer reuse so the collate path stops allocating a
+fresh host batch every step.
+
+Three pieces, each independently testable without jax:
+
+``ArenaPool`` / ``HostArena``
+    A bounded pool of preallocated per-field host buffers sized to one
+    batch. The batch assembler fills arena slices in place
+    (``np.copyto``/``out=``) instead of ``np.stack``/``np.concatenate``
+    allocating every batch; the pool recycles an arena only once the
+    dispatch stage reports its transfer done AND every consumer-visible
+    view of it has been dropped (``add_hold`` — on backends where
+    ``device_put`` is zero-copy the staged array aliases the arena, so
+    "transfer done" alone is not permission to overwrite). Exhaustion
+    applies backpressure (bounded, stop-aware wait); a wait that outlives
+    ``grow_timeout_s`` allocates past ``depth`` rather than deadlocking a
+    consumer that legitimately holds many batches (e.g.
+    ``superbatches(k)``). Growth is sticky — ``depth`` rises to the
+    high-water mark, so the timeout is paid once per working-set
+    increase, not per cycle — and every allocation is visible in
+    ``arena_alloc``.
+
+``OverlapMeter``
+    Wall-clock co-activity of named pipeline stages. ``overlap_s`` is the
+    time during which two or more stages were simultaneously inside their
+    tracked section — the direct measurement of "collate of batch N+1
+    overlaps the transfer of batch N".
+
+``StagingEngine``
+    Two threads replacing the single serial stage loop: an **assemble**
+    thread that drives the host-batch iterator (filling arenas), and a
+    **dispatch** thread that issues the device puts and keeps a bounded
+    window of in-flight transfers, blocking on the oldest when the window
+    fills. Delivery order is preserved; stop/fault semantics follow PR 1
+    (stop-aware puts everywhere, no thread outlives ``stop()``, in-flight
+    arenas are reclaimed on shutdown).
+"""
+
+import logging
+import queue
+import threading
+import time
+import weakref
+from collections import deque
+from contextlib import contextmanager
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_DONE = object()        # assemble exhausted its iterator
+
+
+_alias_probe_memo = {}
+
+
+def staging_aliases_host(jax):
+    """True when ``jax.device_put`` on this backend may return an array
+    aliasing the source host buffer (observed on the CPU backend for large
+    aligned arrays) — recycling a staged-from arena would then corrupt
+    batches the consumer still holds. Probed once per process per backend
+    with a buffer large enough to take the zero-copy path; the transfer is
+    fenced before the source is mutated so a copying backend whose DMA is
+    still in flight can't be misread as aliasing. Any failure (or a
+    misread) errs toward True — the aliasing mode is the conservative one
+    (GC-gated recycling).
+    """
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 - unknown backend: assume the worst
+        return True
+    if backend not in _alias_probe_memo:
+        try:
+            src = np.zeros(1 << 20, np.uint8)
+            staged = jax.device_put(src)
+            jax.block_until_ready(staged)
+            src[0] = 1
+            _alias_probe_memo[backend] = int(np.asarray(staged)[0]) == 1
+        except Exception:  # noqa: BLE001
+            _alias_probe_memo[backend] = True
+    return _alias_probe_memo[backend]
+
+
+class HostArena(object):
+    """One batch's worth of recyclable per-field host buffers."""
+
+    def __init__(self, pool, spec):
+        # spec: {name: (shape, dtype)}; shape includes the batch dim.
+        self.buffers = {name: np.empty(shape, dtype)
+                        for name, (shape, dtype) in spec.items()}
+        self._pool = pool
+        self._lock = threading.Lock()
+        self._holds = 0
+        self._retired = False
+        self._reclaimed = False
+
+    @property
+    def nbytes(self):
+        return sum(b.nbytes for b in self.buffers.values())
+
+    def add_hold(self, obj):
+        """Keep this arena out of the free list until ``obj`` is garbage
+        collected (used when staged arrays alias the arena's memory)."""
+        with self._lock:
+            self._holds += 1
+        weakref.finalize(obj, self._drop_hold)
+
+    def _drop_hold(self):
+        with self._lock:
+            self._holds -= 1
+            ready = (self._retired and self._holds == 0
+                     and not self._reclaimed)
+            if ready:
+                self._retired = False
+                self._reclaimed = True
+        if ready:
+            self._pool._reclaim(self)
+
+    def retire(self):
+        """Transfer done: return to the pool once no holds remain.
+        Idempotent — stop-path drains can race the normal retire."""
+        with self._lock:
+            if self._reclaimed:
+                return
+            if self._holds:
+                self._retired = True
+                return
+            self._reclaimed = True
+        self._pool._reclaim(self)
+
+
+class ArenaPool(object):
+    """Bounded pool of :class:`HostArena` with backpressure and counters.
+
+    The assembler calls :meth:`get_buffers` (blocking, stop-aware) and the
+    engine pairs the yielded batch with :meth:`claim_pending`. Batches
+    whose shapes differ from the pool's spec (e.g. a ``partial`` final
+    batch) bypass the pool (``get_buffers`` returns ``None``).
+    """
+
+    def __init__(self, depth, stop_event=None, grow_timeout_s=0.5,
+                 tracer=None, meter=None, meter_stage='assemble'):
+        if depth < 1:
+            raise ValueError('ArenaPool depth must be >= 1, got {}'.format(depth))
+        self._depth = depth
+        self._stop = stop_event if stop_event is not None else threading.Event()
+        self._grow_timeout_s = grow_timeout_s
+        # Backpressure waits happen inside the assembler's tracked section;
+        # pausing the meter keeps them out of busy/overlap accounting (an
+        # arena-starved pipeline must not read as perfectly overlapped —
+        # arena_wait_s reports the stall instead).
+        self._meter = meter
+        self._meter_stage = meter_stage
+        if tracer is None:
+            from petastorm_tpu.trace import NullTracer
+            tracer = NullTracer()
+        self._tracer = tracer
+        self._cond = threading.Condition()
+        self._free = []
+        self._spec = None
+        self._allocated = 0
+        self._pending = None
+        # counters (reset_stats() zeroes these, never the pool itself)
+        self._alloc = 0
+        self._reuse = 0
+        self._wait_s = 0.0
+
+    def _matches(self, spec):
+        if self._spec is None:
+            self._spec = dict(spec)
+            return True
+        return spec == self._spec
+
+    def get_buffers(self, spec):
+        """Buffers for one batch of ``spec`` ({name: (shape, dtype)}), or
+        ``None`` when the spec mismatches the pool or the pool is stopping.
+        Blocks (stop-aware) while every arena is out; waits longer than
+        ``grow_timeout_s`` allocate past ``depth`` instead of deadlocking.
+        """
+        with self._cond:
+            if not self._matches(spec):
+                return None
+            waited = 0.0
+            while True:
+                if self._stop.is_set():
+                    return None
+                if self._free:
+                    arena = self._free.pop()
+                    arena._reclaimed = False
+                    self._reuse += 1
+                    break
+                if self._allocated < self._depth or waited >= self._grow_timeout_s:
+                    arena = HostArena(self, self._spec)
+                    self._allocated += 1
+                    self._alloc += 1
+                    # Growth is STICKY: depth tracks the high-water mark so
+                    # a consumer that legitimately pins more than the
+                    # initial depth (superbatches(k)) pays the grow timeout
+                    # once, not once per extra arena on every cycle.
+                    if self._allocated > self._depth:
+                        self._depth = self._allocated
+                    break
+                t0 = time.perf_counter()
+                if self._meter is not None:
+                    with self._meter.pause(self._meter_stage):
+                        self._cond.wait(timeout=0.05)
+                else:
+                    self._cond.wait(timeout=0.05)
+                waited += time.perf_counter() - t0
+                self._wait_s += time.perf_counter() - t0
+            self._pending = arena
+            self._tracer.counter('arena_pool_free', len(self._free), 'staging')
+            return arena.buffers
+
+    def claim_pending(self):
+        """The arena handed out by the latest ``get_buffers`` call (or
+        ``None``): called by the engine right after the host iterator
+        yields, pairing the batch with its backing arena."""
+        with self._cond:
+            arena, self._pending = self._pending, None
+            return arena
+
+    def _reclaim(self, arena):
+        with self._cond:
+            if len(self._free) < self._depth:
+                self._free.append(arena)
+            else:
+                self._allocated -= 1   # grown-past-depth arena: let it die
+            self._cond.notify_all()
+            self._tracer.counter('arena_pool_free', len(self._free), 'staging')
+
+    def reclaim_pending(self):
+        """Shutdown path: an arena handed out but never claimed (the
+        assembler died between fill and yield) must not leak."""
+        arena = self.claim_pending()
+        if arena is not None:
+            arena.retire()
+
+    def stats(self):
+        with self._cond:
+            return {'arena_alloc': self._alloc,
+                    'arena_reuse': self._reuse,
+                    'arena_wait_s': round(self._wait_s, 4),
+                    'arena_depth': self._depth,
+                    'arena_allocated': self._allocated}
+
+    def reset_stats(self):
+        with self._cond:
+            self._alloc = 0
+            self._reuse = 0
+            self._wait_s = 0.0
+
+
+class OverlapMeter(object):
+    """Wall-clock co-activity of named stages (assemble vs dispatch).
+
+    ``reset()`` starts a new measurement window (the bench resets after
+    warmup) but lifetime totals survive it — on zero-copy backends the
+    cache-warm steady state has nearly nothing left to overlap (both
+    stages are view handoffs), so the decode-bound phase where dispatch
+    genuinely hides under assembly is only visible in the totals.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = 0
+        self._mark = None
+        self._busy = {}
+        self._overlap_s = 0.0
+        self._base_busy = {}
+        self._base_overlap = 0.0
+
+    def _transition(self, delta):
+        now = time.perf_counter()
+        if self._active >= 2 and self._mark is not None:
+            self._overlap_s += now - self._mark
+        self._active += delta
+        self._mark = now
+        return now
+
+    @contextmanager
+    def track(self, name):
+        with self._lock:
+            t0 = self._transition(+1)
+        try:
+            yield
+        finally:
+            with self._lock:
+                t1 = self._transition(-1)
+                self._busy[name] = self._busy.get(name, 0.0) + (t1 - t0)
+
+    @contextmanager
+    def pause(self, name):
+        """Suspend a stage from inside its ``track`` section — used while
+        the assembler is merely *blocked* (reader starvation) so idle wait
+        doesn't masquerade as busy/overlapping collate time. The paused
+        span is subtracted from the stage's busy seconds and stops overlap
+        accrual for its duration."""
+        with self._lock:
+            t0 = self._transition(-1)
+        try:
+            yield
+        finally:
+            with self._lock:
+                t1 = self._transition(+1)
+                self._busy[name] = self._busy.get(name, 0.0) - (t1 - t0)
+
+    @staticmethod
+    def _frac(busy, overlap):
+        floor = min(busy.values()) if len(busy) >= 2 else 0.0
+        return min(1.0, overlap / floor) if floor > 1e-9 else 0.0
+
+    def stats(self, total=False):
+        with self._lock:
+            busy = dict(self._busy)
+            overlap = self._overlap_s
+            if not total:
+                busy = {k: v - self._base_busy.get(k, 0.0)
+                        for k, v in busy.items()}
+                overlap -= self._base_overlap
+        return {'busy_s': {k: round(v, 4) for k, v in busy.items()},
+                'overlap_s': round(overlap, 4),
+                'overlap_frac': round(self._frac(busy, overlap), 4)}
+
+    def reset(self):
+        """Start a new window; lifetime totals (``stats(total=True)``)
+        keep accumulating."""
+        with self._lock:
+            self._base_busy = dict(self._busy)
+            self._base_overlap = self._overlap_s
+
+
+class MeteredReader(object):
+    """Iteration proxy reporting time blocked in the underlying reader as
+    *paused* assemble time (``OverlapMeter.pause``): the assemble stage's
+    busy/overlap accounting then covers collate work only, not reader
+    starvation — an input-bound run must not read as perfectly overlapped
+    pipelining. Every non-iteration attribute passes through."""
+
+    def __init__(self, reader, meter, stage='assemble'):
+        self._pst_reader = reader
+        self._pst_meter = meter
+        self._pst_stage = stage
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        with self._pst_meter.pause(self._pst_stage):
+            return next(self._pst_reader)
+
+    def __getattr__(self, name):
+        return getattr(self._pst_reader, name)
+
+
+class _StageError(object):
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class StagingEngine(object):
+    """Assemble/dispatch pipeline feeding a consumer queue.
+
+    :param host_iter: iterator of host-batch dicts (typically
+        ``iter_numpy_batches(..., batch_buffers=pool.get_buffers)`` so the
+        batches land in pool arenas).
+    :param stage_fn: host batch dict -> staged dict (async device puts).
+    :param out_queue: bounded consumer queue; receives staged dicts in
+        order, then ``end_sentinel`` (or an ``Exception`` on failure).
+    :param stop_event: shared stop flag; no engine thread outlives it.
+    :param pool: the :class:`ArenaPool` backing ``host_iter`` (or None).
+    :param inflight: max staged batches whose transfers may be in flight
+        before the dispatch thread blocks on the oldest (the backpressure
+        window from the ISSUE; also bounds how much arena memory a burst
+        can pin).
+    :param ready_fn: staged dict -> blocks until its transfer completed
+        (``jax.block_until_ready``). Called before an arena is retired.
+    :param is_ready_fn: staged dict -> bool, non-blocking (opportunistic
+        early retirement); optional.
+    :param holds_mode: staged arrays alias arena memory (zero-copy
+        backends): register GC holds so an arena is never recycled while
+        the consumer can still observe it.
+    """
+
+    def __init__(self, host_iter, stage_fn, out_queue, stop_event,
+                 end_sentinel, pool=None, inflight=2, ready_fn=None,
+                 is_ready_fn=None, holds_mode=False, tracer=None,
+                 meter=None):
+        self._host_iter = host_iter
+        self._stage_fn = stage_fn
+        self._out = out_queue
+        self._stop = stop_event
+        self._end = end_sentinel
+        self._pool = pool
+        self._window = max(1, int(inflight))
+        self._ready_fn = ready_fn or (lambda staged: None)
+        self._is_ready_fn = is_ready_fn
+        self._holds_mode = holds_mode
+        if tracer is None:
+            from petastorm_tpu.trace import NullTracer
+            tracer = NullTracer()
+        self._tracer = tracer
+        self.meter = meter if meter is not None else OverlapMeter()
+        self._stats_lock = threading.Lock()
+        self._retired = 0
+        self._ready_wait_s = 0.0
+        self._stage_q = queue.Queue(maxsize=2)
+        self._threads = [
+            threading.Thread(target=self._assemble_loop, daemon=True,
+                             name='pst-staging-assemble'),
+            threading.Thread(target=self._dispatch_loop, daemon=True,
+                             name='pst-staging-dispatch'),
+        ]
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    # -- stop-aware queue helpers ----------------------------------------
+
+    def _put(self, q, obj):
+        """Bounded-queue put that never outlives stop() (PR 1 semantics:
+        an unbounded put can leak the thread forever if the consumer left).
+        Returns whether ``obj`` was actually enqueued — the caller owns its
+        cleanup ONLY on False, or a stop-time race would settle the same
+        arena twice. When stopping, a final non-blocking attempt still
+        wakes a consumer already parked in an untimed get()."""
+        while not self._stop.is_set():
+            try:
+                q.put(obj, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        try:
+            q.put_nowait(obj)
+            return True
+        except queue.Full:
+            return False
+
+    def _get(self):
+        while not self._stop.is_set():
+            try:
+                return self._stage_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+        try:
+            return self._stage_q.get_nowait()
+        except queue.Empty:
+            return None
+
+    # -- assemble stage ---------------------------------------------------
+
+    def _assemble_loop(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    with self.meter.track('assemble'):
+                        with self._tracer.span('assemble', 'host'):
+                            batch = next(self._host_iter)
+                except StopIteration:
+                    break
+                arena = self._pool.claim_pending() if self._pool else None
+                if not self._put(self._stage_q, (batch, arena)):
+                    if arena is not None:
+                        arena.retire()
+                    return
+        except Exception as e:  # noqa: BLE001 - surfaced to consumer
+            if self._pool is not None:
+                self._pool.reclaim_pending()
+            self._put(self._stage_q, _StageError(e))
+            return
+        self._put(self._stage_q, _DONE)
+
+    # -- dispatch stage ---------------------------------------------------
+
+    def _head_ready(self, staged):
+        if self._is_ready_fn is None:
+            return False
+        try:
+            return bool(self._is_ready_fn(staged))
+        except Exception:  # noqa: BLE001 - readiness probe must not kill dispatch
+            return False
+
+    def _retire(self, staged, arena, wait):
+        if arena is None:
+            return
+        if wait and not self._stop.is_set():
+            t0 = time.perf_counter()
+            self._ready_fn(staged)
+            with self._stats_lock:
+                self._ready_wait_s += time.perf_counter() - t0
+        arena.retire()
+        with self._stats_lock:
+            self._retired += 1
+
+    def _dispatch_loop(self):
+        inflight = deque()
+        arena = None    # the current batch's arena until the window owns it
+        try:
+            while True:
+                item = self._get()
+                if item is None:          # stopping
+                    return
+                if item is _DONE:
+                    while inflight:
+                        self._retire(*inflight.popleft(), wait=True)
+                    self._put(self._out, self._end)
+                    return
+                if isinstance(item, _StageError):
+                    while inflight:
+                        self._retire(*inflight.popleft(), wait=True)
+                    self._put(self._out, item.exc)
+                    return
+                batch, arena = item
+                if self._stop.is_set():
+                    # Never issue device puts into a stopping pipe (the old
+                    # stage loop's fetch/stage stop-check): on a wedged
+                    # device a put can hang past the join timeout, leaving
+                    # a leaked thread holding reader views whose teardown
+                    # it races.
+                    return
+                with self.meter.track('dispatch'):
+                    with self._tracer.span('dispatch', 'device'):
+                        staged = self._stage_fn(batch)
+                if arena is not None:
+                    if self._holds_mode:
+                        for value in staged.values():
+                            arena.add_hold(value)
+                    inflight.append((staged, arena))
+                    arena = None
+                    self._tracer.counter('staging_inflight', len(inflight),
+                                         'staging')
+                del batch
+                if not self._put(self._out, staged):
+                    return
+                del staged
+                # Opportunistic early retirement, then hard backpressure:
+                # block on the OLDEST in-flight transfer once the window
+                # is full — collate of batch N+1 proceeds in the assemble
+                # thread meanwhile, which is the overlap this engine exists
+                # to create.
+                while inflight and self._head_ready(inflight[0][0]):
+                    self._retire(*inflight.popleft(), wait=False)
+                while len(inflight) > self._window:
+                    self._retire(*inflight.popleft(), wait=True)
+        except Exception as e:  # noqa: BLE001 - surfaced to consumer
+            # Deliver first (the stop-aware put is reliable while the
+            # consumer lives), THEN stop the whole engine: the assembler
+            # must not keep retrying its bounded put forever (a leaked
+            # stager holding reader refs), and with stop set no arena can
+            # be handed out again, making the wait=False drain below safe.
+            self._put(self._out, e)
+            self._stop.set()
+        finally:
+            # Shutdown: no arena may leak — neither the failing batch's
+            # (claimed but never appended to the window) nor the window's.
+            # Stop is set on every path that reaches here with entries
+            # outstanding, so a retired arena cannot be re-handed-out and
+            # overwritten under a still-running transfer; the transfers
+            # themselves keep their memory alive via their own references.
+            if arena is not None:
+                arena.retire()
+            while inflight:
+                self._retire(*inflight.popleft(), wait=False)
+
+    # -- lifecycle / stats -------------------------------------------------
+
+    def stop(self, join_timeout_s=10):
+        """Idempotent: set stop, unblock both threads, join them, settle
+        arena bookkeeping. The caller drains ``out_queue`` (it owns it)."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=join_timeout_s)
+        if self._pool is not None:
+            self._pool.reclaim_pending()
+        # Drain whatever assemble left between the stages.
+        while True:
+            try:
+                item = self._stage_q.get_nowait()
+            except queue.Empty:
+                break
+            if isinstance(item, tuple) and item[1] is not None:
+                item[1].retire()
+
+    @property
+    def alive(self):
+        return any(t.is_alive() for t in self._threads)
+
+    def stats(self):
+        m = self.meter.stats()
+        total = self.meter.stats(total=True)
+        with self._stats_lock:
+            retired, ready_wait = self._retired, self._ready_wait_s
+        return {'assemble_s': m['busy_s'].get('assemble', 0.0),
+                'dispatch_s': m['busy_s'].get('dispatch', 0.0),
+                'overlap_s': m['overlap_s'],
+                'overlap_frac': m['overlap_frac'],
+                'overlap_frac_total': total['overlap_frac'],
+                'inflight_retired': retired,
+                'ready_wait_s': round(ready_wait, 4)}
+
+    def reset_stats(self):
+        self.meter.reset()
+        with self._stats_lock:
+            self._retired = 0
+            self._ready_wait_s = 0.0

@@ -90,6 +90,13 @@ class TensorWorker(RowGroupWorkerBase):
         # always private — no defensive copy needed before transforms.
         cached = (worker_predicate is None
                   and not isinstance(self.args['cache'], NullCache))
+        # Block-handoff ownership marker: ``private=False`` blocks are (or
+        # may be) shared by reference with the RAM cache and MUST only ever
+        # be copied FROM downstream — the loader's recycled-arena collate
+        # path would corrupt every later epoch if it took ownership of (or
+        # padded/recycled in place) a cached block. Transform and in-chunk-
+        # shuffle below both copy, flipping the chunk back to private.
+        private = not cached
         if worker_predicate is None:
             import hashlib
             cache_key = 'tensor:{}:{}:{}:{}'.format(
@@ -126,6 +133,7 @@ class TensorWorker(RowGroupWorkerBase):
             # epoch 2's cache hit would serve already-transformed data.
             if cached:
                 cols = {k: np.array(v, copy=True) for k, v in cols.items()}
+                private = True
             out = transform_spec.func(dict(cols))
             for name in transform_spec.removed_fields:
                 out.pop(name, None)
@@ -144,11 +152,13 @@ class TensorWorker(RowGroupWorkerBase):
                 self.args.get('shuffle_seed'), self.args['dataset_path_hash'],
                 piece.path, piece.row_group, shuffle_row_drop_partition, n_rows)
             cols = {k: v[perm] for k, v in cols.items()}
+            private = True
 
         if n_rows:
             self.publish_func({'__pst_tensor_chunk__': 1,
                                'key': chunk_key(piece_index, shuffle_row_drop_partition),
                                'cols': cols,
+                               'private': private,
                                'timings': timings})
 
     # --- loading ------------------------------------------------------
@@ -219,6 +229,7 @@ class TensorResultsQueueReader(DeferredRowAccounting):
     def __init__(self):
         self._timings = {'read_s': 0.0, 'decode_s': 0.0, 'cache_s': 0.0,
                          'chunks': 0}
+        self._last_private = False
 
     @property
     def batched_output(self):
@@ -228,12 +239,23 @@ class TensorResultsQueueReader(DeferredRowAccounting):
     def stage_timings(self):
         return dict(self._timings)
 
+    @property
+    def last_chunk_private(self):
+        """Ownership of the chunk most recently returned by ``read_next``:
+        True when its blocks are NOT shared with a cache, so a downstream
+        collate stage may take ownership of (donate/recycle) them. Read
+        synchronously right after the reader yields — the flag refers to
+        that sample. Resume-skip slicing keeps the flag: a view of a
+        private block is still unshared."""
+        return self._last_private
+
     def read_next(self, pool, schema, ngram):
         if ngram is not None:
             raise NotImplementedError('NGram is not supported with tensor readers')
         while True:
             chunk = pool.get_results()
             cols, key = chunk['cols'], chunk['key']
+            self._last_private = bool(chunk.get('private'))
             t = chunk.get('timings') or {}
             for k in ('read_s', 'decode_s', 'cache_s'):
                 if k in t:

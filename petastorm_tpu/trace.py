@@ -59,6 +59,19 @@ class Tracer(object):
                 'pid': 0, 'tid': threading.get_ident(),
             })
 
+    def counter(self, name, value, cat='pipeline'):
+        """A counter-track sample (chrome trace 'C' event): renders as a
+        filled area chart. Used by the staging engine for arena-pool
+        occupancy and the in-flight transfer window, so a timeline shows
+        backpressure (pool pinned at 0 free) next to the spans it stalls."""
+        with self._lock:
+            self._events.append({
+                'name': name, 'cat': cat, 'ph': 'C',
+                'ts': (time.perf_counter() - self._t0) * 1e6,
+                'pid': 0, 'tid': threading.get_ident(),
+                'args': {name: value},
+            })
+
     @property
     def events(self):
         with self._lock:
@@ -116,6 +129,9 @@ class NullTracer(object):
         return self._SPAN
 
     def instant(self, name, cat='pipeline'):
+        pass
+
+    def counter(self, name, value, cat='pipeline'):
         pass
 
 

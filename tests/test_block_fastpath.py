@@ -1,0 +1,245 @@
+"""Block fast-path tests (ISSUE 2 satellites): ``last_batch='pad'/'partial'``
+when a batch spans multiple chunks, arena-fill collation (``np.copyto`` into
+provided buffers instead of ``np.concatenate``), and the block-handoff
+ownership marker (``last_chunk_private``) that keeps arena fills from ever
+taking ownership of cache-shared blocks.
+"""
+
+from collections import namedtuple
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_tensor_reader
+from petastorm_tpu.jax_loader import _iter_block_batches, iter_numpy_batches
+
+Sample = namedtuple('Sample', ['id', 'vec'])
+
+
+class FakeBlockReader(object):
+    """Minimal batched reader: yields premade chunks, reports ownership."""
+
+    batched_output = True
+
+    def __init__(self, chunks, private):
+        # chunks: list of dicts name -> array; private: list of bools
+        self._chunks = list(chunks)
+        self._private = list(private)
+        self.last_chunk_private = False
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._i >= len(self._chunks):
+            raise StopIteration
+        chunk = self._chunks[self._i]
+        self.last_chunk_private = self._private[self._i]
+        self._i += 1
+        return Sample(**chunk)
+
+
+def _chunks(sizes, start=0):
+    out = []
+    base = start
+    for n in sizes:
+        ids = np.arange(base, base + n, dtype=np.int32)
+        out.append({'id': ids,
+                    'vec': np.stack([ids, ids]).T.astype(np.float32)})
+        base += n
+    return out
+
+
+def _blocks(reader, batch, last_batch='drop', **kw):
+    return list(_iter_block_batches(reader, batch, {}, last_batch, False,
+                                    False, **kw))
+
+
+# ---------------------------------------------------------------------------
+# pad / partial across chunk boundaries
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('views_ok', [True, False])
+def test_pad_batch_spanning_multiple_chunks(views_ok):
+    """Final batch assembled from several short chunks, then repeat-padded:
+    chunks of 3+3+2 rows with batch 12 -> one padded batch, pad rows all
+    equal to the last real row."""
+    reader = FakeBlockReader(_chunks([3, 3, 2]), [False] * 3)
+    batches = _blocks(reader, 12, last_batch='pad', views_ok=views_ok)
+    assert len(batches) == 1
+    b = batches[0]
+    assert b['id'].shape == (12,)
+    np.testing.assert_array_equal(b['id'][:8], np.arange(8))
+    np.testing.assert_array_equal(b['id'][8:], np.full(4, 7))
+    np.testing.assert_array_equal(b['vec'][8:], np.full((4, 2), 7.0))
+
+
+@pytest.mark.parametrize('views_ok', [True, False])
+def test_partial_batch_spanning_multiple_chunks(views_ok):
+    reader = FakeBlockReader(_chunks([3, 3, 2]), [False] * 3)
+    batches = _blocks(reader, 6, last_batch='partial', views_ok=views_ok)
+    assert [len(b['id']) for b in batches] == [6, 2]
+    np.testing.assert_array_equal(batches[1]['id'], [6, 7])
+
+
+def test_pad_never_mutates_source_chunks():
+    """The repeat-pad fill must copy FROM the tail chunk, never write into
+    it — a cache-shared block padded in place would corrupt later epochs."""
+    chunks = _chunks([3, 2])
+    originals = [{k: v.copy() for k, v in c.items()} for c in chunks]
+    reader = FakeBlockReader(chunks, [False, False])
+    _blocks(reader, 8, last_batch='pad', views_ok=True)
+    for chunk, orig in zip(chunks, originals):
+        for name in chunk:
+            np.testing.assert_array_equal(chunk[name], orig[name])
+
+
+def test_mid_epoch_batches_spanning_chunks_with_pad_tensor_reader(
+        synthetic_dataset):
+    """End-to-end over the real tensor reader: 50 rows in 10-row chunks,
+    batch 8 -> every batch boundary crosses chunks; pad fills the tail."""
+    with make_tensor_reader(synthetic_dataset.url, schema_fields=['id'],
+                            reader_pool_type='dummy', num_epochs=1,
+                            shuffle_row_groups=False) as reader:
+        batches = list(iter_numpy_batches(reader, 8, last_batch='pad'))
+    assert len(batches) == 7
+    ids = np.concatenate([b['id'] for b in batches])
+    assert sorted(set(ids.tolist())) == list(range(50))
+    np.testing.assert_array_equal(batches[-1]['id'][2:], np.full(6, 49))
+
+
+# ---------------------------------------------------------------------------
+# arena fills (batch_buffers) and ownership
+# ---------------------------------------------------------------------------
+
+class RecordingProvider(object):
+    """batch_buffers stand-in: hands out one reusable buffer set and
+    records every request."""
+
+    def __init__(self):
+        self.requests = []
+        self.buffers = None
+
+    def __call__(self, spec):
+        self.requests.append(spec)
+        if self.buffers is None:
+            self.buffers = {name: np.empty(shape, dtype)
+                            for name, (shape, dtype) in spec.items()}
+        if any(self.buffers[name].shape != shape
+               for name, (shape, _) in spec.items()):
+            return None
+        return self.buffers
+
+
+def test_spanning_batches_fill_provided_buffers():
+    provider = RecordingProvider()
+    reader = FakeBlockReader(_chunks([3, 3, 2]), [False] * 3)
+    batches = _blocks(reader, 4, views_ok=True, batch_buffers=provider)
+    assert len(batches) == 2
+    # Batch 0 (rows 0-3) spans chunks -> collated into the provider's
+    # buffer; its arrays ARE the buffer objects.
+    assert batches[0]['id'] is provider.buffers['id']
+    np.testing.assert_array_equal(batches[1]['id'], [4, 5, 6, 7])
+
+
+def test_arena_fill_reads_but_never_mutates_shared_chunks():
+    chunks = _chunks([3, 3, 2])
+    originals = [{k: v.copy() for k, v in c.items()} for c in chunks]
+    reader = FakeBlockReader(chunks, [False] * 3)
+    _blocks(reader, 4, views_ok=False, batch_buffers=RecordingProvider())
+    for chunk, orig in zip(chunks, originals):
+        for name in chunk:
+            np.testing.assert_array_equal(chunk[name], orig[name])
+
+
+def test_private_whole_chunk_donated_shared_copied():
+    """views_ok=False (stable-arena mode): a whole PRIVATE chunk exactly
+    covering a batch is handed out by reference (zero memcpy); a SHARED
+    chunk of the same shape must be copied out instead."""
+    chunks = _chunks([4, 4])
+    reader = FakeBlockReader(chunks, [True, False])
+    batches = _blocks(reader, 4, views_ok=False,
+                      batch_buffers=RecordingProvider())
+    assert batches[0]['id'] is chunks[0]['id']        # donated
+    assert batches[1]['id'] is not chunks[1]['id']    # copied from
+    np.testing.assert_array_equal(batches[1]['id'], chunks[1]['id'])
+
+
+def test_views_ok_hands_out_chunk_views():
+    """views_ok=True (zero-copy backends): single-chunk batches are views
+    of the chunk, shared or not — read-only downstream."""
+    chunks = _chunks([8])
+    reader = FakeBlockReader(chunks, [False])
+    batches = _blocks(reader, 4, views_ok=True)
+    assert np.shares_memory(batches[0]['id'], chunks[0]['id'])
+    assert np.shares_memory(batches[1]['id'], chunks[0]['id'])
+
+
+def test_sanitize_copy_upgrades_chunk_to_private():
+    """A chunk whose every field was copied by dtype sanitization is
+    private regardless of what the reader reported (x64 off: int64 ->
+    int32 allocates), so it may be donated."""
+    ids = np.arange(4, dtype=np.int64)
+    reader = FakeBlockReader([{'id': ids, 'vec': np.ones((4, 2))}], [False])
+    batches = _blocks(reader, 4, views_ok=False,
+                      batch_buffers=RecordingProvider())
+    assert batches[0]['id'].dtype == np.int32
+    assert not np.shares_memory(batches[0]['id'], ids)
+
+
+def test_last_chunk_private_plumbing_tensor_reader(synthetic_dataset):
+    """NullCache (default) publishes private chunks; a memory cache makes
+    them shared — the reader property reflects the worker's marker."""
+    with make_tensor_reader(synthetic_dataset.url, schema_fields=['id'],
+                            reader_pool_type='dummy', num_epochs=1,
+                            shuffle_row_groups=False) as reader:
+        next(iter(reader))
+        assert reader.last_chunk_private is True
+    with make_tensor_reader(synthetic_dataset.url, schema_fields=['id'],
+                            reader_pool_type='dummy', num_epochs=1,
+                            shuffle_row_groups=False,
+                            cache_type='memory') as reader:
+        next(iter(reader))
+        assert reader.last_chunk_private is False
+
+
+def test_cached_blocks_survive_arena_epochs(synthetic_dataset):
+    """Two epochs over a memory cache through the arena-fill path
+    (views_ok=False forces collation): epoch 2 must see identical data —
+    the fills only ever copied FROM the cached blocks."""
+    with make_tensor_reader(synthetic_dataset.url, schema_fields=['id'],
+                            reader_pool_type='dummy', num_epochs=2,
+                            shuffle_row_groups=False,
+                            cache_type='memory') as reader:
+        provider = RecordingProvider()
+        snapshots = [np.array(b['id'], copy=True) for b in _blocks(
+            reader, 10, views_ok=False, batch_buffers=provider)]
+    assert len(snapshots) == 10
+    for first, second in zip(snapshots[:5], snapshots[5:]):
+        np.testing.assert_array_equal(first, second)
+
+
+# ---------------------------------------------------------------------------
+# _stack_column out= (per-row arena hookup)
+# ---------------------------------------------------------------------------
+
+def test_stack_column_into_buffer_when_dtype_matches():
+    from petastorm_tpu.jax_loader import _stack_column
+
+    rows = [np.full((2, 2), i, dtype=np.float32) for i in range(4)]
+    out = np.empty((4, 2, 2), np.float32)
+    result = _stack_column(rows, 'f', {}, False, out=out)
+    assert result is out
+    np.testing.assert_array_equal(out[3], np.full((2, 2), 3.0))
+
+
+def test_stack_column_falls_back_on_dtype_mismatch():
+    from petastorm_tpu.jax_loader import _stack_column
+
+    rows = [np.full((2,), i, dtype=np.int64) for i in range(4)]
+    out = np.empty((4, 2), np.int32)     # sanitized target differs from rows
+    result = _stack_column(rows, 'f', {}, False, out=out)
+    assert result is not out
+    assert result.dtype == np.int32
+    np.testing.assert_array_equal(result[2], [2, 2])
