@@ -16,8 +16,8 @@ from petastorm_tpu.data_service import (DataServer, RemoteReader,  # noqa: F401
                                         load_server_snapshot, serve_dataset,
                                         verify_shared_stream_complete)
 from petastorm_tpu.device_cache import DeviceDatasetCache  # noqa: F401
-from petastorm_tpu.errors import (RowGroupQuarantinedError,  # noqa: F401
-                                  WorkerLostError)
+from petastorm_tpu.errors import (PipelineStallError,  # noqa: F401
+                                  RowGroupQuarantinedError, WorkerLostError)
 from petastorm_tpu.job_checkpoint import JobCheckpointer  # noqa: F401
 from petastorm_tpu.reader import (Reader, make_batch_reader,  # noqa: F401
                                   make_reader, make_tensor_reader)

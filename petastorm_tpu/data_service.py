@@ -104,6 +104,18 @@ _SERVER_ID_LEN = 16
 _COUNT_STRUCT = struct.Struct('<Q')
 _META_STRUCT = struct.Struct('<16sQ')   # (server_id, chunk seq)
 _MAC_LEN = 16
+#: After a liveness probe finds an endpoint unreachable (whole rpc retry
+#: budget unanswered), further probes report it dead from memory for this
+#: long instead of re-paying the budget — a watchdog sweeping every tick
+#: must stay bounded even on sole-consumer streams where no failover
+#: permanently retires the endpoint.
+_PROBE_DEAD_BACKOFF_S = 30.0
+
+
+class RpcUnanswered(Exception):
+    """One REQ/REP attempt produced no reply within its window. Retried
+    through the reader's rpc retry policy; only a server that misses the
+    whole budget is treated as dead (a single dropped REP is just slow)."""
 
 
 def _mac(key, *parts):
@@ -720,6 +732,12 @@ class RemoteReader(object):
     :param auth_key: shared secret matching the servers' ``auth_key`` —
         chunk headers, control broadcasts, and rpc replies are then
         authenticated before unpickling (module trust-boundary note).
+    :param rpc_retry_policy: a custom
+        :class:`petastorm_tpu.retry.RetryPolicy` for one-shot rpc calls
+        (schema fetch, resume, liveness probes). Default: 3 attempts with
+        short jittered backoff — one dropped REP must not mark a healthy
+        server dead; only a server that misses the whole budget counts as
+        unreachable.
     """
 
     batched_output = True
@@ -729,7 +747,8 @@ class RemoteReader(object):
 
     def __init__(self, endpoints, control_endpoints=None, rpc_endpoints=None,
                  rcvhwm=4, poll_timeout_s=0.1, shared_stream=False,
-                 end_grace_s=5.0, resume_state=None, auth_key=None):
+                 end_grace_s=5.0, resume_state=None, auth_key=None,
+                 rpc_retry_policy=None):
         import zmq
 
         if isinstance(endpoints, str):
@@ -775,6 +794,20 @@ class RemoteReader(object):
         self._dup_chunks = 0
         self._bad_auth_frames = 0
         self._first_bad_auth_t = None
+        if rpc_retry_policy is None:
+            from petastorm_tpu.retry import RetryPolicy
+            rpc_retry_policy = RetryPolicy(
+                max_attempts=3, base_delay_s=0.05, max_delay_s=0.5,
+                retry_exceptions=(RpcUnanswered,))
+        self._rpc_retry_policy = rpc_retry_policy
+        # Health supervision state (attach_health): rpc-probed liveness,
+        # endpoint -> server_id mapping learned from 'stats' replies, and
+        # servers failed over (shared-stream mode) after a probe declared
+        # them dead.
+        self._hb_recv = None
+        self._endpoint_sids = {}
+        self._failed_endpoints = set()
+        self._probe_dead_until = {}     # endpoint -> monotonic backoff expiry
         # Thread-safety of stop() vs an iterating pump thread: sockets are
         # only touched under _sock_lock; stop() sets _stopped and closes
         # the sockets itself ONLY if it can take the lock without blocking
@@ -836,6 +869,8 @@ class RemoteReader(object):
     def _close_sockets(self):
         if not self._closed:
             self._closed = True
+            if self._hb_recv is not None:
+                self._hb_recv.beat('idle')   # stream over: quiet != stalled
             self._data_sock.close(linger=0)
             self._ctrl_sock.close(linger=0)
 
@@ -879,6 +914,8 @@ class RemoteReader(object):
     def _track(self, sid, seq):
         """Count a received chunk (caller holds _acct_lock); False for a
         duplicate (replayed by a restarted server) — drop, don't count."""
+        if self._hb_recv is not None:
+            self._hb_recv.beat('recv')
         self._last_recv[sid] = time.monotonic()
         tracker = self._seen.get(sid)
         if tracker is None:
@@ -987,7 +1024,7 @@ class RemoteReader(object):
                             'this consumer and the server(s) (a keyless '
                             'server cannot satisfy a keyed consumer).'
                             .format(self._bad_auth_frames))
-                if len(self._ended_server_ids) >= self._n_servers:
+                if self._servers_accounted() >= self._n_servers:
                     if self._server_errors:
                         # Error end: deliver loudly as soon as everything
                         # ended — counts are meaningless mid-failure.
@@ -1148,8 +1185,9 @@ class RemoteReader(object):
             raw = raw[:-_MAC_LEN]
         return pickle.loads(raw)
 
-    def _one_shot_rpc(self, endpoint, request, timeout_ms=10000):
-        """One REQ/REP round-trip on a fresh socket; None on timeout."""
+    def _rpc_attempt(self, endpoint, request, timeout_ms):
+        """One REQ/REP round-trip on a fresh socket (REQ state machines
+        cannot be reused after a lost reply); RpcUnanswered on timeout."""
         zmq = self._zmq
         sock = self._context.socket(zmq.REQ)
         sock.setsockopt(zmq.LINGER, 0)
@@ -1157,10 +1195,143 @@ class RemoteReader(object):
             sock.connect(endpoint)
             sock.send(self._rpc_dumps(request))
             if not sock.poll(timeout_ms):
-                return None
+                raise RpcUnanswered('{} gave no reply within {}ms'.format(
+                    endpoint, timeout_ms))
             return self._rpc_loads(sock.recv())
         finally:
             sock.close(linger=0)
+
+    def _one_shot_rpc(self, endpoint, request, timeout_ms=10000):
+        """One logical rpc under the retry policy: a dropped REP gets a
+        fresh-socket retry (small jittered budget) instead of immediately
+        branding the server dead. ``None`` only once the WHOLE budget is
+        unanswered — callers may then treat the server as unreachable
+        rather than slow."""
+        try:
+            return self._rpc_retry_policy.call(
+                self._rpc_attempt, endpoint, request, timeout_ms,
+                retry_call_name='data-service-rpc')
+        except RpcUnanswered:
+            return None
+
+    # -- health supervision (petastorm_tpu.health) -----------------------
+
+    def attach_health(self, registry):
+        """Register the receive loop with a
+        :class:`~petastorm_tpu.health.HeartbeatRegistry` (called by a
+        wrapping ``JaxLoader``, or directly): the heartbeat is beaten per
+        received chunk, the probe reports per-server silence ages and rpc
+        liveness, and the soft recovery fails a shared stream over to the
+        surviving servers when a probe finds one dead."""
+        from petastorm_tpu import health as health_mod
+        self._hb_recv = registry.register('remote-recv')
+        self._hb_recv.beat('poll')
+        registry.register_probe('remote-recv', self._health_probe)
+
+        def failover(diagnosis):
+            # The diagnosing probe already paid for the rpc round-trips;
+            # reuse its verdict instead of probing all servers again.
+            dead = (diagnosis.get('probes', {}).get('remote-recv', {})
+                    .get('dead_endpoints'))
+            if dead is not None:
+                return self._mark_failed(dead)
+            return self.failover_dead_servers()
+
+        registry.register_recovery(health_mod.REMOTE_SERVER_DEAD, failover)
+
+    def probe_servers(self, timeout_ms=500):
+        """rpc liveness of every server not already failed over:
+        ``(alive, dead)`` where ``alive`` maps rpc endpoint -> its
+        ``stats`` reply and ``dead`` lists the endpoints whose whole retry
+        budget went unanswered. Also learns the endpoint -> server_id
+        mapping used by failover. Endpoints already in
+        ``diagnostics['failed_over_servers']`` are skipped — re-paying the
+        full retry budget for a known-dead server on every watchdog tick
+        would stall the supervisor itself."""
+        alive, dead = {}, []
+        now = time.monotonic()
+        with self._acct_lock:
+            already_failed = set(self._failed_endpoints)
+        for endpoint in self._rpc_endpoints:
+            if endpoint in already_failed:
+                continue
+            if self._probe_dead_until.get(endpoint, 0) > now:
+                dead.append(endpoint)   # recently probed dead: don't re-pay
+                continue
+            reply = self._one_shot_rpc(endpoint, {'cmd': 'stats'},
+                                       timeout_ms=timeout_ms)
+            if reply is None or 'error' in reply:
+                self._probe_dead_until[endpoint] = now + _PROBE_DEAD_BACKOFF_S
+                dead.append(endpoint)
+            else:
+                self._probe_dead_until.pop(endpoint, None)
+                alive[endpoint] = reply
+                if reply.get('server_id') is not None:
+                    with self._acct_lock:   # _servers_accounted iterates this
+                        self._endpoint_sids[endpoint] = reply['server_id']
+        return alive, dead
+
+    def _health_probe(self):
+        """Watchdog probe: runs only while SOME stage looks stalled (any
+        classification, not just remote ones), never on the hot path — so
+        the rpc round-trips are acceptable, and already-failed-over
+        endpoints are excluded to keep each sweep bounded."""
+        diag = self.diagnostics
+        _alive, dead = self.probe_servers()
+        return {'server_last_chunk_age_s': diag['server_last_chunk_age_s'],
+                'servers_ended': diag['servers_ended'],
+                'failed_over': diag['failed_over_servers'],
+                'dead_endpoints': dead}
+
+    def failover_dead_servers(self, timeout_ms=500):
+        """Shared-stream soft recovery: mark rpc-dead servers as ended so
+        the surviving servers keep feeding and end-of-stream accounting
+        completes (grace window) instead of waiting forever on a corpse.
+        Sole-consumer streams refuse — their exact end accounting would
+        silently truncate the epoch; they surface the death via the stall
+        diagnosis / end-of-stream error instead. Returns True when a
+        server was failed over."""
+        if not self._shared_stream:
+            return False
+        _alive, dead = self.probe_servers(timeout_ms=timeout_ms)
+        return self._mark_failed(dead)
+
+    def _mark_failed(self, dead_endpoints):
+        if not self._shared_stream:
+            return False
+        acted = False
+        for endpoint in dead_endpoints:
+            with self._acct_lock:   # watchdog thread vs pump-thread readers
+                if endpoint in self._failed_endpoints:
+                    continue
+                self._failed_endpoints.add(endpoint)
+                sid = self._endpoint_sids.get(endpoint)
+                if sid is not None:
+                    self._ended_server_ids.add(sid)
+                survivors = self._n_servers - len(self._failed_endpoints)
+            acted = True
+            logger.warning(
+                'data-service server %s unreachable over rpc (whole retry '
+                'budget unanswered); failing the shared stream over to %d '
+                'surviving server(s)', endpoint, survivors)
+        return acted
+
+    def _servers_accounted(self):
+        """END-declared servers plus failed-over servers whose identity was
+        never learned (they died before answering any rpc, so no sid could
+        be added to the ended set). An unknown-dead endpoint may actually
+        BE one of the cleanly-ended servers (a server whose process exits
+        after END also stops answering rpc), so unknown-dead endpoints only
+        count beyond the ENDed sids that no probed endpoint accounts for —
+        otherwise a dead-after-END server would be double-counted and end a
+        shared stream while a healthy peer is still feeding."""
+        with self._acct_lock:
+            known_sids = set(self._endpoint_sids.values())
+            unmatched_ends = len(self._ended_server_ids - known_sids)
+            unknown_dead = sum(1 for e in self._failed_endpoints
+                               if self._endpoint_sids.get(e) is None)
+            return (len(self._ended_server_ids)
+                    + max(0, unknown_dead - unmatched_ends))
 
     @property
     def transformed_schema(self):
@@ -1200,12 +1371,17 @@ class RemoteReader(object):
             ages = {sid.hex(): round(now - t, 3)
                     for sid, t in self._last_recv.items()
                     if sid not in self._ended_server_ids}
+            failed_over = sorted(self._failed_endpoints)
         return {'remote_chunks': self._chunks,
                 'servers': self._n_servers,
                 'servers_ended': len(self._ended_server_ids),
                 'pending_chunks': len(self._pending),
                 'duplicate_chunks': self._dup_chunks,
                 'bad_auth_frames': self._bad_auth_frames,
+                # Servers a watchdog liveness probe declared dead and
+                # failed over (shared-stream mode only; see
+                # failover_dead_servers).
+                'failed_over_servers': failed_over,
                 # Seconds since each server's last chunk: a server gone
                 # silent (SIGKILL, network partition) shows a growing age
                 # here long before the end-of-epoch accounting notices.

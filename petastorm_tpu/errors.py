@@ -16,6 +16,11 @@ Exception hierarchy::
     │                                     reader's ``error_budget`` (or a
     │                                     quarantine arrived with no budget
     │                                     configured)
+    ├── PipelineStallError                the health watchdog diagnosed a
+    │                                     stalled stage and soft recovery
+    │                                     did not clear it (carries the
+    │                                     full diagnosis: classification,
+    │                                     beat table, thread stacks)
     └── PodAbortError                     a pod peer died/desynced; defined
                                           in ``parallel/pod_guard.py``
 
@@ -73,6 +78,20 @@ class RowGroupQuarantinedError(PetastormTpuError):
     def __init__(self, message, quarantined=None):
         super(RowGroupQuarantinedError, self).__init__(message)
         self.quarantined = list(quarantined or [])
+
+
+class PipelineStallError(PetastormTpuError):
+    """The health watchdog (``petastorm_tpu.health``) diagnosed a stalled
+    pipeline stage and escalating recovery did not clear it.
+
+    The message names the stalled stage and classification and embeds the
+    all-thread stack dump; ``diagnosis`` holds the structured report
+    (classification, stage, detail, last-beat table, probe snapshots,
+    stacks) for programmatic triage."""
+
+    def __init__(self, message, diagnosis=None):
+        super(PipelineStallError, self).__init__(message)
+        self.diagnosis = diagnosis or {}
 
 
 #: Failure classes a worker may *quarantine* (skip-and-record the row-group)

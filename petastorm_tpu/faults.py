@@ -15,13 +15,16 @@ Spec syntax (semicolon-separated sites, colon-separated ``key=value`` params)::
 
 Sites and their effects when they fire:
 
-=================  ========================================================
-``fs-read-error``  raise ``IOError`` at the row-group read / filesystem call
-``fs-read-delay``  sleep ``delay`` seconds at the same points
-``decode-corrupt`` raise ``DecodeFieldError`` before codec decode
-``worker-kill``    ``SIGKILL`` the current (worker) process
-``queue-stall``    sleep ``delay`` seconds before publishing a result
-=================  ========================================================
+==================== ======================================================
+``fs-read-error``    raise ``IOError`` at the row-group read / filesystem call
+``fs-read-delay``    sleep ``delay`` seconds at the same points
+``decode-corrupt``   raise ``DecodeFieldError`` before codec decode
+``worker-kill``      ``SIGKILL`` the current (worker) process
+``queue-stall``      sleep ``delay`` seconds before publishing a result
+``device-put-delay`` sleep ``delay`` seconds in the loader's device staging
+                     (simulates a hung ``device_put`` for the watchdog's
+                     dispatch-hung classification, ``health.py``)
+==================== ======================================================
 
 Params (all optional):
 
@@ -57,7 +60,7 @@ logger = logging.getLogger(__name__)
 ENV_VAR = 'PETASTORM_TPU_FAULTS'
 
 #: Sites whose effect is a sleep rather than an error.
-_DELAY_SITES = ('fs-read-delay', 'queue-stall')
+_DELAY_SITES = ('fs-read-delay', 'queue-stall', 'device-put-delay')
 
 _DEFAULT_DELAY_S = 0.05
 

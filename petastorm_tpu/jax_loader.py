@@ -583,13 +583,28 @@ class JaxLoader(object):
         before the dispatch stage blocks on the oldest — the window that
         lets collate of batch N+1 overlap the transfer of batch N
         (``stats['overlap_frac']``).
+    :param watchdog: enable the pipeline health supervisor
+        (``petastorm_tpu.health``): every stage beats a heartbeat and a
+        watchdog thread classifies stalls (reader-starved / assemble-stuck
+        / dispatch-hung / consumer-not-draining / arena-pool-wedged /
+        remote-server-dead), records a diagnosis (thread stacks, beat
+        table, stage counters) into ``stats['watchdog']``, runs soft
+        recovery, and escalates a persistent stall to a
+        :class:`~petastorm_tpu.errors.PipelineStallError` raised from
+        ``__next__`` instead of an anonymous hang. ``None`` defers to the
+        ``PETASTORM_TPU_WATCHDOG`` environment variable (off when unset).
+    :param stall_timeout_s: per-stage stall deadlines for the watchdog —
+        a number (applies to every stage) or a dict mapping stage name
+        (``'assemble'``, ``'dispatch'``, ``'consumer'``, ``'remote-recv'``,
+        ``'worker-pool'``, ...) or ``'default'`` to seconds. Default 60s.
     """
 
     def __init__(self, reader, batch_size, mesh=None, sharding=None,
                  batch_axis='data', prefetch=2, shape_policies=None,
                  shuffling_queue_capacity=0, min_after_dequeue=None, seed=None,
                  last_batch='drop', strict_fields=False, echo=1, tracer=None,
-                 stage_chunks=1, arena_depth=None, inflight=2):
+                 stage_chunks=1, arena_depth=None, inflight=2,
+                 watchdog=None, stall_timeout_s=None):
         import jax
 
         if tracer is None:
@@ -639,6 +654,25 @@ class JaxLoader(object):
         self._queue = queue.Queue(maxsize=max(1, prefetch))
         self._stop = threading.Event()
         self._exhausted = False
+        # Pipeline health supervisor (petastorm_tpu.health): heartbeats on
+        # every stage + a watchdog that classifies stalls, runs soft
+        # recovery, and escalates to PipelineStallError instead of hanging.
+        from petastorm_tpu import health as health_mod
+        self._health = None
+        self._hb_consumer = None
+        self._stall_error = None
+        if health_mod.watchdog_enabled(watchdog):
+            self._health = health_mod.HealthMonitor(
+                stall_timeouts=stall_timeout_s,
+                on_hard_stall=self._deliver_stall, tracer=self._tracer)
+            self._hb_consumer = self._health.registry.register('consumer')
+            self._health.registry.register_probe(
+                'consumer', lambda: {'queue_depth': self._queue.qsize(),
+                                     'queue_capacity': self._queue.maxsize,
+                                     'exhausted': self._exhausted})
+            attach = getattr(reader, 'attach_health', None)
+            if attach is not None:
+                attach(self._health.registry)
         self._namedtuple_cache = {}
         # input-stall accounting (BASELINE.json targets <5% input stall)
         self._batches_delivered = 0
@@ -699,10 +733,16 @@ class JaxLoader(object):
             # work only (an input- or arena-bound run must not read as
             # perfect pipelining).
             meter = OverlapMeter()
-            host_reader = MeteredReader(reader, meter)
+            hb_assemble = (self._health.registry.register('assemble')
+                           if self._health is not None else None)
+            host_reader = MeteredReader(reader, meter, heartbeat=hb_assemble)
             self._arena_pool = ArenaPool(arena_depth, stop_event=self._stop,
-                                         tracer=self._tracer, meter=meter)
+                                         tracer=self._tracer, meter=meter,
+                                         heartbeat=hb_assemble)
             arena_buffers = self._arena_pool.get_buffers
+            if self._health is not None:
+                self._health.registry.register_probe('arena-pool',
+                                                     self._arena_pool.stats)
 
         self._host_iter = iter_numpy_batches(
             host_reader, local_batch, shape_policies=shape_policies,
@@ -726,7 +766,13 @@ class JaxLoader(object):
                 end_sentinel=_END, pool=self._arena_pool, inflight=inflight,
                 ready_fn=ready_fn, is_ready_fn=is_ready_fn,
                 holds_mode=aliasing, tracer=self._tracer,
-                meter=meter).start()
+                meter=meter,
+                health=self._health.registry
+                if self._health is not None else None).start()
+        # The watchdog starts only once every stage had the chance to
+        # register, so its first classification sees the full beat table.
+        if self._health is not None:
+            self._health.start()
 
     # -- staging thread --------------------------------------------------
 
@@ -756,6 +802,8 @@ class JaxLoader(object):
         return self._stage_concat(*staged)
 
     def _stage(self, host_batch):
+        from petastorm_tpu.faults import maybe_inject
+        maybe_inject('device-put-delay')
         jax = self._jax
         out = {}
         t0 = time.perf_counter()
@@ -812,12 +860,39 @@ class JaxLoader(object):
 
     # -- consumer --------------------------------------------------------
 
+    def _deliver_stall(self, error):
+        """Hard-stall sink (watchdog thread): make the consumer raise the
+        diagnosed :class:`PipelineStallError` instead of blocking forever.
+        The error rides the staging queue (the consumer is typically parked
+        in an untimed ``get()``); a full queue — the consumer-not-draining
+        shape — has one stale batch evicted to make room."""
+        self._stall_error = error
+        for _ in range(2):
+            try:
+                self._queue.put_nowait(error)
+                return
+            except queue.Full:
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    pass
+        logger.error('could not deliver PipelineStallError into the staging '
+                     'queue; it will surface on the next __next__ call')
+
     def __iter__(self):
         return self
 
     def __next__(self):
         if self._exhausted:
             raise StopIteration
+        if self._stall_error is not None:
+            # Consumer-staging mode (or a failed queue delivery): the
+            # watchdog's hard diagnosis still surfaces here.
+            self._exhausted = True
+            error, self._stall_error = self._stall_error, None
+            raise error
+        if self._hb_consumer is not None:
+            self._hb_consumer.beat('queue-wait')
         t0 = time.perf_counter()
         if self._first_get_t is None:
             self._first_get_t = t0
@@ -828,8 +903,18 @@ class JaxLoader(object):
             fresh = False   # source rows already counted on first delivery
         else:
             if self._consumer_staging:
+                # Inline staging (prefetch=0): the consumer thread IS the
+                # pipeline, so its heartbeat states must distinguish a
+                # starved reader from a hung device_put here too — without
+                # the brackets a wedged inline transfer would read as
+                # 'queue-wait' (an innocent state) and never classify.
                 try:
-                    item = self._stage(self._next_host_batch())
+                    if self._hb_consumer is not None:
+                        self._hb_consumer.beat('reader-wait')
+                    host_batch = self._next_host_batch()
+                    if self._hb_consumer is not None:
+                        self._hb_consumer.beat('device_put')
+                    item = self._stage(host_batch)
                 except StopIteration:
                     item = _END
                 except Exception as e:  # noqa: BLE001 - match staged path
@@ -843,6 +928,8 @@ class JaxLoader(object):
         self._wait_s += time.perf_counter() - t0
         if item is _END:
             self._exhausted = True
+            if self._hb_consumer is not None:
+                self._hb_consumer.beat('idle')   # exhausted, not stalled
             raise StopIteration
         if isinstance(item, Exception):
             self._exhausted = True
@@ -850,6 +937,15 @@ class JaxLoader(object):
         names = tuple(sorted(item))
         nt = cached_namedtuple(self._namedtuple_cache, 'JaxBatch', names)
         self._batches_delivered += 1
+        if self._hb_consumer is not None:
+            # 'delivered' + stale = the training loop took this batch and
+            # never came back (consumer-not-draining, never escalated).
+            self._hb_consumer.beat('delivered')
+        # A delivered batch IS recovery: a hard stall diagnosed while this
+        # call was in flight (inline staging sleeping through its own
+        # escalation) must not kill the pipeline that has since come back.
+        # (Staged-path hard stalls ride the queue and still terminate.)
+        self._stall_error = None
         if self._row_granular_ckpt and fresh:
             # A padded final batch over-reports by the pad amount; the
             # attribution FIFO simply drains empty, which is correct (the
@@ -962,6 +1058,11 @@ class JaxLoader(object):
             out['worker_stage_timings'] = {
                 k: (round(v, 4) if isinstance(v, float) else v)
                 for k, v in worker_timings.items()}
+        if self._health is not None:
+            # Stall supervision: detections/recoveries/hard escalations and
+            # the latest diagnosis (classification, stage, beat table,
+            # probes — the stack dump stays on the error object).
+            out['watchdog'] = self._health.stats()
         return out
 
     def state_dict(self):
@@ -985,6 +1086,10 @@ class JaxLoader(object):
         return self._reader.state_dict()
 
     def stop(self):
+        if self._health is not None:
+            # First: a supervisor firing mid-teardown would misread the
+            # (deliberately) silent stages as a stall.
+            self._health.stop()
         self._stop.set()
         self._exhausted = True
         # Drain so the staging threads' bounded puts can exit.

@@ -16,7 +16,7 @@ import warnings
 from petastorm_tpu.arrow_worker import ArrowResultsQueueReader, ArrowWorker
 from petastorm_tpu.cache import LocalDiskArrowTableCache, LocalDiskCache, NullCache
 from petastorm_tpu.checkpoint import ConsumptionTracker
-from petastorm_tpu.errors import NoDataAvailableError
+from petastorm_tpu.errors import NoDataAvailableError, PipelineStallError
 from petastorm_tpu.etl.dataset_metadata import (PetastormMetadataError,
                                                 get_schema,
                                                 infer_or_load_unischema)
@@ -24,7 +24,8 @@ from petastorm_tpu.py_dict_worker import PyDictResultsQueueReader, PyDictWorker
 from petastorm_tpu.storage import ROWGROUP_INDEX_KEY, ParquetStore
 from petastorm_tpu.transform import transform_schema
 from petastorm_tpu.unischema import match_unischema_fields
-from petastorm_tpu.workers import EmptyResultError
+from petastorm_tpu.workers import (EmptyResultError,
+                                   TimeoutWaitingForResultError)
 from petastorm_tpu.workers.dummy_pool import DummyPool
 from petastorm_tpu.workers.thread_pool import ThreadPool
 from petastorm_tpu.workers.ventilator import ConcurrentVentilator
@@ -104,7 +105,9 @@ def make_reader(dataset_url,
                 shm_result_ring_bytes=None,
                 resume_state=None,
                 pool_profiling=False,
-                error_budget=None):
+                error_budget=None,
+                watchdog=None,
+                stall_timeout_s=None):
     """Reader for datasets materialized with petastorm_tpu codecs.
 
     Parity: reference ``petastorm/reader.py:50-174``. Rejects plain Parquet
@@ -116,6 +119,14 @@ def make_reader(dataset_url,
     of aborting the epoch, raising ``RowGroupQuarantinedError`` only once
     the budget — an int count or a float fraction of the epoch's row-group
     items — is exhausted. See ``docs/failure_model.rst``.
+
+    ``watchdog`` / ``stall_timeout_s`` arm the pipeline health supervisor
+    (``petastorm_tpu.health``): the ventilator, worker pool, and result
+    handoff beat heartbeats, and a watchdog thread classifies stalls and
+    records a diagnosis (thread stacks, last-beat table) into
+    ``Reader.diagnostics()['watchdog']``. ``watchdog=None`` defers to the
+    ``PETASTORM_TPU_WATCHDOG`` environment variable. A ``JaxLoader``
+    wrapping this reader supervises both with a single watchdog.
     """
     store = ParquetStore(dataset_url, storage_options)
     try:
@@ -149,7 +160,8 @@ def make_reader(dataset_url,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec,
                   resume_state=resume_state,
-                  error_budget=error_budget)
+                  error_budget=error_budget,
+                  watchdog=watchdog, stall_timeout_s=stall_timeout_s)
 
 
 def make_tensor_reader(dataset_url,
@@ -170,7 +182,9 @@ def make_tensor_reader(dataset_url,
                        resume_state=None,
                        pool_profiling=False,
                        shuffle_rows_in_chunk=False,
-                       error_budget=None):
+                       error_budget=None,
+                       watchdog=None,
+                       stall_timeout_s=None):
     """Decoded-columnar reader: the TPU hot path (no reference equivalent).
 
     Like :func:`make_reader` (codecs run, values are decoded) but columnar
@@ -248,7 +262,8 @@ def make_tensor_reader(dataset_url,
                   cache=cache, transform_spec=transform_spec,
                   resume_state=resume_state,
                   shuffle_rows_in_chunk=shuffle_rows_in_chunk,
-                  error_budget=error_budget)
+                  error_budget=error_budget,
+                  watchdog=watchdog, stall_timeout_s=stall_timeout_s)
 
 
 def make_batch_reader(dataset_url,
@@ -269,7 +284,9 @@ def make_batch_reader(dataset_url,
                       resume_state=None,
                       pool_profiling=False,
                       shuffle_rows_in_chunk=False,
-                      error_budget=None):
+                      error_budget=None,
+                      watchdog=None,
+                      stall_timeout_s=None):
     """Columnar batch reader for **any** Parquet store (no codecs needed).
 
     Parity: reference ``petastorm/reader.py:177-289``. Warns when pointed at a
@@ -309,7 +326,8 @@ def make_batch_reader(dataset_url,
                   cache=cache, transform_spec=transform_spec,
                   resume_state=resume_state,
                   shuffle_rows_in_chunk=shuffle_rows_in_chunk,
-                  error_budget=error_budget)
+                  error_budget=error_budget,
+                  watchdog=watchdog, stall_timeout_s=stall_timeout_s)
 
 
 class _CallableDict(dict):
@@ -432,7 +450,8 @@ class Reader(object):
                  seed=None, predicate=None, rowgroup_selector=None,
                  num_epochs=1, cur_shard=None, shard_count=None,
                  cache=None, transform_spec=None, ngram=None, resume_state=None,
-                 shuffle_rows_in_chunk=False, error_budget=None):
+                 shuffle_rows_in_chunk=False, error_budget=None,
+                 watchdog=None, stall_timeout_s=None):
         self._store = store
         self.stored_schema = stored_schema
         self.ngram = ngram
@@ -552,6 +571,83 @@ class Reader(object):
             inline=getattr(self._workers_pool, 'inline_ventilation', False))
         self._workers_pool.start(worker_class, worker_args, ventilator=self._ventilator)
 
+        # --- pipeline health supervision (petastorm_tpu.health) ------------
+        # A standalone reader owns its monitor; a wrapping JaxLoader calls
+        # attach_health(registry) instead so ONE watchdog supervises the
+        # whole pipeline (its registry wins — we then skip our own).
+        from petastorm_tpu import health as health_mod
+        self._health = None
+        self._health_registry = None
+        self._hb_handoff = None
+        self._stall_error = None
+        if health_mod.watchdog_enabled(watchdog):
+            from petastorm_tpu.trace import get_global_tracer
+
+            def deliver(error):
+                # Raised at the next __next__ entry; additionally injected
+                # straight into a thread pool's results queue (its
+                # get_results blocks unboundedly, so entry-time checks
+                # alone would never fire), and substituted for the process
+                # pools' bounded get_results timeout when that pops.
+                self._stall_error = error
+                inject = getattr(self._workers_pool,
+                                 'inject_consumer_error', None)
+                if inject is not None:
+                    inject(error)
+
+            self._health = health_mod.HealthMonitor(
+                stall_timeouts=stall_timeout_s, tracer=get_global_tracer(),
+                on_hard_stall=deliver)
+            self.attach_health(self._health.registry)
+            self._health.start()
+
+    def attach_health(self, registry):
+        """Register this reader's stages into a
+        :class:`~petastorm_tpu.health.HeartbeatRegistry` (called by a
+        wrapping loader, or by ``__init__`` for a standalone monitor):
+        ventilator + result-handoff heartbeats, a worker-pool probe
+        (liveness, in-flight items, respawn budget), and a soft-recovery
+        nudge for reader-tier stalls."""
+        from petastorm_tpu import health as health_mod
+        if self._health is not None and registry is not self._health.registry:
+            # A loader is taking over supervision: one watchdog per
+            # pipeline (ours would see heartbeats nothing beats anymore).
+            self._health.stop()
+            self._health = None
+        self._health_registry = registry
+        self._ventilator.heartbeat = registry.register('ventilator')
+        self._hb_handoff = registry.register('reader-handoff')
+        if hasattr(self._results_queue_reader, 'heartbeat'):
+            self._results_queue_reader.heartbeat = self._hb_handoff
+        pool = self._workers_pool
+        pool.health_heartbeat = registry.register('worker-pool')
+
+        def pool_probe():
+            diag = dict(pool.diagnostics)
+            processes = getattr(pool, '_processes', None)
+            if processes:
+                diag['dead_workers'] = [
+                    slot for slot, p in enumerate(processes)
+                    if p is not None and p.poll() is not None]
+            return diag
+
+        registry.register_probe('worker-pool', pool_probe)
+
+        def nudge_reader(diagnosis):
+            # Safe from the watchdog thread: wake a parked ventilator so
+            # backpressure bookkeeping is re-checked. Respawns themselves
+            # happen on the consumer thread (pool.get_results polls worker
+            # health every iteration) — never from here (zmq sockets and
+            # shm rings are single-thread-owned).
+            wakeup = getattr(self._ventilator, '_wakeup', None)
+            if wakeup is not None:
+                wakeup.set()
+                return True
+            return False
+
+        registry.register_recovery(health_mod.READER_STARVED, nudge_reader)
+        registry.register_recovery(health_mod.WORKER_POOL_DEAD, nudge_reader)
+
     def _pool_workers_count(self):
         return getattr(self._workers_pool, 'workers_count', 1)
 
@@ -607,12 +703,46 @@ class Reader(object):
     def __next__(self):
         if self._stopped:
             raise RuntimeError('Trying to iterate a stopped Reader')
+        if self._stall_error is not None:
+            error, self._stall_error = self._stall_error, None
+            raise error
+        hb = self._hb_handoff
+        if hb is not None:
+            # 'poll' (waiting on the pool — stale means the decode tier
+            # produced nothing) vs 'handoff' below (row delivered — stale
+            # means the consumer stopped pulling).
+            hb.beat('poll')
         try:
             row = self._results_queue_reader.read_next(
                 self._workers_pool, self._transformed_schema, self.ngram)
+            if hb is not None:
+                hb.beat('handoff')
+            # A delivered row IS recovery: a hard stall diagnosed while we
+            # were parked inside the pool must not kill a pipeline that
+            # has since come back.
+            self._stall_error = None
             return row
+        except TimeoutWaitingForResultError as timeout_error:
+            if self._stall_error is not None:
+                # The pool's bare timeout popped while the watchdog holds a
+                # full diagnosis — surface the diagnosed error instead.
+                error, self._stall_error = self._stall_error, None
+                raise error from timeout_error
+            raise
+        except PipelineStallError as stall_error:
+            # The thread pool surfaced the injected copy of the diagnosis;
+            # drop our entry-check copy or the SAME error would raise a
+            # second time on the next call even after recovery.
+            if stall_error is self._stall_error:
+                self._stall_error = None
+            raise
         except EmptyResultError:
             self.last_row_consumed = True
+            if hb is not None:
+                hb.beat('idle')   # exhausted, not stalled
+            pool_hb = getattr(self._workers_pool, 'health_heartbeat', None)
+            if pool_hb is not None:
+                pool_hb.beat('idle')
             raise StopIteration
 
     next = __next__
@@ -700,6 +830,8 @@ class Reader(object):
         self._ventilator.reset()
 
     def stop(self):
+        if self._health is not None:
+            self._health.stop()
         self._workers_pool.stop()
         self._stopped = True
 
@@ -708,13 +840,18 @@ class Reader(object):
 
     @property
     def diagnostics(self):
-        """Pool health + quarantine state. Usable both as a mapping
+        """Pool health + quarantine state + (when supervised) the
+        watchdog's stall diagnosis. Usable both as a mapping
         (``reader.diagnostics['x']``) and called
         (``reader.diagnostics()['quarantined_rowgroups']``)."""
         diag = _CallableDict(self._workers_pool.diagnostics)
         diag['quarantined_rowgroups'] = self._quarantine_log.snapshot()
         diag['error_budget'] = (self._quarantine_log.budget
                                 if self._quarantine_log.enabled else None)
+        if self._health is not None:
+            diag['watchdog'] = self._health.stats()
+        elif self._health_registry is not None:
+            diag['heartbeats'] = self._health_registry.beat_table()
         return diag
 
     def __enter__(self):

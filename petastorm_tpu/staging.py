@@ -147,12 +147,18 @@ class ArenaPool(object):
     """
 
     def __init__(self, depth, stop_event=None, grow_timeout_s=0.5,
-                 tracer=None, meter=None, meter_stage='assemble'):
+                 tracer=None, meter=None, meter_stage='assemble',
+                 heartbeat=None):
         if depth < 1:
             raise ValueError('ArenaPool depth must be >= 1, got {}'.format(depth))
         self._depth = depth
         self._stop = stop_event if stop_event is not None else threading.Event()
         self._grow_timeout_s = grow_timeout_s
+        # Health hookup: while the assembler is parked waiting for an arena
+        # its heartbeat reads 'arena-wait' and goes stale — the watchdog
+        # then classifies the stall as arena-pool-wedged rather than
+        # blaming collate work.
+        self._heartbeat = heartbeat
         # Backpressure waits happen inside the assembler's tracked section;
         # pausing the meter keeps them out of busy/overlap accounting (an
         # arena-starved pipeline must not read as perfectly overlapped —
@@ -189,6 +195,7 @@ class ArenaPool(object):
             if not self._matches(spec):
                 return None
             waited = 0.0
+            waiting_hb = False
             while True:
                 if self._stop.is_set():
                     return None
@@ -208,14 +215,29 @@ class ArenaPool(object):
                     if self._allocated > self._depth:
                         self._depth = self._allocated
                     break
+                if self._heartbeat is not None and not waiting_hb:
+                    # One beat on entry, then let the age accrue: a wedged
+                    # pool must read as a stale 'arena-wait' heartbeat.
+                    self._heartbeat.beat('arena-wait')
+                    waiting_hb = True
+                # Real wakeups: release and GC-settle notify the condition
+                # (see _reclaim) and stop() paths call wake(), so acquire
+                # latency is no longer quantized to a poll interval and a
+                # missed wakeup cannot masquerade as arena starvation. The
+                # timeout is the grow deadline, capped only so an EXTERNAL
+                # stop_event set without wake() is still observed promptly
+                # (that cap bounds stop latency, not acquire latency).
+                timeout = min(max(self._grow_timeout_s - waited, 0.005), 0.25)
                 t0 = time.perf_counter()
                 if self._meter is not None:
                     with self._meter.pause(self._meter_stage):
-                        self._cond.wait(timeout=0.05)
+                        self._cond.wait(timeout=timeout)
                 else:
-                    self._cond.wait(timeout=0.05)
+                    self._cond.wait(timeout=timeout)
                 waited += time.perf_counter() - t0
                 self._wait_s += time.perf_counter() - t0
+            if waiting_hb:
+                self._heartbeat.beat('collate')
             self._pending = arena
             self._tracer.counter('arena_pool_free', len(self._free), 'staging')
             return arena.buffers
@@ -244,13 +266,24 @@ class ArenaPool(object):
         if arena is not None:
             arena.retire()
 
+    def wake(self):
+        """Wake any waiter so it can observe the stop flag promptly (the
+        condition is otherwise only notified on arena release)."""
+        with self._cond:
+            self._cond.notify_all()
+
     def stats(self):
         with self._cond:
             return {'arena_alloc': self._alloc,
                     'arena_reuse': self._reuse,
                     'arena_wait_s': round(self._wait_s, 4),
                     'arena_depth': self._depth,
-                    'arena_allocated': self._allocated}
+                    'arena_allocated': self._allocated,
+                    # Context for watchdog diagnoses: a wait can only
+                    # outlive this before growth relieves it, so a pool
+                    # that CAN grow shows wedges as climbing arena_alloc
+                    # (memory), not as long arena-waits.
+                    'arena_grow_timeout_s': self._grow_timeout_s}
 
     def reset_stats(self):
         with self._cond:
@@ -345,17 +378,29 @@ class MeteredReader(object):
     starvation — an input-bound run must not read as perfectly overlapped
     pipelining. Every non-iteration attribute passes through."""
 
-    def __init__(self, reader, meter, stage='assemble'):
+    def __init__(self, reader, meter, stage='assemble', heartbeat=None):
         self._pst_reader = reader
         self._pst_meter = meter
         self._pst_stage = stage
+        self._pst_hb = heartbeat
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        with self._pst_meter.pause(self._pst_stage):
-            return next(self._pst_reader)
+        hb = self._pst_hb
+        if hb is not None:
+            # State labels bracket the reader pull so a stale heartbeat
+            # tells the watchdog *what* starved: 'reader-wait' = the
+            # decode/IO tier produced nothing (reader-starved); 'collate'
+            # = the batch-assembly work itself wedged (assemble-stuck).
+            hb.beat('reader-wait')
+        try:
+            with self._pst_meter.pause(self._pst_stage):
+                return next(self._pst_reader)
+        finally:
+            if hb is not None:
+                hb.beat('collate')
 
     def __getattr__(self, name):
         return getattr(self._pst_reader, name)
@@ -393,7 +438,7 @@ class StagingEngine(object):
     def __init__(self, host_iter, stage_fn, out_queue, stop_event,
                  end_sentinel, pool=None, inflight=2, ready_fn=None,
                  is_ready_fn=None, holds_mode=False, tracer=None,
-                 meter=None):
+                 meter=None, health=None):
         self._host_iter = host_iter
         self._stage_fn = stage_fn
         self._out = out_queue
@@ -412,6 +457,17 @@ class StagingEngine(object):
         self._stats_lock = threading.Lock()
         self._retired = 0
         self._ready_wait_s = 0.0
+        self._leaked_threads = []
+        # Health hookup (petastorm_tpu.health): both stage threads beat a
+        # named heartbeat at every phase transition, so the watchdog can
+        # tell a hung device_put ('device_put'/'ready-wait') from a full
+        # consumer queue ('out-put') from waiting on upstream
+        # ('stageq-get' — an innocent state; blame lands on assemble).
+        self._hb_assemble = self._hb_dispatch = None
+        if health is not None:
+            self._hb_assemble = health.register('assemble')
+            self._hb_dispatch = health.register('dispatch')
+            health.register_probe('staging', self.stats)
         self._stage_q = queue.Queue(maxsize=2)
         self._threads = [
             threading.Thread(target=self._assemble_loop, daemon=True,
@@ -460,8 +516,19 @@ class StagingEngine(object):
     # -- assemble stage ---------------------------------------------------
 
     def _assemble_loop(self):
+        hb = self._hb_assemble
+        try:
+            self._assemble_body(hb)
+        finally:
+            if hb is not None:
+                hb.beat('idle')   # exited (done, stopped, or errored-and-
+                                  # delivered): quiet is no longer a stall
+
+    def _assemble_body(self, hb):
         try:
             while not self._stop.is_set():
+                if hb is not None:
+                    hb.beat('collate')
                 try:
                     with self.meter.track('assemble'):
                         with self._tracer.span('assemble', 'host'):
@@ -469,6 +536,8 @@ class StagingEngine(object):
                 except StopIteration:
                     break
                 arena = self._pool.claim_pending() if self._pool else None
+                if hb is not None:
+                    hb.beat('stageq-put')
                 if not self._put(self._stage_q, (batch, arena)):
                     if arena is not None:
                         arena.retire()
@@ -494,6 +563,8 @@ class StagingEngine(object):
         if arena is None:
             return
         if wait and not self._stop.is_set():
+            if self._hb_dispatch is not None:
+                self._hb_dispatch.beat('ready-wait')
             t0 = time.perf_counter()
             self._ready_fn(staged)
             with self._stats_lock:
@@ -503,10 +574,20 @@ class StagingEngine(object):
             self._retired += 1
 
     def _dispatch_loop(self):
+        hb = self._hb_dispatch
+        try:
+            self._dispatch_body(hb)
+        finally:
+            if hb is not None:
+                hb.beat('idle')
+
+    def _dispatch_body(self, hb):
         inflight = deque()
         arena = None    # the current batch's arena until the window owns it
         try:
             while True:
+                if hb is not None:
+                    hb.beat('stageq-get')
                 item = self._get()
                 if item is None:          # stopping
                     return
@@ -528,6 +609,8 @@ class StagingEngine(object):
                     # a leaked thread holding reader views whose teardown
                     # it races.
                     return
+                if hb is not None:
+                    hb.beat('device_put')
                 with self.meter.track('dispatch'):
                     with self._tracer.span('dispatch', 'device'):
                         staged = self._stage_fn(batch)
@@ -540,6 +623,8 @@ class StagingEngine(object):
                     self._tracer.counter('staging_inflight', len(inflight),
                                          'staging')
                 del batch
+                if hb is not None:
+                    hb.beat('out-put')
                 if not self._put(self._out, staged):
                     return
                 del staged
@@ -576,10 +661,35 @@ class StagingEngine(object):
 
     def stop(self, join_timeout_s=10):
         """Idempotent: set stop, unblock both threads, join them, settle
-        arena bookkeeping. The caller drains ``out_queue`` (it owns it)."""
+        arena bookkeeping. The caller drains ``out_queue`` (it owns it).
+
+        A thread that outlives ``join_timeout_s`` (e.g. a ``device_put``
+        hung on a wedged device) is NOT silently forgotten: it is recorded
+        in ``stats()['leaked_threads']``, traced, and logged with the
+        stuck thread's stack — shutdown must never pretend it succeeded.
+        Returns the list of thread names leaked by *this* call.
+        """
         self._stop.set()
+        if self._pool is not None:
+            self._pool.wake()   # waiters observe the stop flag immediately
+        leaked = []
         for t in self._threads:
             t.join(timeout=join_timeout_s)
+            if t.is_alive():
+                leaked.append(t.name)
+        if leaked:
+            from petastorm_tpu.health import dump_all_stacks
+            with self._stats_lock:
+                self._leaked_threads.extend(
+                    n for n in leaked if n not in self._leaked_threads)
+            for name in leaked:
+                self._tracer.instant('staging-leaked-thread:{}'.format(name),
+                                     cat='watchdog')
+            logger.warning(
+                'StagingEngine.stop: thread(s) %s still alive after %.1fs '
+                'join — a hung transfer is leaking them past shutdown. '
+                'Thread stacks:\n%s', leaked, join_timeout_s,
+                dump_all_stacks())
         if self._pool is not None:
             self._pool.reclaim_pending()
         # Drain whatever assemble left between the stages.
@@ -590,6 +700,7 @@ class StagingEngine(object):
                 break
             if isinstance(item, tuple) and item[1] is not None:
                 item[1].retire()
+        return leaked   # THIS call's leaks; stats() keeps the cumulative list
 
     @property
     def alive(self):
@@ -600,13 +711,15 @@ class StagingEngine(object):
         total = self.meter.stats(total=True)
         with self._stats_lock:
             retired, ready_wait = self._retired, self._ready_wait_s
+            leaked = list(self._leaked_threads)
         return {'assemble_s': m['busy_s'].get('assemble', 0.0),
                 'dispatch_s': m['busy_s'].get('dispatch', 0.0),
                 'overlap_s': m['overlap_s'],
                 'overlap_frac': m['overlap_frac'],
                 'overlap_frac_total': total['overlap_frac'],
                 'inflight_retired': retired,
-                'ready_wait_s': round(ready_wait, 4)}
+                'ready_wait_s': round(ready_wait, 4),
+                'leaked_threads': leaked}
 
     def reset_stats(self):
         self.meter.reset()

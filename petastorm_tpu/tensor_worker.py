@@ -230,6 +230,10 @@ class TensorResultsQueueReader(DeferredRowAccounting):
         self._timings = {'read_s': 0.0, 'decode_s': 0.0, 'cache_s': 0.0,
                          'chunks': 0}
         self._last_private = False
+        #: Optional health.Heartbeat (wired by ``Reader.attach_health``):
+        #: beaten per decoded chunk crossing the pool->consumer handoff,
+        #: so the watchdog sees TensorWorker output flow directly.
+        self.heartbeat = None
 
     @property
     def batched_output(self):
@@ -254,6 +258,8 @@ class TensorResultsQueueReader(DeferredRowAccounting):
             raise NotImplementedError('NGram is not supported with tensor readers')
         while True:
             chunk = pool.get_results()
+            if self.heartbeat is not None:
+                self.heartbeat.beat('handoff')
             cols, key = chunk['cols'], chunk['key']
             self._last_private = bool(chunk.get('private'))
             t = chunk.get('timings') or {}

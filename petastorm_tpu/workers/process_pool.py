@@ -102,6 +102,10 @@ class ProcessPool(SupervisedPoolMixin):
         #: Set by the Reader when ``error_budget`` is enabled; receives
         #: RowGroupQuarantined records (and raises when the budget is spent).
         self.quarantine_sink = None
+        #: Optional health.Heartbeat (set by ``Reader.attach_health``):
+        #: beaten each ``get_results`` poll ('poll') and on every delivered
+        #: message ('deliver') — proves the consumer-side pump is alive.
+        self.health_heartbeat = None
 
     @property
     def workers_count(self):
@@ -200,6 +204,8 @@ class ProcessPool(SupervisedPoolMixin):
     def get_results(self, timeout=_DEFAULT_TIMEOUT_S):
         deadline = time.monotonic() + timeout if timeout is not None else None
         while True:
+            if self.health_heartbeat is not None:
+                self.health_heartbeat.beat('poll')
             self._flush_pending()
             self._check_worker_health()
             if self._rescued:
@@ -232,9 +238,13 @@ class ProcessPool(SupervisedPoolMixin):
                                        'chunk %s (respawn replay)', seq,
                                        chunk_index)
                         continue
+                    if self.health_heartbeat is not None:
+                        self.health_heartbeat.beat('deliver')
                     return self._serializer.deserialize(message[1])
                 # Legacy untagged payload (custom workers publishing through
                 # an old-style bootstrap).
+                if self.health_heartbeat is not None:
+                    self.health_heartbeat.beat('deliver')
                 return self._serializer.deserialize(message[1])
             if self._all_done():
                 raise EmptyResultError()

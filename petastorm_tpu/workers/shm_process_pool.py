@@ -121,6 +121,10 @@ class ShmProcessPool(SupervisedPoolMixin):
         self._registry = None
         #: Set by the Reader when ``error_budget`` is enabled.
         self.quarantine_sink = None
+        #: Optional health.Heartbeat (set by ``Reader.attach_health``):
+        #: beaten each ``get_results`` poll ('poll') and on every delivered
+        #: payload ('deliver') — proves the pump is alive and flowing.
+        self.health_heartbeat = None
 
     @property
     def workers_count(self):
@@ -280,6 +284,8 @@ class ShmProcessPool(SupervisedPoolMixin):
     def get_results(self, timeout=_DEFAULT_TIMEOUT_S):
         deadline = time.monotonic() + timeout if timeout is not None else None
         while True:
+            if self.health_heartbeat is not None:
+                self.health_heartbeat.beat('poll')
             self._flush_pending()
             self._check_worker_health()
             message = self._poll_once(timeout_ms=50)
@@ -293,6 +299,8 @@ class ShmProcessPool(SupervisedPoolMixin):
                                        'chunk %s (respawn replay)', seq,
                                        chunk_index)
                         continue
+                    if self.health_heartbeat is not None:
+                        self.health_heartbeat.beat('deliver')
                     return self._serializer.deserialize(payload)
                 control = pickle.loads(message[1])
                 if control == _WORKER_STARTED:

@@ -84,6 +84,8 @@ class ThreadPool(object):
         #: Set by the Reader when ``error_budget`` is enabled; receives
         #: RowGroupQuarantined records (and raises when the budget is spent).
         self.quarantine_sink = None
+        #: Optional health.Heartbeat (set by ``Reader.attach_health``).
+        self.health_heartbeat = None
 
     @property
     def workers_count(self):
@@ -121,10 +123,28 @@ class ThreadPool(object):
             except queue.Full:
                 continue
 
+    def inject_consumer_error(self, exc):
+        """Watchdog delivery path: surface ``exc`` to a consumer parked in
+        :meth:`get_results` (whose default timeout is unbounded). Unlike a
+        worker exception, an injected error does NOT stop/join the pool —
+        the very point is that a worker may be wedged and unjoinable; the
+        caller owns teardown."""
+        self._injected_error = exc
+
+    _injected_error = None
+
     def get_results(self, timeout=None):
         import time
         deadline = time.monotonic() + timeout if timeout is not None else None
         while True:
+            if self._injected_error is not None and self._results_queue.empty():
+                # Still no results: the diagnosed stall stands. (With
+                # results available the pipeline recovered — deliver them
+                # and drop the stale injection below.)
+                error, self._injected_error = self._injected_error, None
+                raise error
+            if self.health_heartbeat is not None:
+                self.health_heartbeat.beat('poll')
             try:
                 result = self._results_queue.get(timeout=_RESULTS_POLL_TIMEOUT_S)
             except queue.Empty:
@@ -157,6 +177,7 @@ class ThreadPool(object):
                 self.stop()
                 self.join()
                 raise result
+            self._injected_error = None   # results flow again: recovered
             return result
 
     def _all_done(self):

@@ -81,6 +81,11 @@ class ConcurrentVentilator(Ventilator):
         self._stop_event = threading.Event()
         self._wakeup = threading.Event()
         self._completed_flag = threading.Event()
+        #: Optional :class:`petastorm_tpu.health.Heartbeat` (set by
+        #: ``Reader.attach_health``): beaten every feeder-loop iteration so
+        #: the watchdog can prove the ventilation thread itself is alive
+        #: (state 'ventilating' / 'backpressure' / 'idle' once done).
+        self.heartbeat = None
 
     def start(self):
         if self._started:
@@ -117,6 +122,8 @@ class ConcurrentVentilator(Ventilator):
         pumped = 0
         while (not self._stop_event.is_set()
                and not self._completed_flag.is_set()):
+            if self.heartbeat is not None:
+                self.heartbeat.beat('ventilating')
             if self._in_flight >= self._max_ventilation_queue_size:
                 break
             if not self._advance_epoch():
@@ -130,17 +137,24 @@ class ConcurrentVentilator(Ventilator):
 
     def _ventilate(self):
         while not self._stop_event.is_set():
+            heartbeat = self.heartbeat
             if not self._advance_epoch():
+                if heartbeat is not None:
+                    heartbeat.beat('idle')   # all epochs fed: quiet != stalled
                 return
             with self._in_flight_lock:
                 below_cap = self._in_flight < self._max_ventilation_queue_size
             if below_cap:
+                if heartbeat is not None:
+                    heartbeat.beat('ventilating')
                 item = self._items_to_ventilate[self._current_item_to_ventilate]
                 self._current_item_to_ventilate += 1
                 with self._in_flight_lock:
                     self._in_flight += 1
                 self._ventilate_fn(**item)
             else:
+                if heartbeat is not None:
+                    heartbeat.beat('backpressure')
                 self._wakeup.wait(self._ventilation_interval)
                 self._wakeup.clear()
 

@@ -145,6 +145,63 @@ def pytest_configure(config):
         'pipeline training, timing gates). The fast CI lane skips them: '
         'pytest -m "not slow" finishes in minutes; run the full suite '
         'before shipping.')
+    config.addinivalue_line(
+        'markers',
+        'timeout(seconds): per-test wall-clock budget override for the '
+        'SIGALRM hang guard (see _per_test_timeout in conftest.py).')
+
+
+# ---------------------------------------------------------------------------
+# Per-test hang guard: a reintroduced pipeline hang must fail ONE test fast
+# (with a full thread dump naming the stuck stage) instead of eating the
+# whole tier-1 wall-clock budget. pytest-timeout provides this when
+# installed; this SIGALRM fixture is the stdlib fallback, honoring the
+# existing markers: plain tests get a tight budget, `chaos` (fault
+# injection, worker respawn) a wider one, `slow` the widest. Override per
+# test with @pytest.mark.timeout(seconds).
+# ---------------------------------------------------------------------------
+
+_TIMEOUT_DEFAULT_S = 120
+_TIMEOUT_CHAOS_S = 240
+_TIMEOUT_SLOW_S = 600
+
+
+class TestHangTimeout(Exception):
+    """The per-test SIGALRM budget expired: the test is hung, not slow."""
+
+
+@pytest.fixture(autouse=True)
+def _per_test_timeout(request):
+    import signal
+    import threading
+
+    if (not hasattr(signal, 'SIGALRM')
+            or threading.current_thread() is not threading.main_thread()
+            or request.config.pluginmanager.hasplugin('timeout')):
+        yield
+        return
+    budget = _TIMEOUT_DEFAULT_S
+    if request.node.get_closest_marker('chaos') is not None:
+        budget = _TIMEOUT_CHAOS_S
+    if request.node.get_closest_marker('slow') is not None:
+        budget = _TIMEOUT_SLOW_S
+    override = request.node.get_closest_marker('timeout')
+    if override is not None and override.args:
+        budget = float(override.args[0])
+
+    def on_alarm(signum, frame):
+        from petastorm_tpu.health import dump_all_stacks
+        raise TestHangTimeout(
+            'test exceeded its {}s hang-guard budget. All-thread stacks:\n'
+            '{}'.format(budget, dump_all_stacks()))
+
+    previous = signal.signal(signal.SIGALRM, on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, budget)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, previous)
 
 
 TimeseriesSchema = Unischema('TimeseriesSchema', [

@@ -505,3 +505,487 @@ def test_faults_inactive_without_env(monkeypatch):
     injector = get_injector()
     assert injector.active_sites == []
     injector.inject('decode-corrupt', key='anything')  # no-op, no raise
+
+
+# ---------------------------------------------------------------------------
+# Pipeline health watchdog (petastorm_tpu/health.py): every stall
+# classification driven deterministically, soft recovery, and escalation to
+# a diagnosed PipelineStallError instead of an anonymous hang.
+# ---------------------------------------------------------------------------
+
+def _tensor_loader(url, batch_size=10, workers_count=2, **loader_kwargs):
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    reader = make_tensor_reader(url, reader_pool_type='thread',
+                                workers_count=workers_count, num_epochs=1,
+                                shuffle_row_groups=False)
+    return JaxLoader(reader, batch_size, **loader_kwargs)
+
+
+def test_watchdog_quiet_on_healthy_pipeline(chaos_dataset):
+    with _tensor_loader(chaos_dataset.url, watchdog=True,
+                        stall_timeout_s=10.0) as loader:
+        batches = sum(1 for _ in loader)
+        stats = loader.stats['watchdog']
+    assert batches == ROWS // 10
+    assert stats['stalls_detected'] == 0
+    assert stats['hard_stalls'] == 0
+
+
+def test_watchdog_classifies_reader_starved_fs_read_delay(
+        chaos_dataset, monkeypatch):
+    monkeypatch.setenv(ENV_VAR, 'fs-read-delay:delay=0.8:max=1')
+    with _tensor_loader(chaos_dataset.url, workers_count=1, watchdog=True,
+                        stall_timeout_s=0.3) as loader:
+        batches = sum(1 for _ in loader)
+        stats = loader.stats['watchdog']
+    assert batches == ROWS // 10        # soft stall: the epoch completed
+    assert stats['stalls_detected'] >= 1
+    assert stats['last_stall']['classification'] == 'reader-starved'
+    assert stats['last_stall']['stage'] == 'assemble'
+    assert stats['hard_stalls'] == 0
+
+
+def test_watchdog_classifies_queue_stall_as_reader_starved(
+        chaos_dataset, monkeypatch):
+    """The queue-stall site (worker sleeps before publishing) starves the
+    loader exactly like slow IO: same classification, full recovery."""
+    monkeypatch.setenv(ENV_VAR, 'queue-stall:delay=0.8:max=1')
+    with _tensor_loader(chaos_dataset.url, workers_count=1, watchdog=True,
+                        stall_timeout_s=0.3) as loader:
+        batches = sum(1 for _ in loader)
+        stats = loader.stats['watchdog']
+    assert batches == ROWS // 10
+    assert stats['stalls_detected'] >= 1
+    assert stats['last_stall']['classification'] == 'reader-starved'
+    assert stats['hard_stalls'] == 0
+
+
+def test_watchdog_dispatch_hung_escalates_to_diagnosed_error(
+        chaos_dataset, monkeypatch):
+    """A hung device_put (device-put-delay site) escalates: the consumer
+    raises PipelineStallError naming the stage and carrying the all-thread
+    stack dump — within ~(1 + escalation) * stall_timeout, not never."""
+    from petastorm_tpu.errors import PipelineStallError
+
+    monkeypatch.setenv(ENV_VAR, 'device-put-delay:delay=30:max=1')
+    loader = _tensor_loader(chaos_dataset.url, watchdog=True,
+                            stall_timeout_s=0.3)
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(PipelineStallError) as exc_info:
+            for _ in loader:
+                pass
+        elapsed = time.monotonic() - t0
+        error = exc_info.value
+        assert error.diagnosis['classification'] == 'dispatch-hung'
+        assert error.diagnosis['stage'] == 'dispatch'
+        assert 'dispatch-hung' in str(error)
+        assert 'Thread' in str(error)           # stack dump embedded
+        assert elapsed < 5.0                     # diagnosed, not hung
+        assert loader.stats['watchdog']['hard_stalls'] == 1
+    finally:
+        monkeypatch.delenv(ENV_VAR)
+        loader.stop()
+
+
+class _SlowPolicy(object):
+    """Shape policy whose first application wedges (collate-stage stall)."""
+
+    def __init__(self, sleep_s):
+        self._sleep_s = sleep_s
+        self._fired = False
+
+    def apply(self, value):
+        if not self._fired:
+            self._fired = True
+            time.sleep(self._sleep_s)
+        return np.asarray(value)
+
+
+def test_watchdog_classifies_assemble_stuck(chaos_dataset):
+    """Work wedged INSIDE collate (a slow shape policy / transform) is
+    distinguished from reader starvation by the heartbeat's state label."""
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    reader = make_reader(chaos_dataset.url, reader_pool_type='thread',
+                         workers_count=2, num_epochs=1,
+                         shuffle_row_groups=False)
+    with JaxLoader(reader, 10, shape_policies={'id': _SlowPolicy(0.8)},
+                   watchdog=True, stall_timeout_s=0.3) as loader:
+        batches = sum(1 for _ in loader)
+        stats = loader.stats['watchdog']
+    assert batches == ROWS // 10
+    assert stats['stalls_detected'] >= 1
+    assert stats['last_stall']['classification'] == 'assemble-stuck'
+    assert stats['hard_stalls'] == 0
+
+
+def test_watchdog_classifies_consumer_not_draining(chaos_dataset):
+    """A consumer that walks away (long compile, eval...) is diagnosed but
+    NEVER escalated — pausing a training loop must not kill the pipeline."""
+    with _tensor_loader(chaos_dataset.url, batch_size=5, prefetch=2,
+                        watchdog=True, stall_timeout_s=0.3) as loader:
+        it = iter(loader)
+        next(it)
+        time.sleep(1.0)                  # non-draining consumer
+        stats = loader.stats['watchdog']
+        assert stats['last_stall']['classification'] == 'consumer-not-draining'
+        assert stats['hard_stalls'] == 0
+        remaining = sum(1 for _ in it)   # resume: pipeline intact
+        assert remaining == ROWS // 5 - 1
+        assert loader.stats['watchdog']['hard_stalls'] == 0
+
+
+@pytest.mark.processpool
+def test_watchdog_worker_kill_site_recovers_within_deadline(
+        chaos_dataset, tmp_path, monkeypatch):
+    """The worker-kill site under a watchdog-armed reader: PR-1 supervision
+    respawns (the soft recovery), the epoch completes exactly-once, and no
+    hard stall fires."""
+    token = tmp_path / 'kill.token'
+    monkeypatch.setenv(ENV_VAR, 'worker-kill:token={}'.format(token))
+    with make_reader(chaos_dataset.url, reader_pool_type='process-zmq',
+                     workers_count=2, num_epochs=1, shuffle_row_groups=False,
+                     watchdog=True, stall_timeout_s=0.3) as reader:
+        ids = _read_all_ids(reader)
+        diagnostics = reader.diagnostics()
+        assert diagnostics['worker_respawns'] == 1
+        assert diagnostics['watchdog']['hard_stalls'] == 0
+    assert token.exists()
+    assert ids == list(range(ROWS))
+
+
+@pytest.mark.processpool
+def test_watchdog_classifies_worker_pool_dead(chaos_dataset):
+    """A SIGKILLed worker observed before PR-1 supervision can respawn it
+    (supervision runs on the consumer thread, which is paused here) is
+    classified worker-pool-dead; resuming consumption respawns and the
+    epoch still completes exactly-once."""
+    with make_reader(chaos_dataset.url, reader_pool_type='process-zmq',
+                     workers_count=2, num_epochs=1, shuffle_row_groups=False,
+                     watchdog=True, stall_timeout_s=0.1) as reader:
+        it = iter(reader)
+        ids = [int(next(it).id) for _ in range(3)]
+        os.kill(reader._workers_pool._processes[0].pid, signal.SIGKILL)
+        label = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            last = reader.diagnostics()['watchdog']['last_stall']
+            if last is not None:
+                label = last['classification']
+                break
+            time.sleep(0.05)
+        assert label == 'worker-pool-dead'
+        # Resume consumption. With a deliberately tiny 0.1s deadline the
+        # watchdog may escalate (respawned worker processes take ~1s to
+        # boot) — that is the documented contract: a DIAGNOSED error, not
+        # a hang, and the pipeline remains consumable through it, so
+        # exactly-once delivery still completes.
+        from petastorm_tpu.errors import PipelineStallError
+        while True:
+            try:
+                row = next(it)
+            except StopIteration:
+                break
+            except PipelineStallError as e:
+                assert 'Thread' in str(e)   # stack dump present
+                continue
+            ids.append(int(row.id))
+        assert reader.diagnostics()['worker_respawns'] == 1
+    assert sorted(ids) == list(range(ROWS))
+
+
+def test_watchdog_classifies_arena_pool_wedged_and_notify_wakeup():
+    """A pool with every arena pinned classifies arena-pool-wedged; and the
+    (satellite) notify-based waits wake the moment an arena is released —
+    acquire latency is no longer quantized to a poll interval."""
+    import threading
+
+    from petastorm_tpu.health import HeartbeatRegistry, classify_stall
+    from petastorm_tpu.staging import ArenaPool
+
+    registry = HeartbeatRegistry(0.2)
+    heartbeat = registry.register('assemble')
+    stop = threading.Event()
+    pool = ArenaPool(1, stop_event=stop, grow_timeout_s=30.0,
+                     heartbeat=heartbeat)
+    spec = {'x': ((4,), np.dtype('float32'))}
+    assert pool.get_buffers(spec) is not None
+    arena = pool.claim_pending()
+
+    got = []
+    waiter = threading.Thread(target=lambda: got.append(pool.get_buffers(spec)),
+                              daemon=True)
+    waiter.start()
+    time.sleep(0.45)
+    label, stage, _detail = classify_stall(registry.beat_table(),
+                                           registry.probe_snapshot())
+    assert (label, stage) == ('arena-pool-wedged', 'assemble')
+    t0 = time.monotonic()
+    arena.retire()                      # release notifies the condition
+    waiter.join(timeout=1.0)
+    wake_latency = time.monotonic() - t0
+    assert not waiter.is_alive()
+    assert got and got[0] is not None
+    assert wake_latency < 0.25
+    stop.set()
+    pool.wake()
+
+
+def test_watchdog_remote_server_dead_fails_over_shared_stream(chaos_dataset):
+    """One live data-service server + one dead endpoint: the watchdog's rpc
+    liveness probe classifies remote-server-dead and the soft recovery
+    fails the shared stream over to the survivor — the epoch completes
+    with every chunk the live server owned."""
+    import socket as pysocket
+
+    from petastorm_tpu.data_service import DataServer, RemoteReader
+    from petastorm_tpu.health import HeartbeatRegistry, Watchdog
+
+    probe = pysocket.socket()
+    probe.bind(('127.0.0.1', 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+
+    server_reader = make_tensor_reader(chaos_dataset.url,
+                                       reader_pool_type='dummy', num_epochs=1,
+                                       shuffle_row_groups=False)
+    server = DataServer(server_reader, 'tcp://127.0.0.1:*').start()
+    remote = RemoteReader([server.data_endpoint,
+                           'tcp://127.0.0.1:{}'.format(dead_port)],
+                          shared_stream=True, end_grace_s=0.75)
+    registry = HeartbeatRegistry({'default': 0.4})
+    remote.attach_health(registry)
+    watchdog = Watchdog(registry)
+    watchdog.start()
+    try:
+        rows = sum(len(chunk.id) for chunk in remote)
+        assert rows == ROWS              # everything the live server served
+        stats = watchdog.stats()
+        # The dead server was classified and failed over (the only soft
+        # recovery registered here is the remote-server-dead one); a later
+        # benign reader-starved episode during the end-grace window may
+        # overwrite last_stall, so assert the durable outcomes.
+        assert stats['stalls_detected'] >= 1
+        assert stats['soft_recoveries'] >= 1
+        diagnostics = remote.diagnostics
+        assert diagnostics['failed_over_servers'] == [
+            'tcp://127.0.0.1:{}'.format(dead_port + 2)]
+    finally:
+        watchdog.stop()
+        remote.stop()
+        remote.join()
+        server.stop()
+
+
+def test_classify_stall_vocabulary():
+    """The classification table docs/tests assert against, pinned."""
+    from petastorm_tpu.health import classify_stall
+
+    def beat(age, state, timeout=0.1):
+        return {'age_s': age, 'state': state, 'beats': 1,
+                'stall_timeout_s': timeout}
+
+    assert classify_stall({'assemble': beat(1.0, 'arena-wait')},
+                          {})[0] == 'arena-pool-wedged'
+    assert classify_stall({'assemble': beat(1.0, 'reader-wait')},
+                          {})[0] == 'reader-starved'
+    assert classify_stall({'assemble': beat(1.0, 'collate')},
+                          {})[0] == 'assemble-stuck'
+    assert classify_stall({'dispatch': beat(1.0, 'device_put')},
+                          {})[0] == 'dispatch-hung'
+    assert classify_stall({'dispatch': beat(1.0, 'ready-wait')},
+                          {})[0] == 'dispatch-hung'
+    assert classify_stall({'dispatch': beat(1.0, 'out-put')},
+                          {})[0] == 'consumer-not-draining'
+    assert classify_stall({'consumer': beat(1.0, 'delivered')},
+                          {'consumer': {'queue_depth': 2}}
+                          )[0] == 'consumer-not-draining'
+    # Inline staging (prefetch=0): the consumer thread IS the pipeline.
+    assert classify_stall({'consumer': beat(1.0, 'device_put')},
+                          {})[0] == 'dispatch-hung'
+    assert classify_stall({'consumer': beat(1.0, 'reader-wait')},
+                          {})[0] == 'reader-starved'
+    assert classify_stall({'reader-handoff': beat(1.0, 'poll')},
+                          {'worker-pool': {'dead_workers': [1]}}
+                          )[0] == 'worker-pool-dead'
+    assert classify_stall({'remote-recv': beat(1.0, 'recv')},
+                          {'remote-recv': {'dead_endpoints': ['tcp://h:1']}}
+                          )[0] == 'remote-server-dead'
+    assert classify_stall({'remote-recv': beat(1.0, 'recv')},
+                          {'remote-recv': {'dead_endpoints': []}}
+                          )[0] == 'reader-starved'
+    # Stages parked in waiting states are symptoms, never culprits.
+    assert classify_stall({'dispatch': beat(1.0, 'stageq-get'),
+                           'consumer': beat(1.0, 'queue-wait')},
+                          {})[0] == 'pipeline-waiting'
+    # A paused consumer quiets the remote receive loop too (backpressure);
+    # the downstream rule must win or a healthy pipeline escalates.
+    assert classify_stall({'remote-recv': beat(1.0, 'recv'),
+                           'dispatch': beat(1.0, 'out-put'),
+                           'consumer': beat(1.0, 'delivered')},
+                          {})[0] == 'consumer-not-draining'
+    # A dead server behind a loader: the starved assembler defers to the
+    # rpc probe so failover recovery can run.
+    assert classify_stall({'remote-recv': beat(1.0, 'recv'),
+                           'assemble': beat(1.0, 'reader-wait')},
+                          {'remote-recv': {'dead_endpoints': ['tcp://x:1']}}
+                          )[0] == 'remote-server-dead'
+    # Idle (cleanly finished / not started) stages never classify.
+    assert classify_stall({'remote-recv': beat(9.0, 'idle'),
+                           'consumer': beat(1.0, 'delivered')},
+                          {})[0] == 'consumer-not-draining'
+
+
+def test_watchdog_env_var_arms_and_sets_deadline(chaos_dataset, monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_WATCHDOG', '0')
+    with make_reader(chaos_dataset.url, reader_pool_type='dummy',
+                     num_epochs=1, shuffle_row_groups=False) as reader:
+        assert 'watchdog' not in reader.diagnostics()
+    monkeypatch.setenv('PETASTORM_TPU_WATCHDOG', '30')
+    with make_reader(chaos_dataset.url, reader_pool_type='dummy',
+                     num_epochs=1, shuffle_row_groups=False) as reader:
+        assert reader.diagnostics()['watchdog']['stalls_detected'] == 0
+        # A numeric env value is the default per-stage deadline.
+        assert reader._health.registry.timeout_for('anything') == 30.0
+
+
+# ---------------------------------------------------------------------------
+# satellites: leaked-thread accounting, rpc retry, per-test hang guard
+# ---------------------------------------------------------------------------
+
+def test_staging_engine_stop_records_leaked_threads():
+    """stop() must not pretend shutdown succeeded when a hung transfer
+    keeps the dispatch thread alive past join_timeout_s: the leak is
+    returned, recorded in stats, and traced."""
+    import queue as queue_mod
+    import threading
+
+    from petastorm_tpu.staging import StagingEngine
+    from petastorm_tpu.trace import Tracer
+
+    release = threading.Event()
+
+    def stage_fn(batch):
+        release.wait(10)     # a device_put that ignores stop
+        return batch
+
+    tracer = Tracer()
+    stop = threading.Event()
+    end = object()
+    engine = StagingEngine(iter([{'x': np.zeros(4)}]), stage_fn,
+                           queue_mod.Queue(maxsize=2), stop, end,
+                           tracer=tracer).start()
+    deadline = time.monotonic() + 5
+    while not release.wait(0) and time.monotonic() < deadline:
+        if any(t.name == 'pst-staging-dispatch' and t.is_alive()
+               for t in engine._threads):
+            time.sleep(0.2)   # give dispatch time to enter stage_fn
+            break
+    leaked = engine.stop(join_timeout_s=0.2)
+    assert leaked == ['pst-staging-dispatch']
+    assert engine.stats()['leaked_threads'] == ['pst-staging-dispatch']
+    assert any(e['name'].startswith('staging-leaked-thread')
+               for e in tracer.events)
+    release.set()
+    for thread in engine._threads:
+        thread.join(timeout=5)
+
+
+def test_one_shot_rpc_retries_before_declaring_dead(monkeypatch):
+    """Satellite: one dropped REP no longer marks a healthy server dead —
+    the rpc goes through RetryPolicy; None means the WHOLE budget went
+    unanswered (dead), not one lost reply (slow)."""
+    from petastorm_tpu.data_service import RemoteReader, RpcUnanswered
+    from petastorm_tpu.retry import RetryPolicy
+
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                         retry_exceptions=(RpcUnanswered,),
+                         sleep=lambda s: None)
+    reader = RemoteReader('tcp://127.0.0.1:9', rpc_retry_policy=policy)
+    try:
+        calls = {'n': 0}
+
+        def flaky(endpoint, request, timeout_ms):
+            calls['n'] += 1
+            if calls['n'] < 3:
+                raise RpcUnanswered('dropped REP')
+            return {'ok': True}
+
+        monkeypatch.setattr(reader, '_rpc_attempt', flaky)
+        assert reader._one_shot_rpc('tcp://x', {'cmd': 'stats'}) == {'ok': True}
+        assert calls['n'] == 3           # two drops absorbed
+
+        calls['n'] = 0
+
+        def dead(endpoint, request, timeout_ms):
+            calls['n'] += 1
+            raise RpcUnanswered('nothing there')
+
+        monkeypatch.setattr(reader, '_rpc_attempt', dead)
+        assert reader._one_shot_rpc('tcp://x', {'cmd': 'stats'}) is None
+        assert calls['n'] == 3           # whole budget spent before None
+    finally:
+        reader.stop()
+        reader.join()
+
+
+@pytest.mark.timeout(2)
+def test_hang_guard_interrupts_a_hang(request):
+    """Satellite: the conftest SIGALRM guard fails a hung test fast (with
+    a thread dump) instead of eating the tier-1 wall-clock budget."""
+    from conftest import TestHangTimeout
+
+    if request.config.pluginmanager.hasplugin('timeout'):
+        pytest.skip('pytest-timeout is active; the SIGALRM fallback guard '
+                    'is deliberately dormant')
+    t0 = time.monotonic()
+    with pytest.raises(TestHangTimeout, match='hang-guard'):
+        time.sleep(60)
+    assert time.monotonic() - t0 < 10
+
+
+def test_watchdog_standalone_reader_delivers_diagnosed_error(
+        chaos_dataset, monkeypatch):
+    """Without a loader, a hard stall still surfaces as a diagnosed
+    PipelineStallError from Reader iteration (thread-pool injection path) —
+    not an unbounded block in get_results."""
+    from petastorm_tpu.errors import PipelineStallError
+
+    monkeypatch.setenv(ENV_VAR, 'queue-stall:delay=6:max=1')
+    with make_tensor_reader(chaos_dataset.url, reader_pool_type='thread',
+                            workers_count=1, num_epochs=1,
+                            shuffle_row_groups=False, watchdog=True,
+                            stall_timeout_s=0.2) as reader:
+        t0 = time.monotonic()
+        with pytest.raises(PipelineStallError) as exc_info:
+            next(iter(reader))
+        assert time.monotonic() - t0 < 3.0
+        assert exc_info.value.diagnosis['classification'] == 'reader-starved'
+        assert 'Thread' in str(exc_info.value)
+
+
+def test_watchdog_recovered_stall_does_not_kill_reader(
+        chaos_dataset, monkeypatch):
+    """A stall that escalates while the consumer is parked but then clears
+    (the injected delay ends) must not poison the recovered pipeline with a
+    stale error: every row still arrives."""
+    from petastorm_tpu.errors import PipelineStallError
+
+    monkeypatch.setenv(ENV_VAR, 'queue-stall:delay=1.2:max=1')
+    with make_tensor_reader(chaos_dataset.url, reader_pool_type='thread',
+                            workers_count=1, num_epochs=1,
+                            shuffle_row_groups=False, watchdog=True,
+                            stall_timeout_s=0.2) as reader:
+        rows = 0
+        it = iter(reader)
+        while True:
+            try:
+                chunk = next(it)
+            except StopIteration:
+                break
+            except PipelineStallError:
+                continue   # diagnosed mid-stall; pipeline still consumable
+            rows += len(chunk.id)
+    assert rows == ROWS

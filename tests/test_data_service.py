@@ -520,6 +520,13 @@ def test_shared_stream_checkpoint(service_dataset):
         endpoints = [s1.data_endpoint, s2.data_endpoint]
         r1 = RemoteReader(endpoints, shared_stream=True)
         r2 = RemoteReader(endpoints, shared_stream=True)
+        # start=False alone is not enough: connect() is async, so without a
+        # settle the servers can start pushing while r2's TCP handshake is
+        # still in flight — the whole tiny stream then commits to r1's
+        # pipes and r2 grace-ends with zero chunks (observed flake under
+        # load). A short settle lets both pipes establish first.
+        import time as _time
+        _time.sleep(0.3)
         s1.start()
         s2.start()
         with r1, r2:
@@ -781,6 +788,10 @@ def test_shared_stream_checkpoint_through_loaders(service_dataset):
         r2 = RemoteReader(endpoints, shared_stream=True)
         l1 = JaxLoader(r1, 8, last_batch='drop', prefetch=4)
         l2 = JaxLoader(r2, 8, last_batch='drop', prefetch=4)
+        # Let both consumers' pipes establish before the servers push (see
+        # test_shared_stream_checkpoint — same starvation race).
+        import time as _time
+        _time.sleep(0.3)
         s1.start()
         s2.start()
         it1, it2 = iter(l1), iter(l2)
