@@ -395,6 +395,22 @@ def _staging_counters(stats):
              'arena_alloc', 'arena_wait_s')}
 
 
+def _autotune_summary(stats):
+    """Compact autotune record for a bench JSON: current knob values, the
+    decision log tail, and the knob trajectory (ISSUE 4: the children run
+    with the controller on and must emit what it did)."""
+    at = stats.get('autotune')
+    if not at:
+        return None
+    return {'knobs': at.get('knobs'),
+            'last_class': at.get('last_class'),
+            'ticks': at.get('ticks'),
+            'paused_ticks': at.get('paused_ticks'),
+            'reverts': at.get('reverts'),
+            'decisions': at.get('decisions', [])[-40:],
+            'trajectory': at.get('trajectory', [])[-40:]}
+
+
 def _child_pipeline(url, workers):
     """Loader-only pipeline capacity (VERDICT r4 #2): the same tensor reader +
     JaxLoader path as the imagenet child but with NO train step — measures how
@@ -403,7 +419,16 @@ def _child_pipeline(url, workers):
     the train-loop stall fraction only bounds it against one model's step
     time. Mirrors the reference's reader-only throughput quantity
     (``petastorm/benchmark/throughput.py:94-110``). Host-side work dominates,
-    so the number is meaningful even when jax runs on CPU."""
+    so the number is meaningful even when jax runs on CPU.
+
+    Load-controlled protocol (VERDICT r5 next-#7): the child takes the
+    probe flock (so an opportunistic TPU probe can't land mid-window),
+    records loadavg around the measurement, and reports the MEDIAN of
+    N >= 3 repetition windows plus their spread — this box's throughput
+    swings with shared-VM load, and a single draw made cross-round host-
+    capacity diffs noise."""
+    import fcntl
+
     import jax
 
     _force_cpu_if_requested()
@@ -419,44 +444,109 @@ def _child_pipeline(url, workers):
     # assemble/dispatch overlap — the ISSUE 2 tentpole); 0 recovers the old
     # serial consumer-staging measurement for comparison.
     prefetch = int(os.environ.get('BENCH_PIPELINE_PREFETCH', '2'))
-    reader = make_tensor_reader(url, schema_fields=['image', 'label'],
-                                reader_pool_type='thread', workers_count=workers,
-                                num_epochs=None, shuffle_row_groups=True, seed=0,
-                                cache_type='memory')
-    with reader:
-        with JaxLoader(reader, batch, prefetch=prefetch) as loader:
-            it = iter(loader)
-            # Warm through one epoch: decoded RAM cache fills, so the
-            # steady-state number isolates pipeline mechanics from first-
-            # epoch jpeg decode (reported separately below).
-            t0 = time.perf_counter()
-            for _ in range(warm_batches):
-                b = next(it)
-            jax.block_until_ready(b.image)
-            cold_rate = batch * warm_batches / (time.perf_counter() - t0)
-            t_read0 = dict(reader.stage_timings)
-            loader.reset_stats()
-            start = time.perf_counter()
-            for _ in range(measure_batches):
-                b = next(it)
-            jax.block_until_ready(b.image)
-            elapsed = time.perf_counter() - start
-            stats = loader.stats
-            t_read = stats.get('worker_stage_timings', {})
+    # The autotuner (ISSUE 4) runs by default so the capacity number is the
+    # self-configured one; BENCH_PIPELINE_AUTOTUNE=0 recovers fixed knobs,
+    # and the *_ARENA_DEPTH/_INFLIGHT envs set deliberately bad starting
+    # points for the convergence experiment.
+    autotune_on = os.environ.get('BENCH_PIPELINE_AUTOTUNE', '1') == '1'
+    arena_depth = os.environ.get('BENCH_PIPELINE_ARENA_DEPTH')
+    inflight = int(os.environ.get('BENCH_PIPELINE_INFLIGHT', '2'))
+    reps = max(1, int(os.environ.get('BENCH_PIPELINE_REPS', '3')))
+
+    # Single-flight vs the opportunistic prober: its claim/measure cycle
+    # loads the box and would skew the capacity window (and vice versa).
+    # Bounded wait, then proceed with the contention on record. When this
+    # child runs UNDER probe_now, the parent already holds the flock for
+    # the whole attempt — contending it here would only stall the child
+    # for the full wait and misrecord the run as unlocked.
+    lock = open(_OPPORTUNISTIC_PATH + '.probe_lock', 'a')
+    lock_held = False
+    if os.environ.get('BENCH_PIPELINE_PARENT_HOLDS_LOCK') == '1':
+        lock_held = 'parent'
+    else:
+        lock_deadline = time.monotonic() + float(
+            os.environ.get('BENCH_PIPELINE_LOCK_WAIT_S', '60'))
+        while True:
+            try:
+                fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                lock_held = True
+                break
+            except OSError:
+                if time.monotonic() >= lock_deadline:
+                    break
+                time.sleep(1)
+    try:
+        load_before = os.getloadavg()
+        reader = make_tensor_reader(
+            url, schema_fields=['image', 'label'],
+            reader_pool_type='thread', workers_count=workers,
+            num_epochs=None, shuffle_row_groups=True, seed=0,
+            cache_type='memory')
+        with reader:
+            with JaxLoader(reader, batch, prefetch=prefetch,
+                           inflight=inflight,
+                           arena_depth=(int(arena_depth)
+                                        if arena_depth else None),
+                           autotune=autotune_on) as loader:
+                it = iter(loader)
+                # Warm through one epoch: decoded RAM cache fills, so the
+                # steady-state number isolates pipeline mechanics from
+                # first-epoch jpeg decode (reported separately below).
+                t0 = time.perf_counter()
+                for _ in range(warm_batches):
+                    b = next(it)
+                jax.block_until_ready(b.image)
+                cold_rate = batch * warm_batches / (time.perf_counter() - t0)
+                t_read0 = dict(reader.stage_timings)
+                # One stats window covering ALL reps: per-rep rates come
+                # from per-rep wall clocks, while the stage profile stays
+                # internally consistent (read/decode/cache deltas, loader
+                # counters, and wall_s all span the same reps x batches).
+                loader.reset_stats()
+                rates = []
+                wall_s = 0.0
+                for _ in range(reps):
+                    start = time.perf_counter()
+                    for _ in range(measure_batches):
+                        b = next(it)
+                    jax.block_until_ready(b.image)
+                    elapsed = time.perf_counter() - start
+                    wall_s += elapsed
+                    rates.append(batch * measure_batches / elapsed)
+                stats = loader.stats
+                t_read = stats.get('worker_stage_timings', {})
+        load_after = os.getloadavg()
+    finally:
+        lock.close()   # releases the flock if held
+    ranked = sorted(rates)   # `rates` itself stays in measurement order:
+                             # the reps list is the convergence trajectory
+    middle = len(ranked) // 2
+    median = (ranked[middle] if len(ranked) % 2
+              else (ranked[middle - 1] + ranked[middle]) / 2)
     profile = {k: round(t_read.get(k, 0) - t_read0.get(k, 0), 4)
                for k in ('read_s', 'decode_s', 'cache_s')}
     profile['stage_dispatch_s'] = stats['stage_dispatch_s']
     profile['consumer_wait_s'] = stats['wait_s']
-    profile['wall_s'] = round(elapsed, 4)
+    profile['wall_s'] = round(wall_s, 4)
     profile.update(_staging_counters(stats))
     profile.update(_robustness_counters(stats))
-    print(json.dumps({
-        'pipeline_img_per_sec': round(batch * measure_batches / elapsed, 2),
+    out = {
+        'pipeline_img_per_sec': round(median, 2),
+        'pipeline_img_per_sec_reps': [round(r, 2) for r in rates],
+        'pipeline_img_per_sec_spread': round(ranked[-1] - ranked[0], 2),
         'pipeline_cold_img_per_sec': round(cold_rate, 2),
         'pipeline_batch': batch,
         'pipeline_prefetch': prefetch,
+        'pipeline_load': {'loadavg_before': list(load_before),
+                          'loadavg_after': list(load_after),
+                          'probe_lock_held': lock_held,
+                          'repetitions': reps},
         'pipeline_stage_profile': profile,
-        'platform': jax.devices()[0].platform}))
+        'platform': jax.devices()[0].platform}
+    autotune_rec = _autotune_summary(stats)
+    if autotune_rec is not None:
+        out['pipeline_autotune'] = autotune_rec
+    print(json.dumps(out))
 
 
 def _child_flashattn():
@@ -759,6 +849,10 @@ def _child_imagenet(url, workers):
     stage_chunks = int(os.environ.get('BENCH_STAGE_CHUNKS',
                                       '4' if platform != 'cpu' else '1'))
 
+    # Self-configuring pipeline (ISSUE 4): the adaptive autotuner runs by
+    # default; BENCH_IMAGENET_AUTOTUNE=0 pins the hand-tuned knobs.
+    autotune_on = os.environ.get('BENCH_IMAGENET_AUTOTUNE', '1') == '1'
+
     aug = os.environ.get('BENCH_IMAGENET_AUG') == '1'
     if aug:
         # Measure the fused on-device Inception augmentation instead of
@@ -821,6 +915,7 @@ def _child_imagenet(url, workers):
         'native_parquet': os.environ.get('PETASTORM_TPU_NATIVE_PARQUET', 'auto'),
         'native_image': not os.environ.get('PETASTORM_TPU_NO_NATIVE'),
         'on_device_augment': aug,
+        'autotune': autotune_on,
     }
     reader = make_tensor_reader(url, schema_fields=['image', 'label'],
                                 reader_pool_type='thread', workers_count=workers,
@@ -829,7 +924,8 @@ def _child_imagenet(url, workers):
 
     with reader:
         with JaxLoader(reader, batch, mesh=mesh, prefetch=prefetch,
-                       stage_chunks=stage_chunks) as loader:
+                       stage_chunks=stage_chunks,
+                       autotune=autotune_on) as loader:
             it = loader.superbatches(scan_k)
             for _ in range(warmup_iters):
                 b = next(it)
@@ -943,6 +1039,9 @@ def _child_imagenet(url, workers):
         'final_loss': round(float(metrics['loss']), 4),
         'bench_config': config,
     }
+    autotune_rec = _autotune_summary(stats)
+    if autotune_rec is not None:
+        out['imagenet_autotune'] = autotune_rec
     out.update(h2d)
     if hbm_cached is not None:
         if isinstance(hbm_cached, dict):
@@ -1120,9 +1219,19 @@ def _sustained_best(inet):
 
 def _set_headline(result, inet, source=None):
     """Point the headline keys (metric/value/unit/vs_baseline + provenance)
-    at an imagenet child record, choosing its best sustained configuration."""
+    at an imagenet child record, choosing its best sustained configuration.
+
+    Headline hygiene (ADVICE r5 #5): the HBM-resident basis gets a
+    DISTINCT metric name (``..._sustained``) plus a machine-checkable
+    ``headline_config`` key, so a cross-round diff can never silently
+    compare a streamed-from-host number against an HBM-resident one."""
     rate, basis, mfu, stall = _sustained_best(inet)
-    result['metric'] = 'imagenet_resnet50_img_per_sec_per_chip'
+    hbm_basis = bool(basis) and basis.startswith('hbm_resident')
+    result['metric'] = ('imagenet_resnet50_img_per_sec_per_chip_sustained'
+                        if hbm_basis
+                        else 'imagenet_resnet50_img_per_sec_per_chip')
+    result['headline_config'] = ('hbm_resident' if hbm_basis
+                                 else 'streamed_from_host')
     result['value'] = rate
     result['unit'] = 'img/s/chip'
     result['vs_baseline'] = round(rate / _NORTH_STAR_IMG_PER_SEC, 3)
@@ -1335,9 +1444,11 @@ def _probe_now_locked(workers, probe_timeouts):
                 inet.get('imagenet_img_per_sec_per_chip')))
     else:
         attempt['outcome'] = 'terminal granted but child failed'
-    # Pipeline capacity rides the same grant; failure is non-fatal.
-    pipe, perr = _run_child('pipeline', [imagenet_url, str(workers)],
-                            timeout_s=900)
+    # Pipeline capacity rides the same grant; failure is non-fatal. This
+    # process already holds the probe flock — the child must not contend it.
+    pipe, perr = _run_child(
+        'pipeline', [imagenet_url, str(workers)], timeout_s=900,
+        extra_env={'BENCH_PIPELINE_PARENT_HOLDS_LOCK': '1'})
     attempt['pipeline'] = pipe if pipe is not None else perr
     # Second model family on real data: the repo's ViT through the same
     # reader -> loader -> train-step path, reduced footprint (the HBM-cached

@@ -1,0 +1,493 @@
+"""Adaptive pipeline autotuner: feedback-driven knob control.
+
+The pipeline's speed knobs — ``workers_count``, ``prefetch``,
+``arena_depth``, ``inflight``, ventilation depth — are fixed at
+construction, yet the optimum moves at runtime: the first (decode-bound)
+epoch and the cache-warm (collate-bound) steady state want different
+settings, and shared-host load swings capacity severalfold between runs
+(PROFILE_r05). tf.data's autotuning (Murray et al., VLDB 2021) and DALI's
+pipeline-depth tuning both show a feedback controller over stage latencies
+recovers near-hand-tuned throughput without per-workload sweeps. Every
+signal such a controller needs already exists here (PR-3 heartbeats, PR-2
+staging counters, consumer wait accounting); this module closes the loop:
+
+:class:`AutoTuner`
+    A control thread that samples a telemetry function every
+    ``interval_s``, computes per-tick deltas of the cumulative wait
+    counters, classifies the **dominant bottleneck** (reader-starved /
+    dispatch-bound / arena-bound / consumer-bound / balanced), and nudges
+    one knob per decision in an AIMD/hill-climbing loop:
+
+    * reader-starved -> grow the worker pool (``ThreadPool.resize``) and
+      loosen ventilation;
+    * dispatch-bound -> widen the in-flight ``device_put`` window (then
+      prefetch depth);
+    * arena-bound -> deepen the host-arena pool;
+    * consumer-bound -> shrink everything one step and tighten the
+      ventilator's results-queue watermark — release memory instead of
+      racing ahead of a consumer that isn't draining.
+
+    Safeguards: per-knob min/max clamps, hysteresis (a classification
+    must repeat for ``hysteresis`` consecutive ticks before any action),
+    a post-action cooldown, a throughput guard that *reverts* the last
+    action when the delivered rate drops past ``throughput_tolerance``,
+    and a hard pause whenever the watchdog (``health.py``) has an active
+    stall episode — the tuner must never fight stall recovery. Every
+    decision lands in a bounded log (surfaced as
+    ``Reader.diagnostics()['autotune']`` / loader ``stats['autotune']``)
+    plus per-knob trace counter events.
+
+Enable with ``autotune=True`` (or an :class:`AutotuneConfig`) on
+``make_reader`` / ``make_batch_reader`` / ``make_tensor_reader`` /
+``JaxLoader``, or process-wide via the ``PETASTORM_TPU_AUTOTUNE``
+environment variable (``1``/``true`` = on with defaults; a number = on
+with that tick interval in seconds; ``0``/``off``/unset = off). A
+``JaxLoader`` wrapping an autotuned reader adopts its knobs so one
+controller tunes the whole pipeline (mirroring the watchdog's
+``attach_health`` ownership rule).
+"""
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = 'PETASTORM_TPU_AUTOTUNE'
+
+# Bottleneck classification labels (the vocabulary tests and docs assert
+# against; deliberately overlapping with health.py's stall vocabulary where
+# the meaning matches).
+READER_STARVED = 'reader-starved'
+DISPATCH_BOUND = 'dispatch-bound'
+ARENA_BOUND = 'arena-bound'
+CONSUMER_BOUND = 'consumer-bound'
+INPUT_BOUND = 'input-bound'     # consumer waits but no stage blames a wait:
+                                # the pipeline's own work is the limit
+BALANCED = 'balanced'
+
+
+def autotune_enabled(explicit=None):
+    """Resolve the ``autotune=`` knob against the environment default.
+
+    ``explicit`` wins when not None (an :class:`AutotuneConfig` counts as
+    True); otherwise ``PETASTORM_TPU_AUTOTUNE`` decides
+    (unset/empty/0/off = disabled)."""
+    if explicit is not None:
+        return bool(explicit)
+    raw = os.environ.get(ENV_VAR, '').strip().lower()
+    return raw not in ('', '0', 'off', 'false', 'no')
+
+
+def env_interval():
+    """A numeric ``PETASTORM_TPU_AUTOTUNE`` value is the tick interval in
+    seconds; any other truthy value keeps the built-in default. ``'1'``
+    is the documented plain on-switch, NOT a 1-second interval."""
+    raw = os.environ.get(ENV_VAR, '').strip()
+    if raw == '1':
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        return None
+    return value if value > 0 else None
+
+
+class AutotuneConfig(object):
+    """Bounds and pacing for the :class:`AutoTuner` control loop.
+
+    Pass an instance as ``autotune=`` to any reader/loader factory. Every
+    knob has a ``[min, max]`` clamp the tuner never crosses; ``hysteresis``
+    and ``cooldown`` are in ticks; ``throughput_tolerance`` is the
+    fractional rate drop past which the last action is reverted.
+    """
+
+    def __init__(self, interval_s=0.5, hysteresis=2, cooldown=2,
+                 throughput_tolerance=0.15, log_size=256,
+                 min_workers=1, max_workers=None,
+                 min_prefetch=1, max_prefetch=8,
+                 min_inflight=1, max_inflight=8,
+                 min_arena_depth=2, max_arena_depth=16,
+                 min_watermark=4,
+                 starve_frac=0.05, signal_frac=0.05):
+        if interval_s <= 0:
+            raise ValueError('interval_s must be positive, got {}'.format(interval_s))
+        self.interval_s = float(interval_s)
+        self.hysteresis = max(1, int(hysteresis))
+        self.cooldown = max(0, int(cooldown))
+        self.throughput_tolerance = float(throughput_tolerance)
+        self.log_size = int(log_size)
+        self.min_workers = max(1, int(min_workers))
+        if max_workers is None:
+            # Threads beyond a few per core only add GIL ping-pong; the
+            # decode path releases the GIL, so oversubscribe moderately.
+            max_workers = min(32, 4 * (os.cpu_count() or 4))
+        self.max_workers = max(self.min_workers, int(max_workers))
+        self.min_prefetch = max(1, int(min_prefetch))
+        self.max_prefetch = max(self.min_prefetch, int(max_prefetch))
+        self.min_inflight = max(1, int(min_inflight))
+        self.max_inflight = max(self.min_inflight, int(max_inflight))
+        self.min_arena_depth = max(1, int(min_arena_depth))
+        self.max_arena_depth = max(self.min_arena_depth, int(max_arena_depth))
+        self.min_watermark = max(2, int(min_watermark))
+        # Below this fraction of wall time blocked, the consumer counts as
+        # "kept fed"; above it, the biggest stage-wait fraction must also
+        # clear signal_frac to earn the blame.
+        self.starve_frac = float(starve_frac)
+        self.signal_frac = float(signal_frac)
+
+
+def resolve_config(explicit=None):
+    """The effective config for an ``autotune=`` value: pass through an
+    :class:`AutotuneConfig`, else defaults with any env-var interval."""
+    if isinstance(explicit, AutotuneConfig):
+        return explicit
+    interval = env_interval()
+    return AutotuneConfig(interval_s=interval) if interval else AutotuneConfig()
+
+
+class Knob(object):
+    """One tunable pipeline parameter: live getter/setter plus clamps.
+
+    ``get``/``set`` must be thread-safe — they run on the tuner thread
+    against state owned by pipeline threads (``ThreadPool.resize``, queue
+    maxsize under its mutex, plain atomic attribute writes)."""
+
+    def __init__(self, name, get, set, lo, hi):
+        self.name = name
+        self.get = get
+        self.set = set
+        self.lo = int(lo)
+        self.hi = int(hi)
+
+    def clamp(self, value):
+        return max(self.lo, min(self.hi, int(value)))
+
+
+# --------------------------------------------------------------------------
+# bottleneck classification
+# --------------------------------------------------------------------------
+
+def classify_loader(deltas, gauges, dt, config):
+    """Dominant bottleneck of a JaxLoader pipeline from one tick's wait
+    deltas (seconds blocked per stage) and queue gauges.
+
+    Returns ``(label, detail)``. The rule set mirrors the stats doc: the
+    consumer's own blocked fraction says whether the pipeline keeps up;
+    when it doesn't, whichever stage spent the biggest fraction of the
+    tick *waiting* (reader pull / arena acquire / transfer fence) is the
+    bottleneck its knob can relieve."""
+    wait_frac = deltas.get('wait_s', 0.0) / dt
+    reader_frac = deltas.get('reader_wait_s', 0.0) / dt
+    arena_frac = deltas.get('arena_wait_s', 0.0) / dt
+    ready_frac = deltas.get('ready_wait_s', 0.0) / dt
+    capacity = gauges.get('queue_capacity') or 1
+    fill = (gauges.get('queue_depth') or 0) / capacity
+    if wait_frac < config.starve_frac:
+        if fill >= 0.5:
+            return (CONSUMER_BOUND,
+                    'consumer blocked {:.0%} of the tick with the staging '
+                    'queue {:.0%} full — pipeline is ahead of the trainer'
+                    .format(wait_frac, fill))
+        return (BALANCED, 'consumer blocked only {:.0%} of the tick'
+                .format(wait_frac))
+    candidates = [(READER_STARVED, reader_frac),
+                  (ARENA_BOUND, arena_frac),
+                  (DISPATCH_BOUND, ready_frac)]
+    label, frac = max(candidates, key=lambda kv: kv[1])
+    if frac < config.signal_frac:
+        return (INPUT_BOUND,
+                'consumer blocked {:.0%} of the tick but no stage reports '
+                'waiting — pipeline work itself is the limit'.format(wait_frac))
+    return (label, 'consumer blocked {:.0%}; dominant stage wait: {} '
+            '{:.0%} of the tick'.format(wait_frac, label, frac))
+
+
+def classify_reader(deltas, gauges, dt, config):
+    """Bottleneck of a standalone Reader (no staging engine): judged from
+    the worker pool's results-queue occupancy — a full queue means the
+    consumer is the limit, an empty one with work still ventilated means
+    the decode tier is."""
+    capacity = gauges.get('results_queue_capacity') or 0
+    if capacity <= 0:
+        # Unbounded results queue: occupancy carries no saturation signal
+        # (any backlog would read as "full" against a fake capacity) — do
+        # nothing rather than shrink a pool on garbage evidence.
+        return (BALANCED, 'results queue unbounded: no fill signal')
+    fill = (gauges.get('results_queue_depth') or 0) / capacity
+    pending = gauges.get('ventilated_unprocessed') or 0
+    if fill >= 0.6:
+        return (CONSUMER_BOUND,
+                'results queue {:.0%} full — consumer is the limit'.format(fill))
+    if fill <= 0.1 and pending > 0:
+        return (READER_STARVED,
+                'results queue {:.0%} full with {} ventilated item(s) still '
+                'unprocessed — decode tier is the limit'.format(fill, pending))
+    return (BALANCED, 'results queue {:.0%} full'.format(fill))
+
+
+# Per-classification grow preferences: the first listed knob that exists
+# and is not already at its clamp takes one additive step.
+_GROW_ACTIONS = {
+    READER_STARVED: (('workers', 1), ('results_watermark', 8)),
+    INPUT_BOUND: (('workers', 1),),
+    DISPATCH_BOUND: (('inflight', 1), ('prefetch', 1)),
+    ARENA_BOUND: (('arena_depth', 2),),
+}
+
+# Consumer-bound shrink: one step down on every present knob (release
+# memory), with the ventilation watermark tightened hardest — over-
+# ventilating row-groups into a saturated results queue only pins memory
+# and stretches tail latency.
+_SHRINK_STEPS = (('workers', 1), ('prefetch', 1), ('inflight', 1),
+                 ('arena_depth', 2), ('results_watermark', 8))
+
+# Cumulative telemetry counters (everything else is a gauge).
+_CUMULATIVE_KEYS = ('batches', 'wait_s', 'reader_wait_s', 'arena_wait_s',
+                    'ready_wait_s')
+
+
+class AutoTuner(object):
+    """Feedback control thread over a set of :class:`Knob`\\ s.
+
+    :param telemetry_fn: ``() -> dict`` sampled once per tick. Keys in
+        ``_CUMULATIVE_KEYS`` are treated as monotonically increasing
+        counters (the tuner differences them); everything else is a gauge.
+        Must be cheap and must not block.
+    :param knobs: dict name -> :class:`Knob`.
+    :param config: :class:`AutotuneConfig` (defaults applied when None).
+    :param classify_fn: ``(deltas, gauges, dt, config) -> (label, detail)``.
+    :param watchdog_active_fn: ``() -> bool``; True pauses tuning for the
+        tick (an active stall episode — recovery owns the pipeline).
+    """
+
+    def __init__(self, telemetry_fn, knobs, config=None, tracer=None,
+                 classify_fn=classify_loader, watchdog_active_fn=None,
+                 name='pst-autotune'):
+        self._telemetry_fn = telemetry_fn
+        self.knobs = dict(knobs)
+        self.config = config if config is not None else AutotuneConfig()
+        if tracer is None:
+            from petastorm_tpu.trace import NullTracer
+            tracer = NullTracer()
+        self._tracer = tracer
+        self._classify_fn = classify_fn
+        self._watchdog_active_fn = watchdog_active_fn
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=name)
+        self._lock = threading.Lock()
+        self._log = deque(maxlen=self.config.log_size)
+        self._trajectory = deque(maxlen=self.config.log_size)
+        self._t0 = None
+        self._prev = None
+        self._prev_t = None
+        self._streak = (None, 0)
+        self._cooldown = 0
+        self._pending = None      # last action awaiting its throughput verdict
+        self._paused_streak = False
+        self.ticks = 0
+        self.paused_ticks = 0
+        self.reverts = 0
+        self.last_class = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout_s=5):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=join_timeout_s)
+
+    @property
+    def alive(self):
+        return self._thread.is_alive()
+
+    def _loop(self):
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - the tuner must not die of a bug
+                logger.exception('autotune tick failed')
+
+    # -- control loop ------------------------------------------------------
+
+    def tick(self, now=None):
+        """One control pass (called by the thread; tests drive it directly
+        with a synthetic clock). Returns the decision dict when a knob
+        changed, else None."""
+        now = now if now is not None else time.monotonic()
+        if self._t0 is None:
+            self._t0 = now
+        snap = self._telemetry_fn() or {}
+        prev, prev_t = self._prev, self._prev_t
+        self._prev, self._prev_t = snap, now
+        self.ticks += 1
+        if self._watchdog_active_fn is not None and self._watchdog_active_fn():
+            # A diagnosed stall episode is in progress: recovery owns the
+            # pipeline. Tuning against it would blur the diagnosis (and a
+            # knob change can mask the stall the watchdog is escalating).
+            self.paused_ticks += 1
+            self._streak = (None, 0)
+            self._pending = None
+            if not self._paused_streak:
+                self._paused_streak = True
+                self._record({'action': 'paused',
+                              'detail': 'watchdog stall episode active'}, now)
+            return None
+        self._paused_streak = False
+        if prev is None:
+            self._snapshot_trajectory(now)
+            return None
+        dt = now - prev_t
+        if dt <= 0:
+            return None
+        deltas = {k: snap.get(k, 0) - prev.get(k, 0) for k in _CUMULATIVE_KEYS}
+        if any(v < 0 for v in deltas.values()):
+            # A cumulative counter went BACKWARD: someone reset the stats
+            # mid-run (bench reset_stats() after warmup). The tick's
+            # deltas — and any pending action verdict judged on them —
+            # are garbage; discard both and re-baseline from this sample.
+            self._pending = None
+            self._streak = (None, 0)
+            return None
+        rate = deltas.get('batches', 0) / dt
+        label, detail = self._classify_fn(deltas, snap, dt, self.config)
+        self.last_class = label
+
+        # Throughput guard first: the verdict on the previous action is due
+        # once its cooldown expired (one settling window after the change).
+        if self._pending is not None and self._cooldown <= 1:
+            pending, self._pending = self._pending, None
+            base = pending['base_rate']
+            tol = self.config.throughput_tolerance
+            if base > 0 and rate < base * (1.0 - tol):
+                for name, old, _new in pending['changes']:
+                    self.knobs[name].set(old)
+                self.reverts += 1
+                decision = {'action': 'revert', 'class': label,
+                            'changes': [(n, new, old)
+                                        for n, old, new in pending['changes']],
+                            'rate': round(rate, 2),
+                            'detail': 'rate {:.1f}/s fell past {:.0%} of '
+                                      'pre-action {:.1f}/s'.format(
+                                          rate, 1.0 - tol, base)}
+                self._record(decision, now)
+                self._snapshot_trajectory(now)
+                self._cooldown = self.config.cooldown
+                self._streak = (None, 0)
+                return decision
+
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+
+        streak_label, streak_count = self._streak
+        if label != streak_label:
+            self._streak = (label, 1)
+        else:
+            self._streak = (label, streak_count + 1)
+        if self._streak[1] < self.config.hysteresis:
+            return None
+        if label in (BALANCED,):
+            return None
+
+        changes = (self._shrink() if label == CONSUMER_BOUND
+                   else self._grow(label))
+        if not changes:
+            self._streak = (label, 0)
+            return None
+        decision = {'action': 'shrink' if label == CONSUMER_BOUND else 'grow',
+                    'class': label, 'changes': changes,
+                    'rate': round(rate, 2), 'detail': detail}
+        self._record(decision, now)
+        self._snapshot_trajectory(now)
+        self._pending = {'changes': changes, 'base_rate': rate}
+        self._cooldown = self.config.cooldown
+        self._streak = (label, 0)
+        return decision
+
+    def _grow(self, label):
+        for name, step in _GROW_ACTIONS.get(label, ()):
+            knob = self.knobs.get(name)
+            if knob is None:
+                continue
+            old = knob.get()
+            if old >= knob.hi:
+                # At (or hand-set above) the clamp: clamping old+step would
+                # MOVE THE KNOB DOWN — shrinking the very resource the
+                # classifier wants more of. Out-of-range stays untouched.
+                continue
+            new = knob.clamp(old + step)
+            if new != old:
+                knob.set(new)
+                return [(name, old, new)]
+        return []
+
+    def _shrink(self):
+        changes = []
+        for name, step in _SHRINK_STEPS:
+            knob = self.knobs.get(name)
+            if knob is None:
+                continue
+            old = knob.get()
+            if old <= knob.lo:   # mirror of _grow: never clamp upward
+                continue
+            # One additive step, floored at lo — deliberately NOT hi-
+            # clamped: a hand-set above-range value must step down
+            # gradually, not collapse to the clamp in one decision.
+            new = max(knob.lo, old - step)
+            if new != old:
+                knob.set(new)
+                changes.append((name, old, new))
+        return changes
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _record(self, decision, now):
+        decision = dict(decision)
+        decision['t'] = round(now - self._t0, 3)
+        decision['tick'] = self.ticks
+        with self._lock:
+            self._log.append(decision)
+        self._tracer.instant(
+            'autotune:{}:{}'.format(decision['action'],
+                                    decision.get('class', '-')),
+            cat='autotune',
+            args={k: v for k, v in decision.items() if k != 'detail'})
+        logger.debug('autotune decision: %s', decision)
+
+    def _snapshot_trajectory(self, now):
+        point = {'t': round(now - self._t0, 3)}
+        for name, knob in self.knobs.items():
+            try:
+                point[name] = knob.get()
+                self._tracer.counter('autotune_{}'.format(name), point[name],
+                                     'autotune')
+            except Exception:  # noqa: BLE001 - a dying getter must not kill it
+                point[name] = None
+        with self._lock:
+            self._trajectory.append(point)
+
+    def stats(self):
+        """Decision log + knob trajectory + current values (what rides in
+        ``stats['autotune']`` / ``diagnostics()['autotune']``)."""
+        knobs = {}
+        for name, knob in self.knobs.items():
+            try:
+                knobs[name] = knob.get()
+            except Exception:  # noqa: BLE001
+                knobs[name] = None
+        with self._lock:
+            return {'ticks': self.ticks,
+                    'paused_ticks': self.paused_ticks,
+                    'reverts': self.reverts,
+                    'last_class': self.last_class,
+                    'knobs': knobs,
+                    'decisions': list(self._log),
+                    'trajectory': list(self._trajectory)}

@@ -488,6 +488,14 @@ class Watchdog(object):
     def alive(self):
         return self._thread.is_alive()
 
+    @property
+    def episode_active(self):
+        """True while a stall episode is in progress (detected and not yet
+        recovered). The autotuner (``autotune.py``) pauses on this — knob
+        changes mid-recovery would blur the diagnosis and can mask the
+        stall the watchdog is escalating."""
+        return self._episode is not None
+
     def _interval(self):
         if self._poll_interval_s is not None:
             return self._poll_interval_s
@@ -576,6 +584,7 @@ class Watchdog(object):
             return {'stalls_detected': self.stalls_detected,
                     'soft_recoveries': self.soft_recoveries,
                     'hard_stalls': self.hard_stalls,
+                    'episode_active': self.episode_active,
                     'last_stall': last.summary() if last is not None else None}
 
 

@@ -25,6 +25,7 @@ import queue
 import threading
 import time
 import warnings
+from collections import deque
 
 import numpy as np
 
@@ -597,6 +598,15 @@ class JaxLoader(object):
         a number (applies to every stage) or a dict mapping stage name
         (``'assemble'``, ``'dispatch'``, ``'consumer'``, ``'remote-recv'``,
         ``'worker-pool'``, ...) or ``'default'`` to seconds. Default 60s.
+    :param autotune: enable the adaptive pipeline autotuner
+        (``petastorm_tpu.autotune``): a control thread classifies the
+        dominant bottleneck each tick from the wait counters above and
+        retunes prefetch depth, the in-flight transfer window, arena
+        depth, the reader's live worker count, and the ventilation
+        watermark within bounded ranges. ``True`` for defaults, an
+        :class:`~petastorm_tpu.autotune.AutotuneConfig` for custom clamps
+        and pacing; ``None`` defers to ``PETASTORM_TPU_AUTOTUNE``. The
+        decision log and knob trajectory ride ``stats['autotune']``.
     """
 
     def __init__(self, reader, batch_size, mesh=None, sharding=None,
@@ -604,7 +614,7 @@ class JaxLoader(object):
                  shuffling_queue_capacity=0, min_after_dequeue=None, seed=None,
                  last_batch='drop', strict_fields=False, echo=1, tracer=None,
                  stage_chunks=1, arena_depth=None, inflight=2,
-                 watchdog=None, stall_timeout_s=None):
+                 watchdog=None, stall_timeout_s=None, autotune=None):
         import jax
 
         if tracer is None:
@@ -651,7 +661,26 @@ class JaxLoader(object):
         self._echo_left = 0
         self._echo_item = None
         self._consumer_staging = prefetch == 0
-        self._queue = queue.Queue(maxsize=max(1, prefetch))
+        # Inline-staging stage split (prefetch=0): the consumer runs the
+        # whole pipeline, so its blocked time alone can't say WHICH stage
+        # is slow — these bracket the reader pull vs the device dispatch
+        # for the autotuner's classification (and they are interesting
+        # stats in their own right).
+        self._inline_reader_s = 0.0
+        self._inline_dispatch_s = 0.0
+        # `prefetch` bounds staged-but-undelivered batches (device memory).
+        # The consumer's batched pop moves queued batches into its local
+        # buffer, so the bound is enforced over BOTH: the queue's live
+        # maxsize is always target - len(_ready) (floor 1) — a drained
+        # slot does NOT become capacity the dispatch thread may refill,
+        # or the ceiling would double.
+        self._prefetch_target = max(1, prefetch)
+        self._queue = queue.Queue(maxsize=self._prefetch_target)
+        # Consumer-local drain buffer: __next__ moves every already-staged
+        # batch here under one queue-mutex acquisition instead of paying a
+        # lock round trip per batch (the warm-cache chunk rate is queue-pop
+        # bound — PROFILE_r05 §2). Consumer thread only.
+        self._ready = deque()
         self._stop = threading.Event()
         self._exhausted = False
         # Pipeline health supervisor (petastorm_tpu.health): heartbeats on
@@ -667,8 +696,9 @@ class JaxLoader(object):
                 on_hard_stall=self._deliver_stall, tracer=self._tracer)
             self._hb_consumer = self._health.registry.register('consumer')
             self._health.registry.register_probe(
-                'consumer', lambda: {'queue_depth': self._queue.qsize(),
-                                     'queue_capacity': self._queue.maxsize,
+                'consumer', lambda: {'queue_depth': (self._queue.qsize()
+                                                     + len(self._ready)),
+                                     'queue_capacity': self._prefetch_target,
                                      'exhausted': self._exhausted})
             attach = getattr(reader, 'attach_health', None)
             if attach is not None:
@@ -709,6 +739,7 @@ class JaxLoader(object):
         self._thread = None       # kept for back-compat introspection
         self._engine = None
         self._arena_pool = None
+        self._metered_reader = None
         arena_buffers = None
         views_ok = True
         host_reader = reader
@@ -736,6 +767,7 @@ class JaxLoader(object):
             hb_assemble = (self._health.registry.register('assemble')
                            if self._health is not None else None)
             host_reader = MeteredReader(reader, meter, heartbeat=hb_assemble)
+            self._metered_reader = host_reader
             self._arena_pool = ArenaPool(arena_depth, stop_event=self._stop,
                                          tracer=self._tracer, meter=meter,
                                          heartbeat=hb_assemble)
@@ -773,6 +805,92 @@ class JaxLoader(object):
         # register, so its first classification sees the full beat table.
         if self._health is not None:
             self._health.start()
+
+        # Adaptive autotuning (petastorm_tpu.autotune): one controller for
+        # the whole pipeline — the loader's knobs (prefetch depth, in-flight
+        # transfer window, arena depth) merged with the reader tier's
+        # (worker-pool size, ventilation watermark), which the reader hands
+        # over via adopt_autotune (stopping any controller of its own).
+        from petastorm_tpu import autotune as autotune_mod
+        self._autotuner = None
+        if autotune_mod.autotune_enabled(autotune):
+            cfg = autotune_mod.resolve_config(autotune)
+            knobs = {}
+            if not self._consumer_staging:
+                knobs['prefetch'] = autotune_mod.Knob(
+                    'prefetch', lambda: self._prefetch_target,
+                    self.set_prefetch, lo=cfg.min_prefetch,
+                    hi=cfg.max_prefetch)
+                knobs['inflight'] = autotune_mod.Knob(
+                    'inflight', lambda: self._engine.inflight_window,
+                    self._engine.set_inflight, lo=cfg.min_inflight,
+                    hi=cfg.max_inflight)
+                knobs['arena_depth'] = autotune_mod.Knob(
+                    'arena_depth', lambda: self._arena_pool.depth,
+                    self._arena_pool.set_depth, lo=cfg.min_arena_depth,
+                    hi=cfg.max_arena_depth)
+            self._reader_telemetry = None
+            adopt = getattr(reader, 'adopt_autotune', None)
+            if adopt is not None:
+                reader_knobs, self._reader_telemetry = adopt(cfg)
+                knobs.update(reader_knobs)
+            if knobs:
+                watchdog_active = None
+                if self._health is not None:
+                    watchdog = self._health.watchdog
+                    watchdog_active = lambda: watchdog.episode_active  # noqa: E731
+                self._autotuner = autotune_mod.AutoTuner(
+                    telemetry_fn=self._autotune_telemetry, knobs=knobs,
+                    config=cfg, tracer=self._tracer,
+                    classify_fn=autotune_mod.classify_loader,
+                    watchdog_active_fn=watchdog_active).start()
+
+    # -- autotune hookups --------------------------------------------------
+
+    def set_prefetch(self, n):
+        """Retarget the staged-batch bound at runtime (autotune hookup).
+        Growing wakes a dispatch thread blocked on the bounded put;
+        shrinking takes effect as the consumer drains below the new cap
+        (no staged batch is dropped). The live queue capacity is the
+        target minus the consumer's drain buffer (see ``__init__``)."""
+        n = max(1, int(n))
+        staging_queue = self._queue
+        with staging_queue.mutex:
+            self._prefetch_target = n
+            staging_queue.maxsize = max(1, n - len(self._ready))
+            staging_queue.not_full.notify_all()
+
+    def _autotune_telemetry(self):
+        """Cumulative per-stage wait counters + queue gauges — the inputs
+        of :func:`petastorm_tpu.autotune.classify_loader`. Cheap enough
+        for a sub-second tick: attribute reads plus two small locks."""
+        out = {'batches': self._batches_delivered,
+               'wait_s': self._wait_s,
+               'queue_depth': self._queue.qsize() + len(self._ready),
+               'queue_capacity': self._prefetch_target}
+        if self._consumer_staging:
+            # Inline staging: the consumer's blocked time IS the pipeline
+            # running, so the stage split above supplies the per-stage
+            # signals — without them every slow tick would classify as
+            # input-bound and ratchet the worker pool to its clamp even
+            # when the device dispatch is the bottleneck.
+            out['reader_wait_s'] = self._inline_reader_s
+            out['ready_wait_s'] = self._inline_dispatch_s
+        if self._metered_reader is not None:
+            out['reader_wait_s'] = self._metered_reader.reader_wait_s
+        if self._arena_pool is not None:
+            out['arena_wait_s'] = self._arena_pool.wait_seconds
+        if self._engine is not None:
+            out['ready_wait_s'] = self._engine.ready_wait_seconds
+        if self._reader_telemetry is not None:
+            reader_tel = self._reader_telemetry()
+            # The reader tier reports its own delivery counter under
+            # 'batches' (its rate signal when tuned standalone); here the
+            # throughput guard must judge actions by DELIVERED loader
+            # batches, not upstream chunk pulls — keep ours.
+            reader_tel.pop('batches', None)
+            out.update(reader_tel)
+        return out
 
     # -- staging thread --------------------------------------------------
 
@@ -911,17 +1029,47 @@ class JaxLoader(object):
                 try:
                     if self._hb_consumer is not None:
                         self._hb_consumer.beat('reader-wait')
+                    t_inline = time.perf_counter()
                     host_batch = self._next_host_batch()
+                    t_staged = time.perf_counter()
+                    self._inline_reader_s += t_staged - t_inline
                     if self._hb_consumer is not None:
                         self._hb_consumer.beat('device_put')
                     item = self._stage(host_batch)
+                    self._inline_dispatch_s += time.perf_counter() - t_staged
                 except StopIteration:
                     item = _END
                 except Exception as e:  # noqa: BLE001 - match staged path
                     item = e
+            elif self._ready:
+                # Batched pop: a previous fetch drained the staging queue
+                # into this consumer-local buffer. Consuming one gives a
+                # capacity slot back to the dispatch thread (the drain
+                # below converted queue slots into buffer debt, not into
+                # refillable capacity).
+                item = self._ready.popleft()
+                staging_queue = self._queue
+                with staging_queue.mutex:
+                    staging_queue.maxsize = max(
+                        1, self._prefetch_target - len(self._ready))
+                    staging_queue.not_full.notify()
             else:
                 with self._tracer.span('wait', 'consumer'):
                     item = self._queue.get()
+                # Batched pop: move every staged batch into the local
+                # buffer under ONE mutex acquisition (vs one Queue.get
+                # lock round trip per batch — the warm-cache rate is
+                # queue-pop bound, PROFILE_r05 §2). The queue's live
+                # maxsize shrinks by the same count (no notify): drained
+                # slots must NOT become capacity the dispatch thread
+                # refills, or staged-but-undelivered device batches would
+                # reach ~2x the documented `prefetch` bound.
+                staging_queue = self._queue
+                with staging_queue.mutex:
+                    while staging_queue.queue:
+                        self._ready.append(staging_queue.queue.popleft())
+                    staging_queue.maxsize = max(
+                        1, self._prefetch_target - len(self._ready))
             if self._echo > 1 and isinstance(item, dict):
                 self._echo_item = item
                 self._echo_left = self._echo - 1
@@ -1010,6 +1158,8 @@ class JaxLoader(object):
         the steady-state window, not reader-pool spin-up."""
         self._batches_delivered = 0
         self._wait_s = 0.0
+        self._inline_reader_s = 0.0
+        self._inline_dispatch_s = 0.0
         self._first_get_t = None
         with self._stats_lock:
             self._stage_s = 0.0
@@ -1018,6 +1168,10 @@ class JaxLoader(object):
             self._engine.reset_stats()
         if self._arena_pool is not None:
             self._arena_pool.reset_stats()
+        if self._metered_reader is not None:
+            # Unlocked against the assembler's += (a concurrent pull could
+            # resurrect one pre-reset sample) — stats noise, not state.
+            self._metered_reader.reader_wait_s = 0.0
 
     @property
     def stats(self):
@@ -1048,6 +1202,11 @@ class JaxLoader(object):
             # (overlap_frac — the software-pipelining win), and time spent
             # fenced on the oldest in-flight transfer (ready_wait_s).
             out.update(self._engine.stats())
+        if self._metered_reader is not None:
+            # Seconds the assembler spent blocked pulling from the reader —
+            # the reader-starved signal (pairs with arena_wait_s /
+            # ready_wait_s to name the bottleneck stage).
+            out['reader_wait_s'] = round(self._metered_reader.reader_wait_s, 4)
         if self._arena_pool is not None:
             # Arena recycling health: after warmup ``arena_alloc`` should
             # stay flat (near-zero new allocations) with ``arena_reuse``
@@ -1063,6 +1222,11 @@ class JaxLoader(object):
             # the latest diagnosis (classification, stage, beat table,
             # probes — the stack dump stays on the error object).
             out['watchdog'] = self._health.stats()
+        if self._autotuner is not None:
+            # Feedback control: current knob values, the full decision log
+            # (grow/shrink/revert/pause with bottleneck classifications),
+            # and the knob trajectory over time.
+            out['autotune'] = self._autotuner.stats()
         return out
 
     def state_dict(self):
@@ -1086,13 +1250,18 @@ class JaxLoader(object):
         return self._reader.state_dict()
 
     def stop(self):
+        if self._autotuner is not None:
+            # First: a tuner firing mid-teardown would retune stages that
+            # are being joined.
+            self._autotuner.stop()
         if self._health is not None:
-            # First: a supervisor firing mid-teardown would misread the
+            # A supervisor firing mid-teardown would misread the
             # (deliberately) silent stages as a stall.
             self._health.stop()
         self._stop.set()
         self._exhausted = True
         # Drain so the staging threads' bounded puts can exit.
+        self._ready.clear()
         try:
             while True:
                 self._queue.get_nowait()

@@ -107,7 +107,8 @@ def make_reader(dataset_url,
                 pool_profiling=False,
                 error_budget=None,
                 watchdog=None,
-                stall_timeout_s=None):
+                stall_timeout_s=None,
+                autotune=None):
     """Reader for datasets materialized with petastorm_tpu codecs.
 
     Parity: reference ``petastorm/reader.py:50-174``. Rejects plain Parquet
@@ -127,6 +128,14 @@ def make_reader(dataset_url,
     ``Reader.diagnostics()['watchdog']``. ``watchdog=None`` defers to the
     ``PETASTORM_TPU_WATCHDOG`` environment variable. A ``JaxLoader``
     wrapping this reader supervises both with a single watchdog.
+
+    ``autotune`` arms the adaptive autotuner (``petastorm_tpu.autotune``):
+    a control thread grows/shrinks the live worker pool and manages the
+    ventilation watermark from the pipeline's own backpressure signals
+    (``True`` | :class:`~petastorm_tpu.autotune.AutotuneConfig`; ``None``
+    defers to ``PETASTORM_TPU_AUTOTUNE``). Decision log in
+    ``Reader.diagnostics()['autotune']``; a wrapping ``JaxLoader`` adopts
+    the knobs into its own controller.
     """
     store = ParquetStore(dataset_url, storage_options)
     try:
@@ -161,7 +170,8 @@ def make_reader(dataset_url,
                   cache=cache, transform_spec=transform_spec,
                   resume_state=resume_state,
                   error_budget=error_budget,
-                  watchdog=watchdog, stall_timeout_s=stall_timeout_s)
+                  watchdog=watchdog, stall_timeout_s=stall_timeout_s,
+                  autotune=autotune)
 
 
 def make_tensor_reader(dataset_url,
@@ -184,7 +194,8 @@ def make_tensor_reader(dataset_url,
                        shuffle_rows_in_chunk=False,
                        error_budget=None,
                        watchdog=None,
-                       stall_timeout_s=None):
+                       stall_timeout_s=None,
+                       autotune=None):
     """Decoded-columnar reader: the TPU hot path (no reference equivalent).
 
     Like :func:`make_reader` (codecs run, values are decoded) but columnar
@@ -263,7 +274,8 @@ def make_tensor_reader(dataset_url,
                   resume_state=resume_state,
                   shuffle_rows_in_chunk=shuffle_rows_in_chunk,
                   error_budget=error_budget,
-                  watchdog=watchdog, stall_timeout_s=stall_timeout_s)
+                  watchdog=watchdog, stall_timeout_s=stall_timeout_s,
+                  autotune=autotune)
 
 
 def make_batch_reader(dataset_url,
@@ -286,7 +298,8 @@ def make_batch_reader(dataset_url,
                       shuffle_rows_in_chunk=False,
                       error_budget=None,
                       watchdog=None,
-                      stall_timeout_s=None):
+                      stall_timeout_s=None,
+                      autotune=None):
     """Columnar batch reader for **any** Parquet store (no codecs needed).
 
     Parity: reference ``petastorm/reader.py:177-289``. Warns when pointed at a
@@ -327,7 +340,8 @@ def make_batch_reader(dataset_url,
                   resume_state=resume_state,
                   shuffle_rows_in_chunk=shuffle_rows_in_chunk,
                   error_budget=error_budget,
-                  watchdog=watchdog, stall_timeout_s=stall_timeout_s)
+                  watchdog=watchdog, stall_timeout_s=stall_timeout_s,
+                  autotune=autotune)
 
 
 class _CallableDict(dict):
@@ -451,7 +465,7 @@ class Reader(object):
                  num_epochs=1, cur_shard=None, shard_count=None,
                  cache=None, transform_spec=None, ngram=None, resume_state=None,
                  shuffle_rows_in_chunk=False, error_budget=None,
-                 watchdog=None, stall_timeout_s=None):
+                 watchdog=None, stall_timeout_s=None, autotune=None):
         self._store = store
         self.stored_schema = stored_schema
         self.ngram = ngram
@@ -601,6 +615,103 @@ class Reader(object):
             self.attach_health(self._health.registry)
             self._health.start()
 
+        # --- adaptive autotuning (petastorm_tpu.autotune) -------------------
+        # A standalone reader owns its controller; a wrapping JaxLoader
+        # calls adopt_autotune() instead so ONE controller (which also sees
+        # the staging-side telemetry) tunes the whole pipeline.
+        from petastorm_tpu import autotune as autotune_mod
+        self._rows_delivered = 0
+        self._autotuner = None
+        if autotune_mod.autotune_enabled(autotune):
+            from petastorm_tpu.trace import get_global_tracer
+            cfg = autotune_mod.resolve_config(autotune)
+            knobs = self._autotune_knobs(cfg)
+            if knobs:   # nothing tunable (e.g. dummy pool): stay off
+                self._autotuner = autotune_mod.AutoTuner(
+                    telemetry_fn=self._autotune_telemetry, knobs=knobs,
+                    config=cfg, tracer=get_global_tracer(),
+                    classify_fn=autotune_mod.classify_reader,
+                    watchdog_active_fn=self._watchdog_episode_active).start()
+
+    def _watchdog_episode_active(self):
+        return (self._health is not None
+                and self._health.watchdog.episode_active)
+
+    def _autotune_knobs(self, cfg):
+        """The reader tier's tunable knobs: live worker-pool size (the
+        ventilation cap tracks it) and the ventilator's results-queue
+        watermark. Pools without ``resize`` (process/dummy) expose
+        nothing."""
+        from petastorm_tpu.autotune import Knob
+        pool = self._workers_pool
+        knobs = {}
+        if hasattr(pool, 'resize'):
+            ventilator = self._ventilator
+
+            def set_workers(n):
+                # Re-fair-share the native decode threads for workers
+                # spawned from now on: the per-worker allotment computed at
+                # construction assumed the construction-time pool size, and
+                # growing e.g. 2 -> 16 workers each carrying cores//2
+                # native threads would oversubscribe the host. (Already-
+                # running workers keep their allotment — a live C++ pool
+                # can't be rethreaded — so the correction lands as the pool
+                # churns.)
+                worker_args = getattr(pool, '_worker_args', None)
+                if isinstance(worker_args, dict) \
+                        and 'decode_threads' in worker_args:
+                    worker_args['decode_threads'] = max(
+                        1, (os.cpu_count() or 4) // max(1, n))
+                pool.resize(n)
+                ventilator.set_max_in_flight(n + _VENTILATE_EXTRA_ROWGROUPS)
+
+            knobs['workers'] = Knob(
+                'workers', lambda: pool.workers_count, set_workers,
+                lo=cfg.min_workers, hi=cfg.max_workers)
+        if hasattr(pool, 'results_watermark'):
+            capacity = pool.results_capacity
+
+            def get_watermark():
+                watermark = pool.results_watermark
+                return watermark if watermark is not None else capacity
+
+            def set_watermark(n):
+                # Full capacity means "unarmed": restore the genuine None
+                # so the ventilator returns to plain bursty feeding — an
+                # armed-at-capacity integer can never trip, but it would
+                # keep paced feeding on for the life of the reader.
+                n = int(n)
+                pool.results_watermark = None if n >= capacity else n
+
+            knobs['results_watermark'] = Knob(
+                'results_watermark', get_watermark, set_watermark,
+                lo=cfg.min_watermark, hi=capacity)
+        return knobs
+
+    def _autotune_telemetry(self):
+        """Cumulative delivered-row count plus pool-queue gauges — the
+        inputs of :func:`petastorm_tpu.autotune.classify_reader`."""
+        pool = self._workers_pool
+        out = {'batches': self._rows_delivered}
+        qsize = getattr(pool, 'results_qsize', None)
+        if qsize is not None:
+            out['results_queue_depth'] = qsize
+            out['results_queue_capacity'] = getattr(pool, 'results_capacity', 1)
+        unprocessed = pool.diagnostics.get('ventilated_unprocessed')
+        if unprocessed is not None:
+            out['ventilated_unprocessed'] = unprocessed
+        return out
+
+    def adopt_autotune(self, cfg):
+        """A wrapping loader takes over tuning (one controller per
+        pipeline — mirrors :meth:`attach_health`): stops this reader's own
+        controller and hands back the reader-tier knobs + telemetry for
+        the loader's controller to merge."""
+        if self._autotuner is not None:
+            self._autotuner.stop()
+            self._autotuner = None
+        return self._autotune_knobs(cfg), self._autotune_telemetry
+
     def attach_health(self, registry):
         """Register this reader's stages into a
         :class:`~petastorm_tpu.health.HeartbeatRegistry` (called by a
@@ -715,6 +826,7 @@ class Reader(object):
         try:
             row = self._results_queue_reader.read_next(
                 self._workers_pool, self._transformed_schema, self.ngram)
+            self._rows_delivered += 1
             if hb is not None:
                 hb.beat('handoff')
             # A delivered row IS recovery: a hard stall diagnosed while we
@@ -830,6 +942,10 @@ class Reader(object):
         self._ventilator.reset()
 
     def stop(self):
+        if self._autotuner is not None:
+            # First: a tuner firing mid-teardown would resize a pool whose
+            # workers are being joined.
+            self._autotuner.stop()
         if self._health is not None:
             self._health.stop()
         self._workers_pool.stop()
@@ -852,6 +968,8 @@ class Reader(object):
             diag['watchdog'] = self._health.stats()
         elif self._health_registry is not None:
             diag['heartbeats'] = self._health_registry.beat_table()
+        if self._autotuner is not None:
+            diag['autotune'] = self._autotuner.stats()
         return diag
 
     def __enter__(self):

@@ -272,6 +272,36 @@ class ArenaPool(object):
         with self._cond:
             self._cond.notify_all()
 
+    def set_depth(self, depth):
+        """Retarget the pool depth at runtime (autotune hookup). Growing
+        wakes a backpressured assembler to allocate immediately; shrinking
+        lets excess arenas die on their next reclaim (``_reclaim`` drops
+        frees beyond ``depth``) — memory drains as the working set cycles,
+        with no arena yanked from under an in-flight transfer."""
+        depth = max(1, int(depth))
+        with self._cond:
+            if depth == self._depth:
+                return
+            self._depth = depth
+            while len(self._free) > depth:
+                self._free.pop()
+                self._allocated -= 1
+            self._cond.notify_all()
+
+    @property
+    def depth(self):
+        """Current pool depth (autotune knob getter — cheaper than a full
+        :meth:`stats` sample on a sub-second tick)."""
+        with self._cond:
+            return self._depth
+
+    @property
+    def wait_seconds(self):
+        """Cumulative assembler backpressure seconds (the autotuner's
+        arena-bound signal)."""
+        with self._cond:
+            return self._wait_s
+
     def stats(self):
         with self._cond:
             return {'arena_alloc': self._alloc,
@@ -383,6 +413,10 @@ class MeteredReader(object):
         self._pst_meter = meter
         self._pst_stage = stage
         self._pst_hb = heartbeat
+        # Cumulative seconds the assembler spent blocked in the reader —
+        # the autotuner's reader-starved signal (written by the assemble
+        # thread only; float rebinding is atomic for readers).
+        self.reader_wait_s = 0.0
 
     def __iter__(self):
         return self
@@ -395,10 +429,12 @@ class MeteredReader(object):
             # decode/IO tier produced nothing (reader-starved); 'collate'
             # = the batch-assembly work itself wedged (assemble-stuck).
             hb.beat('reader-wait')
+        t0 = time.perf_counter()
         try:
             with self._pst_meter.pause(self._pst_stage):
                 return next(self._pst_reader)
         finally:
+            self.reader_wait_s += time.perf_counter() - t0
             if hb is not None:
                 hb.beat('collate')
 
@@ -658,6 +694,25 @@ class StagingEngine(object):
                 self._retire(*inflight.popleft(), wait=False)
 
     # -- lifecycle / stats -------------------------------------------------
+
+    def set_inflight(self, n):
+        """Retarget the in-flight transfer window at runtime (autotune
+        hookup): the dispatch loop re-reads the window every batch, so a
+        widened window takes effect on the next dispatch and a narrowed
+        one drains by blocking on the oldest transfers."""
+        self._window = max(1, int(n))
+
+    @property
+    def inflight_window(self):
+        return self._window
+
+    @property
+    def ready_wait_seconds(self):
+        """Cumulative seconds the dispatch stage spent fenced on the
+        oldest in-flight transfer — the autotuner's dispatch-bound signal
+        (cheaper than a full :meth:`stats` sample on a sub-second tick)."""
+        with self._stats_lock:
+            return self._ready_wait_s
 
     def stop(self, join_timeout_s=10):
         """Idempotent: set stop, unblock both threads, join them, settle

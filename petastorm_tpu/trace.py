@@ -50,14 +50,20 @@ class Tracer(object):
                     'pid': 0, 'tid': threading.get_ident(),
                 })
 
-    def instant(self, name, cat='pipeline'):
-        """A zero-duration marker event."""
+    def instant(self, name, cat='pipeline', args=None):
+        """A zero-duration marker event. ``args`` (a JSON-safe dict)
+        renders in the trace viewer's detail pane — the autotuner attaches
+        each decision's knob changes so the timeline shows *what* changed
+        at the marker, not just that something did."""
+        event = {
+            'name': name, 'cat': cat, 'ph': 'i', 's': 't',
+            'ts': (time.perf_counter() - self._t0) * 1e6,
+            'pid': 0, 'tid': threading.get_ident(),
+        }
+        if args:
+            event['args'] = dict(args)
         with self._lock:
-            self._events.append({
-                'name': name, 'cat': cat, 'ph': 'i', 's': 't',
-                'ts': (time.perf_counter() - self._t0) * 1e6,
-                'pid': 0, 'tid': threading.get_ident(),
-            })
+            self._events.append(event)
 
     def counter(self, name, value, cat='pipeline'):
         """A counter-track sample (chrome trace 'C' event): renders as a
@@ -128,7 +134,7 @@ class NullTracer(object):
     def span(self, name, cat='pipeline'):
         return self._SPAN
 
-    def instant(self, name, cat='pipeline'):
+    def instant(self, name, cat='pipeline', args=None):
         pass
 
     def counter(self, name, value, cat='pipeline'):

@@ -7,6 +7,31 @@ reference's other utils live elsewhere here: ``decode_row`` ->
 """
 
 
+def drain_queue(bounded_queue, buffer, max_items):
+    """Move up to ``max_items`` ready items from a ``queue.Queue`` into a
+    consumer-local ``buffer`` (deque) under ONE mutex acquisition — the
+    batched-pop primitive behind the worker pool's result handoff
+    (``ThreadPool._pop_result``; a per-item ``Queue.get`` costs a lock
+    round trip each, and the warm-cache chunk rate is queue-pop bound,
+    PROFILE_r05 §2). The cap matters: every drained slot is capacity the
+    producers refill, so callers size it to bound how far undelivered
+    items may overshoot the queue's nominal depth. Producers blocked on
+    the bounded put are woken for the freed capacity. Returns the number
+    of items moved.
+
+    NOT used by the JaxLoader consumer: its drain must keep staged device
+    batches within the ``prefetch`` bound, so it shrinks the queue's live
+    ``maxsize`` by the drained count and skips the wakeup — see
+    ``JaxLoader.__next__``."""
+    with bounded_queue.mutex:
+        take = min(len(bounded_queue.queue), max_items)
+        for _ in range(take):
+            buffer.append(bounded_queue.queue.popleft())
+        if take > 0:
+            bounded_queue.not_full.notify_all()
+    return take
+
+
 def cached_namedtuple(cache, type_name, names):
     """Namedtuple type for ``names``, memoized in the caller's ``cache`` dict.
 

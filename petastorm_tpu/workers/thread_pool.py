@@ -11,7 +11,9 @@ results queue with stop-aware put (``:200-214``), end-of-data detection
 import pstats
 import queue
 import threading
+from collections import deque
 
+from petastorm_tpu.utils import drain_queue
 from petastorm_tpu.workers import (EmptyResultError, RowGroupQuarantined,
                                    TimeoutWaitingForResultError,
                                    VentilatedItemProcessedMessage,
@@ -48,6 +50,11 @@ class WorkerThread(threading.Thread):
         try:
             self._worker.initialize()
             while not self._pool._stop_event.is_set():
+                # Retire check sits BETWEEN items only: a worker that has
+                # already popped a ventilated item always processes it, so
+                # a shrinking resize() can never drop work on the floor.
+                if self._pool._should_retire(self):
+                    return
                 try:
                     args, kwargs = self._pool._ventilator_queue.get(
                         timeout=_VENTILATION_POLL_TIMEOUT_S)
@@ -77,10 +84,30 @@ class ThreadPool(object):
         self._ventilator_queue = queue.Queue()
         self._stop_event = threading.Event()
         self._workers = []
+        self._retired_workers = []
         self._ventilator = None
         self._profiling_enabled = profiling_enabled
         self._ventilated_unprocessed = 0
         self._count_lock = threading.Lock()
+        # Live-resize state (autotune.py): the target count may differ from
+        # len(_workers) while retire requests are pending.
+        self._resize_lock = threading.Lock()
+        self._retire_requests = 0
+        self._next_worker_id = workers_count
+        self._worker_class = None
+        self._worker_args = None
+        # Consumer-local drain buffer: get_results() moves every already-
+        # ready result here under ONE queue-mutex acquisition instead of
+        # paying a lock round trip per pop (the warm-cache chunk rate is
+        # queue-pop bound — PROFILE_r05 §2). Touched only by the consumer
+        # thread.
+        self._pending_results = deque()
+        #: Ventilator backpressure watermark: when set, the ventilator
+        #: stops feeding new row-groups while the results queue holds this
+        #: many items (bounding peak queue depth / decoded-block memory
+        #: instead of racing ahead of a slow consumer). ``None`` = off.
+        self.results_watermark = None
+        self._results_peak = 0
         #: Set by the Reader when ``error_budget`` is enabled; receives
         #: RowGroupQuarantined records (and raises when the budget is spent).
         self.quarantine_sink = None
@@ -94,15 +121,92 @@ class ThreadPool(object):
     def start(self, worker_class, worker_args=None, ventilator=None):
         if self._workers:
             raise RuntimeError('ThreadPool already started')
+        self._worker_class = worker_class
+        self._worker_args = worker_args
         for worker_id in range(self._workers_count):
-            worker = worker_class(worker_id, self._put_result, worker_args)
-            thread = WorkerThread(self, worker, self._profiling_enabled)
-            self._workers.append(thread)
-            thread.start()
+            self._spawn_worker(worker_id)
         self._ventilator = ventilator
         if ventilator is not None:
             ventilator._ventilate_fn = self.ventilate
+            if getattr(ventilator, 'backpressure_fn', None) is None:
+                ventilator.backpressure_fn = self._results_backpressure
             ventilator.start()
+
+    def _spawn_worker(self, worker_id):
+        worker = self._worker_class(worker_id, self._put_result,
+                                    self._worker_args)
+        thread = WorkerThread(self, worker, self._profiling_enabled)
+        with self._count_lock:
+            self._workers.append(thread)
+        thread.start()
+
+    def resize(self, n):
+        """Grow or shrink the live worker count to ``n`` (autotune hookup).
+
+        Growing spawns fresh workers immediately; shrinking posts retire
+        requests that workers honor **between** items — each request
+        retires exactly one worker, and a worker that already popped work
+        always finishes it first, so no ventilated item is ever lost or
+        double-processed. Returns the new target count."""
+        n = int(n)
+        if n < 1:
+            raise ValueError('workers_count must be >= 1, got {}'.format(n))
+        with self._resize_lock:
+            if self._worker_class is None:
+                raise RuntimeError('ThreadPool.resize() requires a started pool')
+            if self._stop_event.is_set():
+                return self._workers_count
+            with self._count_lock:
+                delta = n - self._workers_count
+                if delta == 0:
+                    return n
+                if delta < 0:
+                    self._retire_requests += -delta
+                    self._workers_count = n
+                    return n
+                # Growing: outstanding retire requests are cancelled first —
+                # resurrecting a not-yet-retired worker is cheaper than a
+                # retire/spawn churn pair.
+                cancelled = min(self._retire_requests, delta)
+                self._retire_requests -= cancelled
+                spawn = delta - cancelled
+                self._workers_count = n
+                worker_id = self._next_worker_id
+                self._next_worker_id += spawn
+            for i in range(spawn):
+                self._spawn_worker(worker_id + i)
+            return n
+
+    def _should_retire(self, thread):
+        """Exactly-once retire claim (called by worker threads between
+        items): consumes one pending retire request, moving the thread to
+        the retired list so join() still reaps it."""
+        if self._retire_requests <= 0:   # lock-free fast path: this check
+            return False                 # runs every ventilation poll
+        with self._count_lock:
+            if self._retire_requests <= 0:
+                return False
+            self._retire_requests -= 1
+            try:
+                self._workers.remove(thread)
+            except ValueError:  # pragma: no cover - stop/retire race
+                pass
+            self._retired_workers.append(thread)
+            return True
+
+    def _results_backpressure(self):
+        """Ventilator saturation signal. ``None`` while no watermark is set
+        (the signal is unarmed: the ventilator keeps its plain bursty
+        feeding); with a watermark, True while undelivered results sit
+        at/over it. Counts the consumer's drain buffer too — the bulk pop
+        moves the whole queue there, and a watermark blind to it would
+        release the moment the consumer took one result, while the full
+        backlog still sits in memory."""
+        watermark = self.results_watermark
+        if watermark is None:
+            return None
+        return (self._results_queue.qsize()
+                + len(self._pending_results)) >= watermark
 
     def ventilate(self, *args, **kwargs):
         with self._count_lock:
@@ -119,9 +223,15 @@ class ThreadPool(object):
                 raise _WorkerTerminationRequested()
             try:
                 self._results_queue.put(data, timeout=_RESULTS_POLL_TIMEOUT_S)
-                return
             except queue.Full:
                 continue
+            depth = (self._results_queue.qsize()
+                     + len(self._pending_results))
+            if depth > self._results_peak:   # racy double-check is fine: a
+                with self._count_lock:       # lost update costs one sample
+                    if depth > self._results_peak:
+                        self._results_peak = depth
+            return
 
     def inject_consumer_error(self, exc):
         """Watchdog delivery path: surface ``exc`` to a consumer parked in
@@ -133,11 +243,31 @@ class ThreadPool(object):
 
     _injected_error = None
 
+    def _pop_result(self):
+        """One result off the consumer-local drain buffer, refilled from
+        the results queue in bulk: a single mutex acquisition moves a
+        batch of already-ready items over (vs one lock round trip per
+        pop), and producers blocked on the bounded put wake immediately
+        for the freed capacity. The batch is capped at a quarter of the
+        queue's capacity: every drained slot is capacity the workers
+        refill, so an uncapped drain would let undelivered results reach
+        ~2x the configured queue bound — the cap keeps the overshoot
+        small while still amortizing the mutex. Raises ``queue.Empty`` on
+        a dry poll."""
+        if self._pending_results:
+            return self._pending_results.popleft()
+        result = self._results_queue.get(timeout=_RESULTS_POLL_TIMEOUT_S)
+        drain_queue(self._results_queue, self._pending_results,
+                    self._results_queue.maxsize // 4)
+        return result
+
     def get_results(self, timeout=None):
         import time
         deadline = time.monotonic() + timeout if timeout is not None else None
         while True:
-            if self._injected_error is not None and self._results_queue.empty():
+            if (self._injected_error is not None
+                    and not self._pending_results
+                    and self._results_queue.empty()):
                 # Still no results: the diagnosed stall stands. (With
                 # results available the pipeline recovered — deliver them
                 # and drop the stale injection below.)
@@ -146,7 +276,7 @@ class ThreadPool(object):
             if self.health_heartbeat is not None:
                 self.health_heartbeat.beat('poll')
             try:
-                result = self._results_queue.get(timeout=_RESULTS_POLL_TIMEOUT_S)
+                result = self._pop_result()
             except queue.Empty:
                 if self._all_done():
                     raise EmptyResultError()
@@ -189,7 +319,7 @@ class ThreadPool(object):
             return False
         with self._count_lock:
             nothing_in_flight = self._ventilated_unprocessed == 0
-        return (nothing_in_flight
+        return (nothing_in_flight and not self._pending_results
                 and self._results_queue.empty() and self._ventilator_queue.empty())
 
     def stop(self):
@@ -198,16 +328,25 @@ class ThreadPool(object):
         self._stop_event.set()
 
     def join(self):
-        for thread in self._workers:
+        # The resize lock orders this snapshot after any in-flight
+        # resize(): a grow that passed its stop check concurrently with
+        # stop()/join() finishes spawning first, so its workers are in the
+        # snapshot and get reaped — join() must never leave a thread
+        # running against a store the owner is about to close.
+        with self._resize_lock:
+            with self._count_lock:
+                threads = list(self._workers) + list(self._retired_workers)
+        for thread in threads:
             thread.join()
         if self._profiling_enabled:
             self._print_profiles()
         self._workers = []
+        self._retired_workers = []
 
     def _print_profiles(self):
         # A worker that never got ventilated work has an empty profile, which
         # pstats.Stats() rejects with TypeError — skip those.
-        profiles = [t.profile for t in self._workers
+        profiles = [t.profile for t in self._workers + self._retired_workers
                     if t.profile is not None and t.profile.getstats()]
         if not profiles:
             return
@@ -222,10 +361,21 @@ class ThreadPool(object):
 
     @property
     def diagnostics(self):
-        return {'output_queue_size': self._results_queue.qsize(),
+        with self._count_lock:
+            live = sum(1 for t in self._workers if t.is_alive())
+        return {'output_queue_size': (self._results_queue.qsize()
+                                      + len(self._pending_results)),
                 'ventilation_queue_size': self._ventilator_queue.qsize(),
-                'ventilated_unprocessed': self._ventilated_unprocessed}
+                'ventilated_unprocessed': self._ventilated_unprocessed,
+                'workers_count': self._workers_count,
+                'live_worker_threads': live,
+                'results_queue_peak': self._results_peak,
+                'results_watermark': self.results_watermark}
 
     @property
     def results_qsize(self):
-        return self._results_queue.qsize()
+        return self._results_queue.qsize() + len(self._pending_results)
+
+    @property
+    def results_capacity(self):
+        return self._results_queue.maxsize

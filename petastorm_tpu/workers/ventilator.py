@@ -43,7 +43,8 @@ class ConcurrentVentilator(Ventilator):
                  random_seed=None,
                  max_ventilation_queue_size=None,
                  ventilation_interval=0.01,
-                 inline=False):
+                 inline=False,
+                 backpressure_fn=None):
         """
         :param ventilate_fn: called with ``**item`` for each ventilated item.
         :param items_to_ventilate: list of dicts of kwargs.
@@ -52,6 +53,17 @@ class ConcurrentVentilator(Ventilator):
         :param random_seed: seed for reproducible shuffling (``None`` = os random).
         :param max_ventilation_queue_size: cap on unprocessed in-flight items;
             defaults to ``len(items_to_ventilate)``.
+        :param backpressure_fn: optional saturation signal ``() -> None |
+            bool``: ``None`` = unarmed (plain bursty feeding), ``True`` =
+            hold ventilation even below the in-flight cap, ``False`` =
+            armed but clear — feeding proceeds *paced* (one item per
+            ``ventilation_interval`` or per ``processed_item()`` ack), so
+            the signal gets to see each fed item's results land before the
+            next feed; an unpaced burst would fill the whole in-flight
+            window before any watermark could react. The worker pools wire
+            this to a results-queue watermark so a saturated downstream
+            stops new row-groups from being fed (bounding decoded-block
+            memory and tail latency). Assignable after construction.
         :param inline: no ventilation thread — the consumer drives
             ventilation by calling :meth:`pump` (synchronous pools). A
             ventilator thread next to an inline pool is pure overhead: on a
@@ -72,6 +84,7 @@ class ConcurrentVentilator(Ventilator):
                                             else len(self._items_to_ventilate))
         self._ventilation_interval = ventilation_interval
         self.inline = inline
+        self.backpressure_fn = backpressure_fn
 
         self._current_item_to_ventilate = 0
         self._in_flight = 0
@@ -115,6 +128,20 @@ class ConcurrentVentilator(Ventilator):
                 self._rng.shuffle(self._items_to_ventilate)
         return True
 
+    def _backpressured(self):
+        """Tri-state sample of the saturation signal: ``None`` = no signal
+        armed (no fn, fn says unarmed, or fn died), ``False`` = armed but
+        clear, ``True`` = hold ventilation. Armed-but-clear still matters:
+        it selects paced feeding (see ``_ventilate``)."""
+        fn = self.backpressure_fn
+        if fn is None:
+            return None
+        try:
+            value = fn()
+        except Exception:  # noqa: BLE001 - a dying probe must not stop feeding
+            return None
+        return None if value is None else bool(value)
+
     def pump(self):
         """Inline mode: ventilate items up to the backpressure cap from the
         CALLING thread. Returns the number of items ventilated."""
@@ -125,6 +152,8 @@ class ConcurrentVentilator(Ventilator):
             if self.heartbeat is not None:
                 self.heartbeat.beat('ventilating')
             if self._in_flight >= self._max_ventilation_queue_size:
+                break
+            if self._backpressured():
                 break
             if not self._advance_epoch():
                 break
@@ -144,7 +173,8 @@ class ConcurrentVentilator(Ventilator):
                 return
             with self._in_flight_lock:
                 below_cap = self._in_flight < self._max_ventilation_queue_size
-            if below_cap:
+            backpressure = self._backpressured() if below_cap else None
+            if below_cap and not backpressure:
                 if heartbeat is not None:
                     heartbeat.beat('ventilating')
                 item = self._items_to_ventilate[self._current_item_to_ventilate]
@@ -152,6 +182,17 @@ class ConcurrentVentilator(Ventilator):
                 with self._in_flight_lock:
                     self._in_flight += 1
                 self._ventilate_fn(**item)
+                if backpressure is not None:
+                    # Paced feeding while a saturation signal is ARMED
+                    # (even when currently clear): the just-fed item's
+                    # results haven't landed yet, so an unpaced loop would
+                    # fill the whole in-flight window before the signal
+                    # could react — a cap-sized result burst the watermark
+                    # exists to prevent. One item per interval, or per
+                    # consumer ack (processed_item() sets the wakeup),
+                    # whichever comes sooner.
+                    self._wakeup.clear()
+                    self._wakeup.wait(self._ventilation_interval)
             else:
                 if heartbeat is not None:
                     heartbeat.beat('backpressure')
@@ -161,6 +202,14 @@ class ConcurrentVentilator(Ventilator):
     def processed_item(self):
         with self._in_flight_lock:
             self._in_flight = max(0, self._in_flight - 1)
+        self._wakeup.set()
+
+    def set_max_in_flight(self, n):
+        """Retarget the in-flight cap at runtime (autotune hookup: the cap
+        tracks the resized worker count). A raised cap wakes a parked
+        feeder immediately; a lowered one simply stops new ventilation
+        until in-flight items drain below it."""
+        self._max_ventilation_queue_size = max(1, int(n))
         self._wakeup.set()
 
     def completed(self):

@@ -147,6 +147,11 @@ def pytest_configure(config):
         'before shipping.')
     config.addinivalue_line(
         'markers',
+        'autotune: adaptive-autotuner tests (tests/test_autotune.py) '
+        'driving the feedback controller, live pool resize, and '
+        'ventilator backpressure.')
+    config.addinivalue_line(
+        'markers',
         'timeout(seconds): per-test wall-clock budget override for the '
         'SIGALRM hang guard (see _per_test_timeout in conftest.py).')
 
@@ -202,6 +207,32 @@ def _per_test_timeout(request):
     finally:
         signal.setitimer(signal.ITIMER_REAL, 0)
         signal.signal(signal.SIGALRM, previous)
+
+
+# ---------------------------------------------------------------------------
+# Autotuner leak guard (extends PR 3's leaked-thread accounting): the control
+# thread must never outlive its reader/loader — a leaked tuner keeps resizing
+# a pool whose owner is gone. Runs on EVERY test (the tuner can be armed by
+# any factory knob or the PETASTORM_TPU_AUTOTUNE env), so a leak fails the
+# offending test in tier-1 rather than poisoning whichever test runs next.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _autotune_thread_guard():
+    import threading
+    import time as _time
+
+    yield
+    deadline = _time.monotonic() + 2.0
+    leaked = []
+    while _time.monotonic() < deadline:
+        leaked = [t.name for t in threading.enumerate()
+                  if t.is_alive() and t.name.startswith('pst-autotune')]
+        if not leaked:
+            return
+        _time.sleep(0.05)   # stop() joins with a timeout: allow it to land
+    pytest.fail('autotuner thread(s) leaked past reader/loader close: '
+                '{}'.format(leaked))
 
 
 TimeseriesSchema = Unischema('TimeseriesSchema', [

@@ -152,6 +152,29 @@ def _import_bench(monkeypatch):
     return importlib.import_module('bench')
 
 
+def test_bench_headline_metric_name_tracks_basis(monkeypatch):
+    """Headline hygiene (ADVICE r5 #5): the HBM-resident basis must carry a
+    DISTINCT metric name (``..._sustained``) plus a ``headline_config``
+    key, so cross-round diffs can never silently mix bases."""
+    bench = _import_bench(monkeypatch)
+
+    streamed = {'imagenet_img_per_sec_per_chip': 400.0, 'mfu': 0.02,
+                'input_stall_frac': 0.3, 'platform': 'axon'}
+    result = {}
+    bench._set_headline(result, streamed)
+    assert result['metric'] == 'imagenet_resnet50_img_per_sec_per_chip'
+    assert result['headline_config'] == 'streamed_from_host'
+
+    hbm = dict(streamed, imagenet_hbm_cached_img_per_sec_per_chip=2615.6,
+               hbm_cached_mfu=0.163, h2d_chunked_GBps=0.044)
+    result = {}
+    bench._set_headline(result, hbm)
+    assert result['metric'] == \
+        'imagenet_resnet50_img_per_sec_per_chip_sustained'
+    assert result['headline_config'] == 'hbm_resident'
+    assert result['value'] == 2615.6
+
+
 def test_bench_opportunistic_fold(tmp_path, monkeypatch, capsys):
     """The end-of-round fold of the best opportunistic TPU measurement
     (bench._fold_opportunistic_and_print): a recorded TPU best must become
