@@ -411,7 +411,113 @@ def _autotune_summary(stats):
             'trajectory': at.get('trajectory', [])[-40:]}
 
 
-def _child_pipeline(url, workers):
+def _rss_mb():
+    """Current resident-set size in MB (statm; peak-RSS fallback)."""
+    try:
+        with open('/proc/self/statm') as f:
+            pages = int(f.read().split()[1])
+        return round(pages * os.sysconf('SC_PAGE_SIZE') / 1e6, 1)
+    except (OSError, ValueError, IndexError):  # pragma: no cover - non-linux
+        import resource
+        maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss is KB on Linux but BYTES on macOS.
+        divisor = 1024.0 * 1024.0 if sys.platform == 'darwin' else 1024.0
+        return round(maxrss / divisor, 1)
+
+
+def _cache_tier_sweep(url, workers, batch, tiers):
+    """Warm-epoch img/s + RSS per cache tier (ISSUE 5): the number that
+    justifies the NVMe chunk-store tier is its warm rate staying near the
+    RAM tier's while RSS stays flat (views over shared page cache, not
+    per-process copies). ``null`` re-decodes every epoch (the cold floor),
+    ``memory`` is the RAM ceiling, ``chunk-store`` is mmap-served NVMe.
+    Fixed knobs (autotune off) so the tiers differ by exactly one thing."""
+    measure = int(os.environ.get('BENCH_PIPELINE_TIER_BATCHES', '16'))
+    warm = _IMAGENET_ROWS // batch + 2
+    out = {}
+    # A fleet-wide PETASTORM_TPU_CHUNK_STORE would silently arm the 'null'
+    # tier with a warm persistent store, corrupting the cold-floor row —
+    # the sweep builds its own store explicitly, so mask the env.
+    from petastorm_tpu import chunk_store as chunk_store_mod
+    saved_env = os.environ.pop(chunk_store_mod.ENV_VAR, None)
+    try:
+        _run_cache_tier_sweep(url, workers, batch, tiers, warm, measure, out)
+    finally:
+        if saved_env is not None:
+            os.environ[chunk_store_mod.ENV_VAR] = saved_env
+    return out
+
+
+def _run_cache_tier_sweep(url, workers, batch, tiers, warm, measure, out):
+    import shutil
+    import tempfile as tempfile_mod
+
+    import jax
+
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    for tier in [t.strip() for t in tiers if t.strip()]:
+        store_dir = None
+        kwargs = {'cache_type': tier}
+        if tier == 'chunk-store':
+            store_dir = tempfile_mod.mkdtemp(prefix='pst-chunk-store-bench-')
+            kwargs['cache_location'] = store_dir
+        try:
+            _measure_cache_tier(url, workers, batch, warm, measure,
+                                kwargs, out, tier)
+        except Exception as e:  # noqa: BLE001 - one bad tier (typo'd name)
+            # must not discard the whole child's already-measured results
+            out[tier] = {'error': '{}: {}'.format(type(e).__name__, e)}
+        finally:
+            if store_dir:
+                shutil.rmtree(store_dir, ignore_errors=True)
+    return out
+
+
+def _measure_cache_tier(url, workers, batch, warm, measure, kwargs, out, tier):
+    import jax
+
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    reader = make_tensor_reader(
+        url, schema_fields=['image', 'label'],
+        reader_pool_type='thread', workers_count=workers,
+        num_epochs=None, shuffle_row_groups=True, seed=0, **kwargs)
+    with reader:
+        with JaxLoader(reader, batch, prefetch=2, autotune=False) as loader:
+            it = iter(loader)
+            for _ in range(warm):
+                b = next(it)
+            jax.block_until_ready(b.image)
+            store = reader.chunk_store
+            flush_timed_out = False
+            if store is not None:
+                # The warm window must measure mmap serves, not a
+                # still-draining write-behind queue.
+                flush_timed_out = not store.flush()
+            t0 = time.perf_counter()
+            for _ in range(measure):
+                b = next(it)
+            jax.block_until_ready(b.image)
+            record = {
+                'img_per_sec': round(
+                    batch * measure / (time.perf_counter() - t0), 2),
+                'rss_mb': _rss_mb()}
+            if store is not None:
+                st = store.stats()
+                record['chunk_store'] = {
+                    k: st[k] for k in ('hits', 'misses', 'fills', 'writes',
+                                       'corrupt_quarantined')}
+                if flush_timed_out:
+                    # The window above mixed mmap serves with still-
+                    # draining write-behind IO: the number is suspect.
+                    record['flush_timed_out'] = True
+    out[tier] = record
+
+
+def _child_pipeline(url, workers, cache_tiers=None):
     """Loader-only pipeline capacity (VERDICT r4 #2): the same tensor reader +
     JaxLoader path as the imagenet child but with NO train step — measures how
     many img/s the input pipeline can produce when nothing consumes compute.
@@ -530,6 +636,12 @@ def _child_pipeline(url, workers):
     profile['wall_s'] = round(wall_s, 4)
     profile.update(_staging_counters(stats))
     profile.update(_robustness_counters(stats))
+    # Cache-tier sweep (ISSUE 5): --cache-tiers=null,memory,chunk-store on
+    # the child command line, or BENCH_PIPELINE_CACHE_TIERS in the env.
+    cache_tiers = cache_tiers or os.environ.get('BENCH_PIPELINE_CACHE_TIERS')
+    if cache_tiers:
+        profile['cache_tier_sweep'] = _cache_tier_sweep(
+            url, workers, batch, cache_tiers.split(','))
     out = {
         'pipeline_img_per_sec': round(median, 2),
         'pipeline_img_per_sec_reps': [round(r, 2) for r in rates],
@@ -1567,7 +1679,12 @@ def main():
         elif name == 'imagenet':
             _child_imagenet(sys.argv[3], int(sys.argv[4]))
         elif name == 'pipeline':
-            _child_pipeline(sys.argv[3], int(sys.argv[4]))
+            cache_tiers = None
+            for extra in sys.argv[5:]:
+                if extra.startswith('--cache-tiers='):
+                    cache_tiers = extra.split('=', 1)[1]
+            _child_pipeline(sys.argv[3], int(sys.argv[4]),
+                            cache_tiers=cache_tiers)
         elif name == 'flashattn':
             _child_flashattn()
         elif name == 'lm':

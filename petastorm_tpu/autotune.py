@@ -248,6 +248,29 @@ _SHRINK_STEPS = (('workers', 1), ('prefetch', 1), ('inflight', 1),
 _CUMULATIVE_KEYS = ('batches', 'wait_s', 'reader_wait_s', 'arena_wait_s',
                     'ready_wait_s')
 
+#: Classifications during which the NVMe chunk store's write-behind writer
+#: is throttled (PACED to one entry per ``throttle_delay_s``, never fully
+#: paused — fill epochs are naturally reader-starved, and a hard pause
+#: would keep the store cold forever): dispatch-bound (transfers already
+#: saturate the host's IO/DMA paths), reader-starved and input-bound
+#: (decode/pipeline work is the limit — epoch-0 spill must not steal CPU
+#: or NVMe bandwidth from it). Balanced/consumer-bound ticks restore full
+#: writer speed: the pipeline is ahead, spill is free.
+WRITER_THROTTLE_CLASSES = (DISPATCH_BOUND, READER_STARVED, INPUT_BOUND)
+
+
+def writer_throttle_listener(store):
+    """A classification listener (see :meth:`AutoTuner.add_listener`)
+    driving ``store.set_writer_throttled``: armed (paced spill) while the
+    tick's bottleneck class is in :data:`WRITER_THROTTLE_CLASSES`,
+    released otherwise. Wired automatically by ``Reader``/``JaxLoader``
+    when the pipeline carries a
+    :class:`~petastorm_tpu.chunk_store.DecodedChunkStore`.
+    """
+    def listener(label, detail=None):
+        store.set_writer_throttled(label in WRITER_THROTTLE_CLASSES)
+    return listener
+
 
 class AutoTuner(object):
     """Feedback control thread over a set of :class:`Knob`\\ s.
@@ -288,6 +311,7 @@ class AutoTuner(object):
         self._cooldown = 0
         self._pending = None      # last action awaiting its throughput verdict
         self._paused_streak = False
+        self._listeners = []
         self.ticks = 0
         self.paused_ticks = 0
         self.reverts = 0
@@ -307,6 +331,15 @@ class AutoTuner(object):
     @property
     def alive(self):
         return self._thread.is_alive()
+
+    def add_listener(self, fn):
+        """Register ``fn(label, detail)`` to run after every classified
+        tick (not while the watchdog pause holds). Listeners observe the
+        bottleneck class without being knobs — e.g. the chunk store's
+        write-behind throttle (:func:`writer_throttle_listener`). Must be
+        cheap; exceptions are logged and swallowed."""
+        self._listeners.append(fn)
+        return fn
 
     def _loop(self):
         while not self._stop.wait(self.config.interval_s):
@@ -359,6 +392,11 @@ class AutoTuner(object):
         rate = deltas.get('batches', 0) / dt
         label, detail = self._classify_fn(deltas, snap, dt, self.config)
         self.last_class = label
+        for listener in self._listeners:
+            try:
+                listener(label, detail)
+            except Exception:  # noqa: BLE001 - a listener must not kill the tuner
+                logger.exception('autotune classification listener failed')
 
         # Throughput guard first: the verdict on the previous action is due
         # once its cooldown expired (one settling window after the change).

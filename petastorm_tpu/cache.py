@@ -14,10 +14,13 @@ LRU eviction.
 import hashlib
 import os
 import pickle
+import sys
 import tempfile
 import threading
 
 import pyarrow as pa
+
+from petastorm_tpu.errors import CorruptChunkError
 
 
 class CacheBase(object):
@@ -50,7 +53,9 @@ class MemoryCache(CacheBase):
     Values are cached by reference (no serialization): callers must treat
     cached values as immutable. With process pools each worker process holds
     its own instance (no cross-process sharing) — prefer the thread pool
-    when using this cache, or ``local-disk`` for a shared tier.
+    when using this cache, or the mmap-backed ``chunk-store`` tier
+    (``petastorm_tpu.chunk_store``) for cross-process sharing of decoded
+    chunks on NVMe.
     """
 
     def __init__(self, size_limit_bytes=None):
@@ -68,11 +73,14 @@ class MemoryCache(CacheBase):
         if hasattr(value, 'nbytes'):
             return int(value.nbytes)
         if isinstance(value, dict):
-            return sum(MemoryCache._nbytes(v) for v in value.values())
+            # Keys count too: on wide schemas (hundreds of string keys per
+            # cached chunk dict) ignoring them systematically under-
+            # estimates the byte cap.
+            return sum(MemoryCache._nbytes(k) + MemoryCache._nbytes(v)
+                       for k, v in value.items())
         if isinstance(value, (list, tuple)):
             return sum(MemoryCache._nbytes(v) for v in value)
         try:
-            import sys
             return sys.getsizeof(value)
         except TypeError:  # pragma: no cover
             return 1024
@@ -163,9 +171,24 @@ class LocalDiskCache(CacheBase):
         return os.path.join(self._path, digest + self._SUFFIX)
 
     def _serialize(self, value):
+        # Decoded ndarray-dict values (the tensor hot path) take the
+        # chunk store's raw-buffer layout (header + np-format field dumps
+        # + CRC32s): a hit then parses a tiny JSON header and wraps the
+        # payload bytes zero-copy, where pickle paid a full deserialize
+        # copy per hit. Anything else (row dicts, scalars) still pickles.
+        from petastorm_tpu.chunk_store import (conforms_tensor_chunk,
+                                               pack_tensor_chunk)
+        if conforms_tensor_chunk(value):
+            return pack_tensor_chunk(value)
         return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
 
     def _deserialize(self, blob):
+        # Old caches hold pickle entries; the magic check keeps that read
+        # path alive (a raw-layout blob can never collide with it: pickle
+        # streams start with an opcode, not b'PSTC').
+        from petastorm_tpu.chunk_store import is_tensor_chunk, read_tensor_chunk
+        if is_tensor_chunk(blob):
+            return read_tensor_chunk(blob)
         return pickle.loads(blob)
 
     def get(self, key, fill_cache_func):
@@ -175,7 +198,8 @@ class LocalDiskCache(CacheBase):
                 blob = f.read()
             os.utime(target, None)  # LRU touch
             return self._deserialize(blob)
-        except (FileNotFoundError, EOFError, pickle.UnpicklingError):
+        except (FileNotFoundError, EOFError, pickle.UnpicklingError,
+                CorruptChunkError):
             pass
         value = fill_cache_func()
         blob = self._serialize(value)

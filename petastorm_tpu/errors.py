@@ -21,6 +21,11 @@ Exception hierarchy::
     │                                     did not clear it (carries the
     │                                     full diagnosis: classification,
     │                                     beat table, thread stacks)
+    ├── CorruptChunkError                 a decoded-chunk store entry (or
+    │                                     raw-layout disk-cache blob)
+    │                                     failed structural/checksum
+    │                                     validation; the entry is
+    │                                     quarantined and refilled
     └── PodAbortError                     a pod peer died/desynced; defined
                                           in ``parallel/pod_guard.py``
 
@@ -92,6 +97,15 @@ class PipelineStallError(PetastormTpuError):
     def __init__(self, message, diagnosis=None):
         super(PipelineStallError, self).__init__(message)
         self.diagnosis = diagnosis or {}
+
+
+class CorruptChunkError(PetastormTpuError):
+    """A persisted decoded chunk (``chunk_store.DecodedChunkStore`` entry
+    or ``LocalDiskCache`` raw-layout blob) failed magic/structure/CRC32
+    validation. Callers quarantine the bytes and refill by re-decode;
+    this error itself never crosses ``cache.get`` (a *refill* failure
+    surfaces as the decode error it is, flowing into the ``error_budget``
+    quarantine machinery)."""
 
 
 #: Failure classes a worker may *quarantine* (skip-and-record the row-group)

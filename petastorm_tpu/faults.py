@@ -24,6 +24,13 @@ Sites and their effects when they fire:
 ``device-put-delay`` sleep ``delay`` seconds in the loader's device staging
                      (simulates a hung ``device_put`` for the watchdog's
                      dispatch-hung classification, ``health.py``)
+``store-read-corrupt`` make the decoded-chunk store (``chunk_store.py``)
+                     treat the entry read as a checksum failure: the entry
+                     is quarantined and transparently refilled by
+                     re-decode. The store consumes this site via
+                     ``should_fire`` (keyed by the chunk cache key) so the
+                     effect is the store's own corruption path, not a
+                     generic raise; ``inject()`` elsewhere raises IOError.
 ==================== ======================================================
 
 Params (all optional):

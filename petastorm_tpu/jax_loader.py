@@ -466,6 +466,12 @@ def _iter_block_batches(reader, batch_size, shape_policies, last_batch, x64,
             all_copied = all_copied and arr is not source
         # densify/sanitize copies (dtype conversion, ragged stack) make the
         # blocks private even when the reader's came out of a cache.
+        if not all_copied and not private:
+            # Cache-shared views may be chunk-store mmaps: hint the kernel
+            # to fault their extents in now, while earlier batches collate,
+            # instead of paying major faults inside the copy loop below.
+            from petastorm_tpu.staging import willneed_arrays
+            willneed_arrays(chunk.values())
         chunks.append([chunk, private or all_copied])
         have += len(chunk[field_names[0]]) if field_names else 0
         while have >= batch_size:
@@ -844,6 +850,14 @@ class JaxLoader(object):
                     config=cfg, tracer=self._tracer,
                     classify_fn=autotune_mod.classify_loader,
                     watchdog_active_fn=watchdog_active).start()
+                store = getattr(reader, 'chunk_store', None)
+                if store is not None:
+                    # Epoch-0 spill throttling (the reader's own controller
+                    # was stopped by adopt_autotune above): pause the NVMe
+                    # write-behind whenever the pipeline itself is the
+                    # classified bottleneck.
+                    self._autotuner.add_listener(
+                        autotune_mod.writer_throttle_listener(store))
 
     # -- autotune hookups --------------------------------------------------
 
@@ -953,7 +967,12 @@ class JaxLoader(object):
                     # device_put branch.
                     try:
                         out[name] = jax.dlpack.from_dlpack(array)
-                    except (TypeError, BufferError, RuntimeError):
+                    except BufferError:
+                        # This buffer is unexportable (e.g. read-only):
+                        # fall back for THIS array only — one such batch
+                        # must not disable zero-copy for the whole run.
+                        out[name] = jax.device_put(array)
+                    except (TypeError, RuntimeError):
                         self._dlpack_staging = False
                         out[name] = jax.device_put(array)
                 else:
@@ -1212,6 +1231,12 @@ class JaxLoader(object):
             # stay flat (near-zero new allocations) with ``arena_reuse``
             # climbing; ``arena_wait_s`` is assembler backpressure.
             out.update(self._arena_pool.stats())
+        store = getattr(self._reader, 'chunk_store', None)
+        if store is not None:
+            # NVMe decoded-chunk tier health: hits/misses/fills say whether
+            # epoch-N decode is actually dead; write-behind counters
+            # (writes, skipped, throttled) cover the epoch-0 spill.
+            out['chunk_store'] = store.stats()
         worker_timings = getattr(self._reader, 'stage_timings', None)
         if worker_timings:
             out['worker_stage_timings'] = {

@@ -72,8 +72,20 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size, arrow_payloa
 
 
 def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate,
-                arrow_cache=False, **extra):
-    if cache_type in (None, 'null'):
+                arrow_cache=False, tensor_path=False, **extra):
+    if cache_type is None:
+        # Tensor-path readers adopt the NVMe decoded-chunk store from the
+        # environment alone (mirrors PETASTORM_TPU_WATCHDOG/_AUTOTUNE):
+        # pointing PETASTORM_TPU_CHUNK_STORE at a directory kills epoch-N
+        # decode fleet-wide without a code change. Only the DEFAULT is
+        # env-armed — an explicit ``cache_type='null'`` below stays a
+        # genuine no-cache (cold-path measurements need an opt-out).
+        from petastorm_tpu import chunk_store
+        if tensor_path and os.environ.get(chunk_store.ENV_VAR):
+            return chunk_store.DecodedChunkStore(size_limit=cache_size_limit,
+                                                 **extra)
+        return NullCache()
+    if cache_type == 'null':
         return NullCache()
     if cache_type == 'local-disk':
         if cache_location is None:
@@ -84,6 +96,18 @@ def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_est
     if cache_type == 'memory':
         from petastorm_tpu.cache import MemoryCache
         return MemoryCache(size_limit_bytes=cache_size_limit)
+    if cache_type == 'chunk-store':
+        if not tensor_path:
+            # Row/batch workers cache row lists / arrow tables — nothing
+            # the store can mmap back. Accepting the knob here would be a
+            # silent permanent no-op (every get() an unstorable miss).
+            raise ValueError(
+                "cache_type='chunk-store' serves decoded tensor chunks: use "
+                "make_tensor_reader (make_reader/make_batch_reader values "
+                "cannot be stored; use 'local-disk' there)")
+        from petastorm_tpu.chunk_store import DecodedChunkStore
+        return DecodedChunkStore(path=cache_location,
+                                 size_limit=cache_size_limit, **extra)
     raise ValueError('Unknown cache_type {!r}'.format(cache_type))
 
 
@@ -184,7 +208,7 @@ def make_tensor_reader(dataset_url,
                        rowgroup_selector=None,
                        num_epochs=1,
                        cur_shard=None, shard_count=None,
-                       cache_type='null', cache_location=None, cache_size_limit=None,
+                       cache_type=None, cache_location=None, cache_size_limit=None,
                        cache_row_size_estimate=None, cache_extra_settings=None,
                        transform_spec=None,
                        storage_options=None,
@@ -210,6 +234,13 @@ def make_tensor_reader(dataset_url,
     fully static shape; predicates may only use scalar fields; no NGram.
     ``cache_type='memory'`` caches *decoded* chunks in RAM — steady-state
     epochs then skip parquet read + decode entirely.
+    ``cache_type='chunk-store'`` spills decoded chunks to local NVMe in
+    the staging-arena layout and mmaps them back from epoch 1 on
+    (:mod:`petastorm_tpu.chunk_store`): cross-process, epoch-persistent,
+    and sized by disk, not RAM — for datasets bigger than memory. The
+    ``PETASTORM_TPU_CHUNK_STORE`` env var (a directory path) arms it
+    without a code change when ``cache_type`` is left at its default;
+    an explicit ``cache_type='null'`` stays a genuine no-cache.
 
     TransformSpec semantics differ: ``func`` receives a dict of column
     blocks (numpy in/numpy out), the vectorized analog of the reference's
@@ -257,6 +288,7 @@ def make_tensor_reader(dataset_url,
 
     cache = _make_cache(cache_type, cache_location, cache_size_limit,
                         cache_row_size_estimate, arrow_cache=False,
+                        tensor_path=True,
                         **(cache_extra_settings or {}))
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
                       shm_result_ring_bytes=shm_result_ring_bytes,
@@ -539,13 +571,14 @@ class Reader(object):
         if hasattr(results_queue_reader, 'set_tracker'):
             results_queue_reader.set_tracker(self._tracker)
 
+        self._cache = cache if cache is not None else NullCache()
         worker_args = {
             'store_factory': _StoreFactory(store.url, store.storage_options),
             'schema': self.schema,
             'full_schema': stored_schema,
             'ngram': ngram,
             'row_groups': self._row_groups,
-            'cache': cache or NullCache(),
+            'cache': self._cache,
             'transform_spec': transform_spec,
             'transformed_schema': self._transformed_schema,
             'partition_names': store.partition_names,
@@ -583,6 +616,26 @@ class Reader(object):
             # Synchronous pools (dummy) drive ventilation from the consumer
             # thread; a feeder thread there is only GIL contention.
             inline=getattr(self._workers_pool, 'inline_ventilation', False))
+        # NVMe chunk-store readahead rides the ventilator's dispatch order:
+        # the moment a row-group item is scheduled (workers_count + 2 items
+        # ahead of the workers), madvise(WILLNEED) its store extents so the
+        # pages are resident by the time the worker's hit copies toward an
+        # arena. Predicate reads bypass the cache entirely, so no wiring.
+        store_readahead = getattr(self._cache, 'readahead', None)
+        if store_readahead is not None and worker_predicate is None:
+            from petastorm_tpu.chunk_store import tensor_chunk_key
+            readahead_keys = [
+                tensor_chunk_key(worker_args['dataset_path_hash'],
+                                 p.path, p.row_group, self.schema)
+                for p in self._row_groups]
+
+            def on_ventilate(item):
+                try:
+                    store_readahead(readahead_keys[item['piece_index']])
+                except Exception:  # noqa: BLE001 - advisory only
+                    logger.debug('chunk store readahead failed', exc_info=True)
+
+            self._ventilator.on_ventilate = on_ventilate
         self._workers_pool.start(worker_class, worker_args, ventilator=self._ventilator)
 
         # --- pipeline health supervision (petastorm_tpu.health) ------------
@@ -632,6 +685,12 @@ class Reader(object):
                     config=cfg, tracer=get_global_tracer(),
                     classify_fn=autotune_mod.classify_reader,
                     watchdog_active_fn=self._watchdog_episode_active).start()
+                if self.chunk_store is not None:
+                    # Epoch-0 spill throttling: pause the store's write-
+                    # behind writer whenever the tuner classifies the
+                    # pipeline itself as the bottleneck.
+                    self._autotuner.add_listener(
+                        autotune_mod.writer_throttle_listener(self.chunk_store))
 
     def _watchdog_episode_active(self):
         return (self._health is not None
@@ -904,6 +963,16 @@ class Reader(object):
                             False))
 
     @property
+    def chunk_store(self):
+        """The reader's :class:`~petastorm_tpu.chunk_store.DecodedChunkStore`
+        when ``cache_type='chunk-store'`` (or the env var) armed one, else
+        ``None``. A wrapping ``JaxLoader`` uses this to surface
+        ``stats['chunk_store']`` and to wire the autotuner's writer
+        throttle."""
+        return (self._cache
+                if getattr(self._cache, 'is_chunk_store', False) else None)
+
+    @property
     def transformed_schema(self):
         """The schema of yielded rows (after any TransformSpec)."""
         return self._transformed_schema
@@ -949,6 +1018,10 @@ class Reader(object):
         if self._health is not None:
             self._health.stop()
         self._workers_pool.stop()
+        if self.chunk_store is not None:
+            # Drain + stop the write-behind thread (don't leave a daemon
+            # writer spilling into a store the caller may be deleting).
+            self.chunk_store.close()
         self._stopped = True
 
     def join(self):
@@ -961,6 +1034,11 @@ class Reader(object):
         (``reader.diagnostics['x']``) and called
         (``reader.diagnostics()['quarantined_rowgroups']``)."""
         diag = _CallableDict(self._workers_pool.diagnostics)
+        if self.chunk_store is not None:
+            # Thread pools share the store object, so these counters cover
+            # the pipeline; process-pool workers count in their own copies
+            # (the entry FILES are still shared via the filesystem).
+            diag['chunk_store'] = self.chunk_store.stats()
         diag['quarantined_rowgroups'] = self._quarantine_log.snapshot()
         diag['error_budget'] = (self._quarantine_log.budget
                                 if self._quarantine_log.enabled else None)

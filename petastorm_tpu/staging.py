@@ -89,6 +89,40 @@ def staging_aliases_host(jax):
     return _alias_probe_memo[backend]
 
 
+def willneed_arrays(arrays, _mmap=None):
+    """madvise(WILLNEED) the mmaps backing any mmap-based arrays.
+
+    The NVMe chunk store (``petastorm_tpu.chunk_store``) serves decoded
+    chunks as numpy views over a read-only mmap; the arena fill then
+    copies mmap -> arena (``np.copyto``), and on a cold page cache every
+    copied cache line is a blocking major fault inside the assemble
+    thread. Hinting the whole backing mapping when the chunk *arrives*
+    (one syscall per chunk) lets the kernel read the extents ahead while
+    earlier batches collate. Non-mmap arrays walk a short ``.base`` chain
+    and fall out — the call is safe (and near-free) on every chunk.
+    Returns the number of distinct mappings hinted."""
+    import mmap as mmap_mod
+    if _mmap is None:
+        _mmap = mmap_mod
+    if not hasattr(_mmap.mmap, 'madvise'):  # pragma: no cover - py<3.8/win
+        return 0
+    hinted, seen = 0, set()
+    for arr in arrays:
+        base = arr
+        while isinstance(base, np.ndarray) and base.base is not None:
+            base = base.base
+        if isinstance(base, memoryview):
+            base = base.obj
+        if isinstance(base, _mmap.mmap) and id(base) not in seen:
+            seen.add(id(base))
+            try:
+                base.madvise(_mmap.MADV_WILLNEED)
+                hinted += 1
+            except (OSError, ValueError):  # pragma: no cover - advisory only
+                continue
+    return hinted
+
+
 class HostArena(object):
     """One batch's worth of recyclable per-field host buffers."""
 

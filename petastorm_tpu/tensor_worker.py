@@ -98,10 +98,13 @@ class TensorWorker(RowGroupWorkerBase):
         # shuffle below both copy, flipping the chunk back to private.
         private = not cached
         if worker_predicate is None:
-            import hashlib
-            cache_key = 'tensor:{}:{}:{}:{}'.format(
-                self.args['dataset_path_hash'], piece.path, piece.row_group,
-                hashlib.md5(','.join(sorted(schema.fields)).encode()).hexdigest()[:8])
+            # Shared key builder (chunk_store.tensor_chunk_key): the NVMe
+            # store lookup happens here, AHEAD of decode — cache.get only
+            # runs load() (read + decode) on a store miss, and the reader's
+            # ventilation-order readahead computes the identical key.
+            from petastorm_tpu.chunk_store import tensor_chunk_key
+            cache_key = tensor_chunk_key(self.args['dataset_path_hash'],
+                                         piece.path, piece.row_group, schema)
             t0 = time.perf_counter()
             cols = self.args['cache'].get(cache_key, load)
             # Cache bookkeeping only: the miss's read/decode seconds are

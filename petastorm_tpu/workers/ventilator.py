@@ -99,6 +99,14 @@ class ConcurrentVentilator(Ventilator):
         #: the watchdog can prove the ventilation thread itself is alive
         #: (state 'ventilating' / 'backpressure' / 'idle' once done).
         self.heartbeat = None
+        #: Optional observer ``(item_dict) -> None`` called just before an
+        #: item is fed to the pool — i.e. in exact dispatch order,
+        #: ``max_ventilation_queue_size`` items ahead of the workers. The
+        #: reader wires the NVMe chunk store's madvise/WILLNEED readahead
+        #: here so the next scheduled row-group's extents are page-cache
+        #: resident before a worker touches them. Must be cheap and must
+        #: not raise (exceptions are swallowed: advice, not work).
+        self.on_ventilate = None
 
     def start(self):
         if self._started:
@@ -160,9 +168,18 @@ class ConcurrentVentilator(Ventilator):
             item = self._items_to_ventilate[self._current_item_to_ventilate]
             self._current_item_to_ventilate += 1
             self._in_flight += 1   # single-threaded: no lock needed
+            self._observe(item)
             self._ventilate_fn(**item)
             pumped += 1
         return pumped
+
+    def _observe(self, item):
+        observer = self.on_ventilate
+        if observer is not None:
+            try:
+                observer(item)
+            except Exception:  # noqa: BLE001 - advisory hook must not stop feeding
+                pass
 
     def _ventilate(self):
         while not self._stop_event.is_set():
@@ -181,6 +198,7 @@ class ConcurrentVentilator(Ventilator):
                 self._current_item_to_ventilate += 1
                 with self._in_flight_lock:
                     self._in_flight += 1
+                self._observe(item)
                 self._ventilate_fn(**item)
                 if backpressure is not None:
                     # Paced feeding while a saturation signal is ARMED

@@ -1,0 +1,112 @@
+"""Subprocess harness for cross-process DecodedChunkStore tests.
+
+Run as ``python chunk_store_race_worker.py <mode> <store_dir> <key> [arg]``:
+
+``fill``
+    Park until ``<store_dir>/GO`` exists (the test releases every racer at
+    once), then ``store.get(key, fill)`` — the single-writer test launches
+    two of these against the same key and asserts exactly one entry file
+    and exactly one combined write. Prints a JSON result line.
+
+``rewrite-loop``
+    For ``arg`` seconds: delete the entry and write it again through the
+    store's tmp-file + atomic-rename path — the adversarial writer for the
+    torn-read test.
+
+``read-loop``
+    For ``arg`` seconds: open a FRESH store each iteration (forcing the
+    full mmap + CRC validation) and read the key. Counts validated reads
+    and corruption observations; a torn chunk would surface as
+    ``corrupt_quarantined > 0``.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _cols():
+    # Deterministic: every process must produce (and expect) identical bytes.
+    rng = np.random.default_rng(7)
+    return {'a': rng.integers(0, 255, (64, 32), dtype=np.uint8),
+            'b': np.arange(64, dtype=np.int64)}
+
+
+def main():
+    from petastorm_tpu.chunk_store import DecodedChunkStore
+
+    mode, store_dir, key = sys.argv[1], sys.argv[2], sys.argv[3]
+    expected = _cols()
+
+    if mode == 'fill':
+        go = os.path.join(store_dir, 'GO')
+        deadline = time.monotonic() + 30
+        while not os.path.exists(go):
+            if time.monotonic() > deadline:
+                raise SystemExit('GO file never appeared')
+            time.sleep(0.001)
+        store = DecodedChunkStore(store_dir)
+        fills = []
+
+        def fill():
+            fills.append(1)
+            return _cols()
+
+        value = store.get(key, fill)
+        ok = all(np.array_equal(value[k], expected[k]) for k in expected)
+        store.flush()
+        stats = store.stats()
+        store.close()
+        print(json.dumps({'fills': len(fills), 'value_ok': bool(ok),
+                          'writes': stats['writes'],
+                          'write_races': stats['write_races']}))
+        return
+
+    if mode == 'rewrite-loop':
+        duration = float(sys.argv[4])
+        store = DecodedChunkStore(store_dir)
+        entry_path = store._entry_path(key)
+        deadline = time.monotonic() + duration
+        rewrites = 0
+        while time.monotonic() < deadline:
+            try:
+                os.unlink(entry_path)
+            except OSError:
+                pass
+            store._write_entry(key, _cols())
+            rewrites += 1
+        store.close()
+        print(json.dumps({'rewrites': rewrites}))
+        return
+
+    if mode == 'read-loop':
+        duration = float(sys.argv[4])
+        deadline = time.monotonic() + duration
+        validated = corrupt = absent = mismatched = 0
+        while time.monotonic() < deadline:
+            # A fresh store per iteration defeats the open-entry memo, so
+            # every read re-runs the full mmap + checksum validation.
+            store = DecodedChunkStore(store_dir)
+            sentinel = object()
+            value = store.get(key, lambda: None)
+            corrupt += store.stats()['corrupt_quarantined']
+            if value is None or value is sentinel:
+                absent += 1
+            else:
+                if all(np.array_equal(value[k], expected[k]) for k in expected):
+                    validated += 1
+                else:
+                    mismatched += 1
+            store.close()
+        print(json.dumps({'validated': validated, 'corrupt': corrupt,
+                          'absent': absent, 'mismatched': mismatched}))
+        return
+
+    raise SystemExit('unknown mode {!r}'.format(mode))
+
+
+if __name__ == '__main__':
+    main()

@@ -154,6 +154,11 @@ def pytest_configure(config):
         'markers',
         'timeout(seconds): per-test wall-clock budget override for the '
         'SIGALRM hang guard (see _per_test_timeout in conftest.py).')
+    config.addinivalue_line(
+        'markers',
+        'chunkstore: NVMe decoded-chunk-store tests '
+        '(tests/test_chunk_store.py); the conftest guard deletes any '
+        'leaked pst-chunk-store-* temp dirs after them.')
 
 
 # ---------------------------------------------------------------------------
@@ -233,6 +238,36 @@ def _autotune_thread_guard():
         _time.sleep(0.05)   # stop() joins with a timeout: allow it to land
     pytest.fail('autotuner thread(s) leaked past reader/loader close: '
                 '{}'.format(leaked))
+
+
+# ---------------------------------------------------------------------------
+# Chunk-store temp-dir guard: stores created without an explicit location
+# (env-armed readers, bench sweeps) land under tempfile.gettempdir() with the
+# pst-chunk-store- prefix; a test that dies mid-write must not leave GBs of
+# decoded chunks on the CI host's NVMe. Scoped to `chunkstore`-marked tests —
+# only they create prefix-named stores, and a global sweep could race another
+# test's live store.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _chunk_store_dir_guard(request):
+    if request.node.get_closest_marker('chunkstore') is None:
+        yield
+        return
+    import glob
+    import shutil
+    import tempfile
+
+    from petastorm_tpu.chunk_store import TEMP_DIR_PREFIX
+    pattern = os.path.join(tempfile.gettempdir(), TEMP_DIR_PREFIX + '*')
+    # Snapshot-diff, not a blanket sweep: the tempdir is host-shared, and
+    # deleting a store another process (xdist shard, live bench sweep)
+    # holds open would corrupt IT mid-run. Only dirs that appeared during
+    # this test are this test's leaks.
+    before = set(glob.glob(pattern))
+    yield
+    for leaked in set(glob.glob(pattern)) - before:
+        shutil.rmtree(leaked, ignore_errors=True)
 
 
 TimeseriesSchema = Unischema('TimeseriesSchema', [
