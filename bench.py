@@ -383,6 +383,19 @@ def _robustness_counters(stats):
     }
 
 
+def _metrics_snapshot():
+    """Full metrics-registry snapshot (petastorm_tpu.metrics) for a stage
+    profile: BENCH_r0N files then carry every registered counter —
+    staging, autotune, watchdog, chunk store, retries/respawns — not the
+    hand-picked subsets above, so a new instrument shows up in bench
+    diffs with zero bench changes. JSON-safe by the collect() contract."""
+    try:
+        from petastorm_tpu import metrics
+        return metrics.get_registry().collect()
+    except Exception as e:  # noqa: BLE001 - telemetry must not sink a bench
+        return {'error': repr(e)}
+
+
 def _staging_counters(stats):
     """Staging-engine health for a stage profile (ISSUE 2): per-stage busy
     seconds, assemble/dispatch co-activity (``overlap_frac`` — 0.0 was the
@@ -636,6 +649,7 @@ def _child_pipeline(url, workers, cache_tiers=None):
     profile['wall_s'] = round(wall_s, 4)
     profile.update(_staging_counters(stats))
     profile.update(_robustness_counters(stats))
+    profile['metrics'] = _metrics_snapshot()
     # Cache-tier sweep (ISSUE 5): --cache-tiers=null,memory,chunk-store on
     # the child command line, or BENCH_PIPELINE_CACHE_TIERS in the env.
     cache_tiers = cache_tiers or os.environ.get('BENCH_PIPELINE_CACHE_TIERS')
@@ -1112,6 +1126,7 @@ def _child_imagenet(url, workers):
     stage_profile['wall_s'] = round(elapsed, 4)
     stage_profile.update(_staging_counters(stats))
     stage_profile.update(_robustness_counters(stats))
+    stage_profile['metrics'] = _metrics_snapshot()
     train_steps = measure_iters * scan_k
     rate = superbatch * measure_iters / elapsed
     # MFU (VERDICT r3 #2): model FLOPs actually retired / chip peak. Uses

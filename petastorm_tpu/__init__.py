@@ -20,8 +20,12 @@ from petastorm_tpu.data_service import (DataServer, RemoteReader,  # noqa: F401
 from petastorm_tpu.device_cache import DeviceDatasetCache  # noqa: F401
 from petastorm_tpu.errors import (PipelineStallError,  # noqa: F401
                                   RowGroupQuarantinedError, WorkerLostError)
+from petastorm_tpu.flight_recorder import FlightRecorder  # noqa: F401
 from petastorm_tpu.job_checkpoint import JobCheckpointer  # noqa: F401
+from petastorm_tpu.metrics import (MetricsExporter,  # noqa: F401
+                                   MetricsRegistry, start_http_exporter)
 from petastorm_tpu.reader import (Reader, make_batch_reader,  # noqa: F401
                                   make_reader, make_tensor_reader)
+from petastorm_tpu.trace import Tracer  # noqa: F401
 from petastorm_tpu.transform import TransformSpec  # noqa: F401
 from petastorm_tpu.unischema import Unischema, UnischemaField  # noqa: F401

@@ -29,10 +29,17 @@ class ArrowWorker(RowGroupWorkerBase):
     def process(self, piece_index, worker_predicate=None, shuffle_row_drop_partition=None):
         from petastorm_tpu.faults import maybe_inject, rowgroup_fault_key
 
+        from petastorm_tpu.trace import get_global_tracer
+
         piece = self.args['row_groups'][piece_index]
         maybe_inject('decode-corrupt',
                      key=rowgroup_fault_key(piece.path, piece.row_group))
-        table = self._load_table_cached(piece, worker_predicate)
+        # Arrow mode ships raw cells, so its 'decode' span covers the
+        # columnar table prep (the read span nests inside it) — the same
+        # three-span vocabulary as the dict/tensor workers on a merged
+        # timeline even though codecs don't run here.
+        with get_global_tracer().span('decode', 'worker'):
+            table = self._load_table_cached(piece, worker_predicate)
         if table is None or table.num_rows == 0:
             return
 
@@ -62,7 +69,8 @@ class ArrowWorker(RowGroupWorkerBase):
             # IPC serializer) for checkpoint/resume consumption tracking.
             md = dict(table.schema.metadata or {})
             md[b'pst.key'] = chunk_key(piece_index, shuffle_row_drop_partition).encode()
-            self.publish_func(table.replace_schema_metadata(md))
+            with get_global_tracer().span('handoff', 'worker'):
+                self.publish_func(table.replace_schema_metadata(md))
 
     def _apply_transform(self, table, transform_spec):
         """Pandas-based batch transform (parity: ``arrow_reader_worker.py:163-178``)."""

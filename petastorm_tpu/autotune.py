@@ -272,6 +272,18 @@ def writer_throttle_listener(store):
     return listener
 
 
+_tuner_id_lock = threading.Lock()
+_tuner_id_next = 0
+
+
+def _next_tuner_id():
+    """Process-unique tuner index for the metrics ``pipeline`` label."""
+    global _tuner_id_next
+    with _tuner_id_lock:
+        tuner_id, _tuner_id_next = _tuner_id_next, _tuner_id_next + 1
+        return tuner_id
+
+
 class AutoTuner(object):
     """Feedback control thread over a set of :class:`Knob`\\ s.
 
@@ -316,6 +328,28 @@ class AutoTuner(object):
         self.paused_ticks = 0
         self.reverts = 0
         self.last_class = None
+        # Registry mirror (petastorm_tpu.metrics): the bottleneck class as
+        # an enum gauge (per pipeline, exactly one class label at 1 — the
+        # service-level signal ROADMAP-1 autoscaling consumes), knob values
+        # as gauges, and a per-action decision counter. Gauges carry a
+        # per-tuner ``pipeline`` label: two controllers in one process
+        # (train + eval loaders) must not overwrite each other's class or
+        # flap each other's knob values.
+        from petastorm_tpu import metrics as metrics_mod
+        self._pipeline_label = 'tuner-{}'.format(_next_tuner_id())
+        self._m_decisions = metrics_mod.counter(
+            'pst_autotune_decisions_total',
+            'Autotuner knob decisions, by action', labelnames=('action',))
+        self._m_bottleneck = metrics_mod.gauge(
+            'pst_autotune_bottleneck',
+            'Current bottleneck classification (enum gauge: per pipeline, '
+            'the active class reads 1, every other 0)',
+            labelnames=('pipeline', 'class'))
+        self._m_knobs = metrics_mod.gauge(
+            'pst_autotune_knob', 'Current autotuner knob values',
+            labelnames=('pipeline', 'knob'))
+        self._metric_class = None
+        self._metric_classes_seen = set()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -327,6 +361,16 @@ class AutoTuner(object):
         self._stop.set()
         if self._thread.is_alive():
             self._thread.join(timeout=join_timeout_s)
+        # Retire this pipeline's gauge children: a stopped tuner must not
+        # keep scraping as a live bottleneck (class stuck at 1), and a
+        # trainer building loaders per epoch must not grow 'tuner-N'
+        # label children in the process registry without bound.
+        for label in self._metric_classes_seen:
+            self._m_bottleneck.remove(self._pipeline_label, label)
+        self._metric_classes_seen.clear()
+        self._metric_class = None
+        for name in self.knobs:
+            self._m_knobs.remove(self._pipeline_label, name)
 
     @property
     def alive(self):
@@ -392,6 +436,13 @@ class AutoTuner(object):
         rate = deltas.get('batches', 0) / dt
         label, detail = self._classify_fn(deltas, snap, dt, self.config)
         self.last_class = label
+        if label != self._metric_class:
+            if self._metric_class is not None:
+                self._m_bottleneck.labels(
+                    self._pipeline_label, self._metric_class).set(0)
+            self._m_bottleneck.labels(self._pipeline_label, label).set(1)
+            self._metric_class = label
+            self._metric_classes_seen.add(label)
         for listener in self._listeners:
             try:
                 listener(label, detail)
@@ -491,6 +542,7 @@ class AutoTuner(object):
         decision = dict(decision)
         decision['t'] = round(now - self._t0, 3)
         decision['tick'] = self.ticks
+        self._m_decisions.labels(decision['action']).inc()
         with self._lock:
             self._log.append(decision)
         self._tracer.instant(
@@ -507,6 +559,8 @@ class AutoTuner(object):
                 point[name] = knob.get()
                 self._tracer.counter('autotune_{}'.format(name), point[name],
                                      'autotune')
+                self._m_knobs.labels(self._pipeline_label, name).set(
+                    point[name])
             except Exception:  # noqa: BLE001 - a dying getter must not kill it
                 point[name] = None
         with self._lock:

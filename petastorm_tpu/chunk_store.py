@@ -385,6 +385,18 @@ class DecodedChunkStore(CacheBase):
         self._stopping = False
         self._throttled = False
         self._dir_bytes = None   # running size estimate; None = needs a scan
+        # Registry mirror (petastorm_tpu.metrics): the same counters as
+        # scrapable instruments — one registry.collect() then covers the
+        # NVMe tier next to staging/autotune/watchdog without a reader
+        # handle. Worker PROCESSES count in their own registries (the
+        # entry files are still shared); thread pools cover the pipeline.
+        from petastorm_tpu import metrics as metrics_mod
+        self._m = {name: metrics_mod.counter(
+            'pst_chunk_store_{}_total'.format(name),
+            'Decoded-chunk store {} count'.format(name.replace('_', ' ')))
+            for name in ('hits', 'misses', 'fills', 'writes',
+                         'write_skipped', 'corrupt', 'bytes_written',
+                         'bytes_mapped', 'readaheads', 'unstorable')}
         # counters (read via stats(); guarded by _lock)
         self.hits = 0
         self.misses = 0
@@ -483,6 +495,7 @@ class DecodedChunkStore(CacheBase):
         except CorruptChunkError as e:
             with self._lock:
                 self.corrupt += 1
+                self._m['corrupt'].inc()
                 self._validated.discard(digest)
             self._quarantine(path, e)
             return None
@@ -497,6 +510,7 @@ class DecodedChunkStore(CacheBase):
             self._entries[digest] = entry
             self._validated.add(digest)
             self.bytes_mapped += entry.nbytes
+            self._m['bytes_mapped'].inc(entry.nbytes)
             while len(self._entries) > self._max_open:
                 # Dropped, not closed: live views keep the mapping alive.
                 self._entries.popitem(last=False)
@@ -534,6 +548,7 @@ class DecodedChunkStore(CacheBase):
             mm.close()   # nothing exported; the page-cache warmth remains
         with self._lock:
             self.readaheads += 1
+            self._m['readaheads'].inc()
         return True
 
     # -- CacheBase protocol ------------------------------------------------
@@ -544,6 +559,7 @@ class DecodedChunkStore(CacheBase):
             with self._lock:
                 self.hits += 1
                 hits = self.hits
+                self._m['hits'].inc()
             from petastorm_tpu.trace import get_global_tracer
             get_global_tracer().counter('chunk_store_hits', hits, 'chunk-store')
             # A fresh shallow dict per hit: callers slice/pop their copy
@@ -554,16 +570,19 @@ class DecodedChunkStore(CacheBase):
             return dict(entry.views)
         with self._lock:
             self.misses += 1
+            self._m['misses'].inc()
         value = fill_cache_func()
         if value is None:
             return None
         with self._lock:
             self.fills += 1   # actual decoded chunks (None = empty row-group)
+            self._m['fills'].inc()
         if conforms_tensor_chunk(value):
             self._enqueue_write(key, value)
         else:
             with self._lock:
                 self.unstorable += 1
+                self._m['unstorable'].inc()
         return value
 
     # -- write-behind ------------------------------------------------------
@@ -583,6 +602,7 @@ class DecodedChunkStore(CacheBase):
             except queue.Full:
                 # NEVER block decode on NVMe: drop, self-heals next epoch.
                 self.write_skipped += 1
+                self._m['write_skipped'].inc()
 
     def set_writer_throttled(self, throttled):
         """Autotune hookup: while True the write-behind writer is PACED —
@@ -666,6 +686,8 @@ class DecodedChunkStore(CacheBase):
             self.writes += 1
             self.bytes_written += nbytes
             writes = self.writes
+            self._m['writes'].inc()
+            self._m['bytes_written'].inc(nbytes)
         from petastorm_tpu.trace import get_global_tracer
         get_global_tracer().counter('chunk_store_writes', writes, 'chunk-store')
         self._maybe_evict(nbytes)

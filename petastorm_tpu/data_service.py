@@ -223,6 +223,10 @@ class DataServer(object):
                 'belongs on the trainer for row-granular pipelines.')
         self._reader = reader
         self._zmq = zmq
+        from petastorm_tpu import metrics as metrics_mod
+        self._m_served = metrics_mod.counter(
+            'pst_data_service_chunks_served_total',
+            'Chunks this data-service server pushed to consumers')
         self._context = zmq.Context.instance()
         # A wildcard data bind derives control = port+1 and rpc = port+2,
         # and either derived port may already be taken by an unrelated
@@ -431,6 +435,7 @@ class DataServer(object):
                     parts, flags=self._zmq.NOBLOCK, copy=False)
                 if count:
                     self._served_chunks += 1
+                    self._m_served.inc()
                 return True
             except self._zmq.Again:
                 # All consumers at HWM (or none connected yet): wake the
@@ -549,6 +554,22 @@ class DataServer(object):
             # without a store connection of their own.
             return {'schema': getattr(self._reader, 'transformed_schema', None),
                     'ngram': getattr(self._reader, 'ngram', None)}
+        if cmd == 'metrics':
+            # This server process's full metrics-registry snapshot
+            # (petastorm_tpu.metrics — JSON-safe, so the pickle reply is
+            # portable): the service-level telemetry the tf.data-service
+            # papers make the autoscaling prerequisite. RemoteReader's
+            # fleet_metrics() sums these across the fleet (ROADMAP-1).
+            from petastorm_tpu import metrics as metrics_mod
+            return {'server_id': self._server_id,
+                    'sent': self._served_chunks,
+                    # registry_id: co-located servers share one process
+                    # registry; fleet_metrics dedupes replies on it so a
+                    # process's counters fold into the aggregate exactly
+                    # once. A uuid, not the pid — pids collide across
+                    # hosts/containers (pid 1 is near-universal there).
+                    'registry_id': metrics_mod.REGISTRY_INSTANCE_ID,
+                    'metrics': metrics_mod.get_registry().collect()}
         raise ValueError('unknown rpc command {!r}'.format(cmd))
 
     def start(self):
@@ -1270,6 +1291,45 @@ class RemoteReader(object):
                     with self._acct_lock:   # _servers_accounted iterates this
                         self._endpoint_sids[endpoint] = reply['server_id']
         return alive, dead
+
+    def fleet_metrics(self, timeout_ms=2000):
+        """Fleet-wide metrics: ask every data-service server for its
+        registry snapshot (the ``metrics`` RPC) and fold the replies into
+        one aggregate (counters/histograms sum per name+labels — see
+        :func:`petastorm_tpu.metrics.aggregate_snapshots`). This is the
+        service-level signal ROADMAP item 1's autoscaler consumes: the
+        decode fleet's bottleneck classes, chunk-store hit rates, and
+        retry/respawn counts in one scrape, no per-server plumbing.
+
+        Returns ``{'servers': {rpc_endpoint: snapshot}, 'aggregate':
+        merged_snapshot, 'unreachable': [endpoints]}``; the caller decides
+        whether missing servers invalidate the sample. The local
+        consumer's own registry is NOT folded in (scrape it directly) —
+        the aggregate describes the remote decode tier. Servers
+        co-located in one PROCESS share a registry; their replies carry
+        the process's registry uuid and the aggregate folds each process
+        in exactly once (summing identical snapshots would double every
+        counter)."""
+        from petastorm_tpu import metrics as metrics_mod
+        servers, unreachable = {}, []
+        by_process = {}
+        for endpoint in self._rpc_endpoints:
+            reply = self._one_shot_rpc(endpoint, {'cmd': 'metrics'},
+                                       timeout_ms=timeout_ms)
+            if reply is None or 'error' in reply \
+                    or not isinstance(reply.get('metrics'), dict):
+                unreachable.append(endpoint)
+                continue
+            servers[endpoint] = reply['metrics']
+            # Unknown registry id (None) can't be deduped: keep
+            # per-endpoint.
+            process_key = reply.get('registry_id')
+            by_process[process_key if process_key is not None
+                       else ('endpoint', endpoint)] = reply['metrics']
+        return {'servers': servers,
+                'aggregate': metrics_mod.aggregate_snapshots(
+                    by_process.values()),
+                'unreachable': unreachable}
 
     def _health_probe(self):
         """Watchdog probe: runs only while SOME stage looks stalled (any

@@ -454,13 +454,29 @@ class Watchdog(object):
 
     def __init__(self, registry, on_hard_stall=None, tracer=None,
                  escalation=DEFAULT_ESCALATION_FACTOR, poll_interval_s=None,
-                 name='pst-watchdog'):
+                 name='pst-watchdog', flight_recorder=None):
+        from petastorm_tpu import metrics
         self._registry = registry
         self._on_hard_stall = on_hard_stall
         if tracer is None:
             from petastorm_tpu.trace import NullTracer
             tracer = NullTracer()
         self._tracer = tracer
+        #: Optional petastorm_tpu.flight_recorder.FlightRecorder: sampled
+        #: every check pass, dumped on hard escalation so the stall's trace
+        #: ring + metric history survive the process.
+        self._flight_recorder = flight_recorder
+        self._m_stalls = metrics.counter(
+            'pst_watchdog_stalls_total',
+            'Stall episodes detected, by classification',
+            labelnames=('classification',))
+        self._m_soft = metrics.counter(
+            'pst_watchdog_soft_recoveries_total',
+            'Stall episodes where a soft recovery action ran')
+        self._m_hard = metrics.counter(
+            'pst_watchdog_hard_stalls_total',
+            'Stalls escalated to PipelineStallError, by classification',
+            labelnames=('classification',))
         self._escalation = max(1.0, float(escalation))
         self._poll_interval_s = poll_interval_s
         self._stop = threading.Event()
@@ -512,6 +528,11 @@ class Watchdog(object):
     def check(self, now=None):
         """One supervision pass (also called directly by tests)."""
         now = now if now is not None else time.monotonic()
+        if self._flight_recorder is not None:
+            try:
+                self._flight_recorder.sample()
+            except Exception:  # noqa: BLE001 - recording must not kill the dog
+                logger.debug('flight recorder sample failed', exc_info=True)
         stalled = self._registry.stalled(now)
         if not stalled:
             self._episode = None
@@ -531,6 +552,7 @@ class Watchdog(object):
             with self._lock:
                 self.stalls_detected += 1
                 self.last_diagnosis = diagnosis
+            self._m_stalls.labels(classification).inc()
             self._tracer.instant('stall:{}'.format(classification),
                                  cat='watchdog')
             logger.warning('pipeline stall detected: %s (stage %r): %s',
@@ -545,6 +567,7 @@ class Watchdog(object):
             if acted:
                 with self._lock:
                     self.soft_recoveries += 1
+                self._m_soft.inc()
                 self._tracer.instant('stall-recovery:{}'.format(classification),
                                      cat='watchdog')
             self._episode = (stage, classification, now, False)
@@ -564,8 +587,20 @@ class Watchdog(object):
                 self.hard_stalls += 1
                 self.last_diagnosis = diagnosis
             self._episode = (stage, classification, started_at, True)
+            self._m_hard.labels(classification).inc()
             self._tracer.instant('stall-hard:{}'.format(classification),
                                  cat='watchdog')
+            if self._flight_recorder is not None:
+                # Dump BEFORE delivering the error: the post-mortem must
+                # exist even if the consumer's teardown kills the process,
+                # and the dump path rides the diagnosis into the error text.
+                try:
+                    dump_path = self._flight_recorder.dump(
+                        diagnosis, reason=classification)
+                    if dump_path is not None:
+                        diagnosis['flight_dump'] = dump_path
+                except Exception:  # noqa: BLE001 - best-effort by contract
+                    logger.exception('flight recorder dump failed')
             error = PipelineStallError(diagnosis.format(),
                                        diagnosis=diagnosis)
             logger.error('pipeline stall escalated to hard error: %s '
@@ -581,11 +616,14 @@ class Watchdog(object):
     def stats(self):
         with self._lock:
             last = self.last_diagnosis
-            return {'stalls_detected': self.stalls_detected,
-                    'soft_recoveries': self.soft_recoveries,
-                    'hard_stalls': self.hard_stalls,
-                    'episode_active': self.episode_active,
-                    'last_stall': last.summary() if last is not None else None}
+            out = {'stalls_detected': self.stalls_detected,
+                   'soft_recoveries': self.soft_recoveries,
+                   'hard_stalls': self.hard_stalls,
+                   'episode_active': self.episode_active,
+                   'last_stall': last.summary() if last is not None else None}
+        if self._flight_recorder is not None:
+            out['flight_dumps'] = list(self._flight_recorder.dumps)
+        return out
 
 
 class HealthMonitor(object):
@@ -597,11 +635,20 @@ class HealthMonitor(object):
     """
 
     def __init__(self, stall_timeouts=None, on_hard_stall=None, tracer=None,
-                 escalation=DEFAULT_ESCALATION_FACTOR, poll_interval_s=None):
+                 escalation=DEFAULT_ESCALATION_FACTOR, poll_interval_s=None,
+                 flight_recorder=None):
         self.registry = HeartbeatRegistry(stall_timeouts)
+        if flight_recorder is None:
+            # Env-armed stall flight recorder (PETASTORM_TPU_FLIGHT_RECORDER
+            # = a directory): every supervised pipeline then dumps its
+            # trace ring + metrics on a hard stall with no code change.
+            from petastorm_tpu import flight_recorder as flight_mod
+            flight_recorder = flight_mod.maybe_from_env(tracer=tracer)
+        self.flight_recorder = flight_recorder
         self.watchdog = Watchdog(self.registry, on_hard_stall=on_hard_stall,
                                  tracer=tracer, escalation=escalation,
-                                 poll_interval_s=poll_interval_s)
+                                 poll_interval_s=poll_interval_s,
+                                 flight_recorder=flight_recorder)
 
     def start(self):
         self.watchdog.start()

@@ -710,6 +710,19 @@ class JaxLoader(object):
             if attach is not None:
                 attach(self._health.registry)
         self._namedtuple_cache = {}
+        # Metrics-registry instruments (petastorm_tpu.metrics): the
+        # machine-scrapable mirror of the `stats` dict. Cached here — one
+        # registry lookup at construction, one small lock per batch.
+        from petastorm_tpu import metrics as metrics_mod
+        self._m_batches = metrics_mod.counter(
+            'pst_loader_batches_total', 'Device batches delivered to the '
+            'training loop (echoed re-deliveries included)')
+        self._m_batch_wait = metrics_mod.histogram(
+            'pst_batch_wait_seconds', 'Consumer-side blocked time per '
+            'fetch (the input-stall signal; includes the end-of-stream '
+            'fetch)')
+        self._m_staged_bytes = metrics_mod.counter(
+            'pst_staged_bytes_total', 'Host bytes handed to device staging')
         # input-stall accounting (BASELINE.json targets <5% input stall)
         self._batches_delivered = 0
         self._wait_s = 0.0
@@ -982,6 +995,7 @@ class JaxLoader(object):
         with self._stats_lock:
             self._stage_s += time.perf_counter() - t0
             self._staged_bytes += nbytes
+        self._m_staged_bytes.inc(nbytes)
         return out
 
     def _next_host_batch(self):
@@ -1092,7 +1106,9 @@ class JaxLoader(object):
             if self._echo > 1 and isinstance(item, dict):
                 self._echo_item = item
                 self._echo_left = self._echo - 1
-        self._wait_s += time.perf_counter() - t0
+        batch_wait = time.perf_counter() - t0
+        self._wait_s += batch_wait
+        self._m_batch_wait.observe(batch_wait)
         if item is _END:
             self._exhausted = True
             if self._hb_consumer is not None:
@@ -1104,6 +1120,7 @@ class JaxLoader(object):
         names = tuple(sorted(item))
         nt = cached_namedtuple(self._namedtuple_cache, 'JaxBatch', names)
         self._batches_delivered += 1
+        self._m_batches.inc()
         if self._hb_consumer is not None:
             # 'delivered' + stale = the training loop took this batch and
             # never came back (consumer-not-draining, never escalated).

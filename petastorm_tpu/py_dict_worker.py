@@ -64,9 +64,11 @@ class PyDictWorker(RowGroupWorkerBase):
             # Envelope tags the chunk with its ventilation key so the consumer
             # can track per-row-group consumption for checkpoint/resume
             # (petastorm_tpu.checkpoint).
-            self.publish_func({'__pst_chunk__': 1,
-                               'key': chunk_key(piece_index, shuffle_row_drop_partition),
-                               'rows': rows})
+            from petastorm_tpu.trace import get_global_tracer
+            with get_global_tracer().span('handoff', 'worker'):
+                self.publish_func({'__pst_chunk__': 1,
+                                   'key': chunk_key(piece_index, shuffle_row_drop_partition),
+                                   'rows': rows})
 
     def _apply_transform(self, row, transform_spec):
         out = transform_spec.func(row)
@@ -101,12 +103,14 @@ class PyDictWorker(RowGroupWorkerBase):
             hashlib.md5(','.join(field_names).encode()).hexdigest()[:8])
 
         def load():
+            from petastorm_tpu.trace import get_global_tracer
             encoded_rows = self._read_columns(piece, field_names)
             decode_schema = (self.args['full_schema'].create_schema_view(
                 [n for n in field_names if n in self.args['full_schema'].fields])
                 if self.args['ngram'] is not None else schema)
-            return decode_rows(encoded_rows, decode_schema,
-                               num_threads=self.args.get('decode_threads'))
+            with get_global_tracer().span('decode', 'worker'):
+                return decode_rows(encoded_rows, decode_schema,
+                                   num_threads=self.args.get('decode_threads'))
 
         return self.args['cache'].get(cache_key, load)
 

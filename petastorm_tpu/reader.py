@@ -455,6 +455,10 @@ class QuarantineLog(object):
                 self._by_item[item_key] = entry
             over_budget = len(self._records) > self._max
             snapshot = list(self._records)
+        from petastorm_tpu import metrics
+        metrics.counter('pst_rowgroups_quarantined_total',
+                        'Distinct poison row-group items quarantined under '
+                        'the error budget').inc()
         logger.warning('Quarantined row-group %s (%d/%d of error budget used)',
                        entry.get('path', piece_index), len(snapshot), self._max)
         if over_budget:

@@ -37,6 +37,10 @@ _retry_counters = {}
 def _count_retry(name):
     with _counters_lock:
         _retry_counters[name] = _retry_counters.get(name, 0) + 1
+    from petastorm_tpu import metrics
+    metrics.counter('pst_retries_total',
+                    'Retried operations, by retry-loop name',
+                    labelnames=('op',)).labels(name).inc()
 
 
 def retry_counters():

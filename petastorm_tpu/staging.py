@@ -212,6 +212,12 @@ class ArenaPool(object):
         self._alloc = 0
         self._reuse = 0
         self._wait_s = 0.0
+        # Registry mirror (petastorm_tpu.metrics): per-acquisition wait
+        # latency — the machine-scrapable arena-backpressure signal.
+        from petastorm_tpu import metrics as metrics_mod
+        self._m_wait = metrics_mod.histogram(
+            'pst_arena_wait_seconds',
+            'Assembler blocked time per arena acquisition (backpressure)')
 
     def _matches(self, spec):
         if self._spec is None:
@@ -272,6 +278,8 @@ class ArenaPool(object):
                 self._wait_s += time.perf_counter() - t0
             if waiting_hb:
                 self._heartbeat.beat('collate')
+            if waited:
+                self._m_wait.observe(waited)
             self._pending = arena
             self._tracer.counter('arena_pool_free', len(self._free), 'staging')
             return arena.buffers
@@ -524,6 +532,14 @@ class StagingEngine(object):
             tracer = NullTracer()
         self._tracer = tracer
         self.meter = meter if meter is not None else OverlapMeter()
+        # Registry mirror (petastorm_tpu.metrics): per-batch assemble and
+        # dispatch latencies — the staging halves of the scrape surface.
+        from petastorm_tpu import metrics as metrics_mod
+        self._m_assemble = metrics_mod.histogram(
+            'pst_assemble_seconds', 'Host-batch collate latency per batch')
+        self._m_dispatch = metrics_mod.histogram(
+            'pst_dispatch_seconds', 'Device staging dispatch latency per '
+            'batch (put issue time, not transfer completion)')
         self._stats_lock = threading.Lock()
         self._retired = 0
         self._ready_wait_s = 0.0
@@ -600,9 +616,12 @@ class StagingEngine(object):
                 if hb is not None:
                     hb.beat('collate')
                 try:
+                    t_assemble = time.perf_counter()
                     with self.meter.track('assemble'):
                         with self._tracer.span('assemble', 'host'):
                             batch = next(self._host_iter)
+                    self._m_assemble.observe(
+                        time.perf_counter() - t_assemble)
                 except StopIteration:
                     break
                 arena = self._pool.claim_pending() if self._pool else None
@@ -681,9 +700,11 @@ class StagingEngine(object):
                     return
                 if hb is not None:
                     hb.beat('device_put')
+                t_dispatch = time.perf_counter()
                 with self.meter.track('dispatch'):
                     with self._tracer.span('dispatch', 'device'):
                         staged = self._stage_fn(batch)
+                self._m_dispatch.observe(time.perf_counter() - t_dispatch)
                 if arena is not None:
                     if self._holds_mode:
                         for value in staged.values():

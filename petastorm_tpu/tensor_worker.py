@@ -74,15 +74,27 @@ class TensorWorker(RowGroupWorkerBase):
         timings = {}
 
         def load():
+            from petastorm_tpu import metrics
+            from petastorm_tpu.trace import get_global_tracer
+
             t0 = time.perf_counter()
             table = self._load_table(piece, worker_predicate)
             timings['read_s'] = time.perf_counter() - t0
             if table is None or table.num_rows == 0:
                 return None
             t0 = time.perf_counter()
-            cols = decode_table_to_blocks(table, schema,
-                                          self.args.get('decode_threads'))
+            # The decode span (process-local global tracer — a sidecar
+            # spiller inside pool workers, see trace.install_worker_tracer)
+            # is what makes worker-subprocess decode visible on a merged
+            # timeline; the histogram is its scrape-surface twin.
+            with get_global_tracer().span('decode', 'worker'):
+                cols = decode_table_to_blocks(table, schema,
+                                              self.args.get('decode_threads'))
             timings['decode_s'] = time.perf_counter() - t0
+            metrics.histogram(
+                'pst_decode_seconds',
+                'Row-group decode latency inside workers').observe(
+                    timings['decode_s'])
             return cols
 
         from petastorm_tpu.cache import NullCache
@@ -158,11 +170,13 @@ class TensorWorker(RowGroupWorkerBase):
             private = True
 
         if n_rows:
-            self.publish_func({'__pst_tensor_chunk__': 1,
-                               'key': chunk_key(piece_index, shuffle_row_drop_partition),
-                               'cols': cols,
-                               'private': private,
-                               'timings': timings})
+            from petastorm_tpu.trace import get_global_tracer
+            with get_global_tracer().span('handoff', 'worker'):
+                self.publish_func({'__pst_tensor_chunk__': 1,
+                                   'key': chunk_key(piece_index, shuffle_row_drop_partition),
+                                   'cols': cols,
+                                   'private': private,
+                                   'timings': timings})
 
     # --- loading ------------------------------------------------------
 

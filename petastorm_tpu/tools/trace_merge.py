@@ -1,0 +1,70 @@
+"""Merge per-process trace sidecar files into one Chrome trace JSON.
+
+A run traced with ``PETASTORM_TPU_TRACE_DIR`` leaves one ``trace-<pid>-
+<uid>.jsonl`` sidecar per process (the loader process plus every pool
+worker). This CLI folds a finished run's sidecars into a single timeline —
+worker ``decode`` tracks under their real pids next to the loader's
+``assemble``/``stage``/``wait`` tracks — ready for chrome://tracing or
+Perfetto::
+
+    python -m petastorm_tpu.tools.trace_merge --dir /tmp/pst-trace \\
+        --out /tmp/pipeline.json --summary
+
+Torn trailing lines (a worker killed mid-write) are skipped, so merging a
+crashed run works. ``--summary`` prints the per-span latency digest
+(count/total/p50/p99) to stdout as JSON.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None):
+    from petastorm_tpu.trace import TRACE_DIR_ENV, Tracer
+
+    parser = argparse.ArgumentParser(
+        prog='python -m petastorm_tpu.tools.trace_merge',
+        description='Merge per-process trace sidecar (JSONL) files from a '
+                    'finished run into one Chrome trace JSON.')
+    parser.add_argument('--dir', dest='spill_dir',
+                        default=os.environ.get(TRACE_DIR_ENV),
+                        help='sidecar directory (default: ${})'
+                        .format(TRACE_DIR_ENV))
+    parser.add_argument('--out', dest='out_path', default=None,
+                        help='output trace path (default: '
+                             '<dir>/merged_trace.json)')
+    parser.add_argument('--summary', action='store_true',
+                        help='also print the per-span count/total/p50/p99 '
+                             'digest as JSON')
+    args = parser.parse_args(argv)
+
+    if not args.spill_dir:
+        parser.error('no sidecar directory: pass --dir or set {}'
+                     .format(TRACE_DIR_ENV))
+    if not os.path.isdir(args.spill_dir):
+        parser.error('not a directory: {!r}'.format(args.spill_dir))
+    out_path = args.out_path or os.path.join(args.spill_dir,
+                                             'merged_trace.json')
+
+    # spill_dir=False: the merge tool must never append a sidecar of its
+    # own to the directory it is merging.
+    tracer = Tracer(spill_dir=False, role='trace-merge')
+    merged = tracer.merge_process_files(args.spill_dir)
+    if merged == 0:
+        print('no sidecar files under {!r}'.format(args.spill_dir),
+              file=sys.stderr)
+        return 1
+    tracer.export_chrome_trace(out_path)
+    report = {'merged_files': merged,
+              'events': len(tracer.events),
+              'out': out_path}
+    if args.summary:
+        report['summary'] = tracer.summary()
+    print(json.dumps(report, indent=1))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

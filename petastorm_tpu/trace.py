@@ -1,4 +1,4 @@
-"""Input-pipeline tracing: chrome://tracing timelines for the loader.
+"""Input-pipeline tracing: chrome://tracing timelines across processes.
 
 The reference's observability stops at per-thread cProfile aggregates
 (SURVEY §5.1 — "No distributed tracing"). This records *spans* — named,
@@ -7,33 +7,99 @@ that chrome://tracing / Perfetto render as a timeline, which is how you SEE
 an input stall: the consumer's ``wait`` spans grow exactly when the staging
 thread's ``device_put`` spans (or the workers' decode) stretch.
 
+Cross-process story (the piece a single in-memory tracer cannot give you —
+worker-subprocess decode dominates the cold path, PROFILE_r05): every
+:class:`Tracer` can additionally *spill* its events to a per-process JSONL
+sidecar file. Setting the ``PETASTORM_TPU_TRACE_DIR`` environment variable
+arms spill for every tracer built afterwards — including the ones the
+process-pool worker bootstraps install (workers are spawned and inherit the
+environment, the same activation channel ``faults.py`` uses). Sidecars are
+append-only, line-buffered, and bounded: a worker that dies mid-write
+leaves at most one torn trailing line, which :meth:`Tracer.
+merge_process_files` (and the ``python -m petastorm_tpu.tools.trace_merge``
+CLI) skip. After a run, merging folds every process's events — shifted
+onto the parent's timebase via each sidecar's wall-clock anchor — into one
+timeline where worker ``decode`` tracks (real pids) sit next to the
+loader's ``assemble``/``stage``/``wait`` tracks.
+
 Usage::
 
+    os.environ['PETASTORM_TPU_TRACE_DIR'] = '/tmp/pst-trace'  # before reader
     tracer = Tracer()
-    with make_tensor_reader(url) as reader:
+    with make_tensor_reader(url, reader_pool_type='process') as reader:
         with JaxLoader(reader, 1024, tracer=tracer) as loader:
             for batch in loader: ...
+    tracer.merge_process_files()
     tracer.export_chrome_trace('/tmp/input_pipeline.json')
 
-Pure stdlib, thread-safe, bounded (drops oldest beyond ``max_events``).
+Pure stdlib, thread-safe, bounded (drops oldest beyond ``max_events``;
+sidecars stop at ``spill_max_events`` lines).
 """
 
+import glob
 import json
+import logging
+import os
 import threading
 import time
+import uuid
 from collections import deque
 from contextlib import contextmanager
 
+logger = logging.getLogger(__name__)
+
+#: Directory that arms per-process sidecar spill for every Tracer built
+#: while it is set (inherited by spawned worker processes).
+TRACE_DIR_ENV = 'PETASTORM_TPU_TRACE_DIR'
+
+_SIDECAR_GLOB = 'trace-*.jsonl'
+_HEADER_KEY = '__pst_trace_sidecar__'
+
 
 class Tracer(object):
-    """Thread-safe span recorder with Chrome trace-event export."""
+    """Thread-safe span recorder with Chrome trace-event export.
 
-    def __init__(self, max_events=100000):
+    :param max_events: in-memory ring bound (oldest dropped past it).
+    :param spill_dir: directory for this process's JSONL sidecar file.
+        ``None`` (default) consults ``PETASTORM_TPU_TRACE_DIR``; ``False``
+        disables spill even when the env var is set.
+    :param role: human label for this process's track in merged timelines
+        (``'main'`` for the default in-process tracer; worker bootstraps
+        pass ``'worker-<id>'``).
+    :param spill_max_events: sidecar line bound (defaults to
+        ``max_events``); past it events keep landing in memory but the
+        file stops growing (a truncation marker records the drop count).
+    """
+
+    def __init__(self, max_events=100000, spill_dir=None, role=None,
+                 spill_max_events=None):
         # deque(maxlen=...): O(1) drop-oldest — a full list.pop(0) buffer
         # would shift max_events pointers inside the hot-path lock.
         self._events = deque(maxlen=max_events)
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
+        # Wall-clock anchor of t0: what lets merge align sidecars recorded
+        # by other processes (perf_counter is process-local) onto one
+        # timeline. Same-host clocks, so the alignment is ~exact.
+        self._wall0 = time.time()
+        self._pid = os.getpid()
+        self.role = role or 'main'
+        if spill_dir is None:
+            spill_dir = os.environ.get(TRACE_DIR_ENV) or None
+        elif spill_dir is False:
+            spill_dir = None
+        self._spill_dir = spill_dir
+        self._spill_file = None
+        self._spill_path = None
+        self._spill_count = 0
+        self._spill_dropped = 0
+        self._spill_failed = False
+        self._spill_max = (int(spill_max_events)
+                           if spill_max_events is not None else max_events)
+        self._merged = []            # events folded in from sidecar files
+        self._roles = {}             # pid -> role (merged sidecar headers)
+
+    # -- recording ---------------------------------------------------------
 
     @contextmanager
     def span(self, name, cat='pipeline'):
@@ -42,13 +108,12 @@ class Tracer(object):
             yield
         finally:
             end = time.perf_counter()
-            with self._lock:
-                self._events.append({
-                    'name': name, 'cat': cat, 'ph': 'X',
-                    'ts': (start - self._t0) * 1e6,      # microseconds
-                    'dur': (end - start) * 1e6,
-                    'pid': 0, 'tid': threading.get_ident(),
-                })
+            self._append({
+                'name': name, 'cat': cat, 'ph': 'X',
+                'ts': (start - self._t0) * 1e6,      # microseconds
+                'dur': (end - start) * 1e6,
+                'pid': self._pid, 'tid': threading.get_ident(),
+            })
 
     def instant(self, name, cat='pipeline', args=None):
         """A zero-duration marker event. ``args`` (a JSON-safe dict)
@@ -58,45 +123,263 @@ class Tracer(object):
         event = {
             'name': name, 'cat': cat, 'ph': 'i', 's': 't',
             'ts': (time.perf_counter() - self._t0) * 1e6,
-            'pid': 0, 'tid': threading.get_ident(),
+            'pid': self._pid, 'tid': threading.get_ident(),
         }
         if args:
             event['args'] = dict(args)
-        with self._lock:
-            self._events.append(event)
+        self._append(event)
 
     def counter(self, name, value, cat='pipeline'):
         """A counter-track sample (chrome trace 'C' event): renders as a
         filled area chart. Used by the staging engine for arena-pool
         occupancy and the in-flight transfer window, so a timeline shows
         backpressure (pool pinned at 0 free) next to the spans it stalls."""
+        self._append({
+            'name': name, 'cat': cat, 'ph': 'C',
+            'ts': (time.perf_counter() - self._t0) * 1e6,
+            'pid': self._pid, 'tid': threading.get_ident(),
+            'args': {name: value},
+        })
+
+    def _append(self, event):
         with self._lock:
-            self._events.append({
-                'name': name, 'cat': cat, 'ph': 'C',
-                'ts': (time.perf_counter() - self._t0) * 1e6,
-                'pid': 0, 'tid': threading.get_ident(),
-                'args': {name: value},
-            })
+            self._events.append(event)
+            if self._spill_dir is not None:
+                self._spill(event)
+
+    # -- sidecar spill -----------------------------------------------------
+
+    def _spill(self, event):
+        """Append one event line to the sidecar (lock held). Line-buffered
+        so a killed process leaves whole lines plus at most one torn tail;
+        bounded so a long run cannot fill the disk."""
+        if self._spill_failed:
+            return
+        if self._spill_file is None and not self._open_spill():
+            return
+        if self._spill_count >= self._spill_max:
+            if self._spill_dropped == 0:
+                try:
+                    self._spill_file.write(json.dumps(
+                        {'name': 'trace-spill-truncated', 'cat': 'trace',
+                         'ph': 'i', 's': 't',
+                         'ts': event.get('ts', 0.0),
+                         'pid': self._pid,
+                         'tid': threading.get_ident()}) + '\n')
+                except OSError:
+                    self._spill_failed = True
+            self._spill_dropped += 1
+            return
+        try:
+            self._spill_file.write(json.dumps(event) + '\n')
+            self._spill_count += 1
+        except (OSError, TypeError, ValueError):
+            # Disk gone or an un-JSONable args payload: tracing is
+            # advisory — never let it take the pipeline down.
+            logger.warning('trace sidecar write failed; disabling spill',
+                           exc_info=True)
+            self._spill_failed = True
+
+    def _open_spill(self):
+        try:
+            os.makedirs(self._spill_dir, exist_ok=True)
+            path = os.path.join(self._spill_dir, 'trace-{}-{}.jsonl'.format(
+                self._pid, uuid.uuid4().hex[:8]))
+            # buffering=1: one flush per line — crash-tolerant (complete
+            # lines survive a SIGKILL) at row-group event granularity.
+            self._spill_file = open(path, 'w', buffering=1)
+            self._spill_path = path
+            self._spill_file.write(json.dumps(
+                {_HEADER_KEY: 1, 'pid': self._pid, 'role': self.role,
+                 'wall0': self._wall0}) + '\n')
+            return True
+        except OSError:
+            logger.warning('cannot open trace sidecar in %r; disabling spill',
+                           self._spill_dir, exc_info=True)
+            self._spill_failed = True
+            return False
+
+    @property
+    def spill_path(self):
+        """This tracer's sidecar file (``None`` when spill is off or no
+        event has been recorded yet)."""
+        with self._lock:
+            return self._spill_path
+
+    def close(self):
+        """Flush + close the sidecar file (worker bootstraps call this on
+        shutdown; safe to call repeatedly, and spill-less tracers no-op)."""
+        with self._lock:
+            f, self._spill_file = self._spill_file, None
+        if f is not None:
+            try:
+                f.flush()
+                f.close()
+            except OSError:  # pragma: no cover - disk already gone
+                pass
+
+    # -- merge -------------------------------------------------------------
+
+    @property
+    def wall0(self):
+        """Wall-clock anchor of this tracer's t0 (the merge timebase)."""
+        return self._wall0
+
+    def merge_process_files(self, spill_dir=None, since_wall0=None):
+        """Fold every sidecar file under ``spill_dir`` (default: this
+        tracer's spill dir, else ``PETASTORM_TPU_TRACE_DIR``) into this
+        tracer's timeline. Each file's events are shifted by its
+        wall-clock anchor so worker tracks align with local spans; this
+        tracer's own sidecar is skipped (its events are already in
+        memory). Torn/corrupt lines (a worker killed mid-write) are
+        skipped, not fatal. Returns the number of files merged.
+
+        The directory is NOT run-scoped: sidecars from an earlier run
+        left in the same directory merge too. Use a fresh directory per
+        run (``tempfile.mkdtemp``), or pass ``since_wall0`` (e.g. this
+        tracer's :attr:`wall0`, captured before the pipeline was built)
+        to skip sidecar files whose anchor predates the run."""
+        directory = spill_dir or self._spill_dir \
+            or os.environ.get(TRACE_DIR_ENV)
+        if not directory:
+            raise ValueError('no spill directory: pass spill_dir or set '
+                             '{}'.format(TRACE_DIR_ENV))
+        own = self.spill_path
+        merged_files = 0
+        for path in sorted(glob.glob(os.path.join(directory, _SIDECAR_GLOB))):
+            if own is not None and os.path.abspath(path) == os.path.abspath(own):
+                continue
+            header, events = read_sidecar_file(path)
+            if header is None and not events:
+                continue
+            if since_wall0 is not None and header is not None \
+                    and header.get('wall0', since_wall0) < since_wall0:
+                continue        # a previous run's leftover sidecar
+            offset_us = 0.0
+            pid = None
+            if header is not None:
+                pid = header.get('pid')
+                offset_us = (header.get('wall0', self._wall0)
+                             - self._wall0) * 1e6
+                if pid is not None and header.get('role'):
+                    self._roles[pid] = header['role']
+            adjusted = []
+            for event in events:
+                event = dict(event)
+                event['ts'] = event.get('ts', 0.0) + offset_us
+                if 'pid' not in event and pid is not None:
+                    event['pid'] = pid
+                adjusted.append(event)
+            with self._lock:
+                self._merged.extend(adjusted)
+            merged_files += 1
+        return merged_files
+
+    # -- inspection / export -----------------------------------------------
 
     @property
     def events(self):
         with self._lock:
-            return list(self._events)
+            return list(self._events) + list(self._merged)
 
     def summary(self):
-        """Total seconds per span name (quick text view of the timeline)."""
-        totals = {}
+        """Per-span-name latency digest — the quick-look view that makes a
+        trace useful without opening Perfetto::
+
+            {name: {'count': n, 'total_s': t, 'p50_s': m, 'p99_s': p}}
+        """
+        durations = {}
         for e in self.events:
-            if e['ph'] == 'X':
-                totals[e['name']] = totals.get(e['name'], 0.0) + e['dur'] / 1e6
-        return {k: round(v, 4) for k, v in sorted(totals.items())}
+            if e.get('ph') == 'X':
+                durations.setdefault(e['name'], []).append(
+                    e.get('dur', 0.0) / 1e6)
+        out = {}
+        for name, values in sorted(durations.items()):
+            values.sort()
+            out[name] = {'count': len(values),
+                         'total_s': round(sum(values), 4),
+                         'p50_s': round(_percentile(values, 0.50), 6),
+                         'p99_s': round(_percentile(values, 0.99), 6)}
+        return out
 
     def export_chrome_trace(self, path):
-        """Write the Chrome trace-event JSON (open in chrome://tracing)."""
-        with open(path, 'w') as f:
-            json.dump({'traceEvents': self.events,
+        """Write the Chrome trace-event JSON (open in chrome://tracing).
+
+        Atomic (tmp file + rename): a watchdog dumping a trace while the
+        process crashes — or two dumps racing — can never leave a torn
+        JSON at ``path``. Distinct pids get ``process_name`` metadata so
+        merged multi-process timelines render labeled tracks."""
+        events = self.events
+        roles = dict(self._roles)
+        roles.setdefault(self._pid, self.role)
+        metadata = []
+        for pid in sorted({e.get('pid') for e in events if 'pid' in e}):
+            metadata.append({
+                'name': 'process_name', 'ph': 'M', 'pid': pid,
+                'args': {'name': '{} (pid {})'.format(
+                    roles.get(pid, 'process'), pid)}})
+        # pid alone is not unique enough: two threads exporting to the
+        # same path (periodic export racing a watchdog dump) must not
+        # share — and truncate — one tmp file.
+        tmp = '{}.tmp.{}.{}'.format(path, os.getpid(), uuid.uuid4().hex[:8])
+        with open(tmp, 'w') as f:
+            json.dump({'traceEvents': metadata + events,
                        'displayTimeUnit': 'ms'}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
         return path
+
+
+def _percentile(sorted_values, q):
+    """Nearest-rank percentile of an ascending list (empty -> 0)."""
+    if not sorted_values:
+        return 0.0
+    index = min(len(sorted_values) - 1,
+                max(0, int(round(q * (len(sorted_values) - 1)))))
+    return sorted_values[index]
+
+
+def read_sidecar_file(path):
+    """``(header_or_None, [events])`` from one sidecar JSONL file.
+
+    Torn trailing lines and corrupt lines (a worker SIGKILLed mid-write)
+    are skipped — the file stays readable even if its writer died."""
+    header = None
+    events = []
+    try:
+        with open(path, 'r') as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except ValueError:
+                    continue        # torn/corrupt line: skip, keep reading
+                if not isinstance(record, dict):
+                    continue
+                if record.get(_HEADER_KEY):
+                    header = record
+                else:
+                    events.append(record)
+    except OSError:
+        logger.warning('cannot read trace sidecar %r', path, exc_info=True)
+    return header, events
+
+
+def install_worker_tracer(role=None):
+    """Worker-bootstrap hook: when ``PETASTORM_TPU_TRACE_DIR`` is set
+    (inherited from the parent through the spawn environment), build a
+    spilling tracer, install it as this process's global tracer, and
+    return it (the bootstrap ``close()``\\ s it on shutdown). Returns
+    ``None`` when tracing is unarmed — instrumentation points then hit
+    the shared :class:`NullTracer` at near-zero cost."""
+    if not os.environ.get(TRACE_DIR_ENV):
+        return None
+    tracer = Tracer(role=role or 'worker-{}'.format(os.getpid()))
+    set_global_tracer(tracer)
+    return tracer
 
 
 _global_tracer = None
@@ -104,8 +387,9 @@ _global_tracer = None
 
 def set_global_tracer(tracer):
     """Install a process-wide tracer that instrumentation points with no
-    Tracer argument (e.g. fault-injection sites in ``faults.py``) report to.
-    Pass ``None`` to reset. Returns the previous global tracer."""
+    Tracer argument (e.g. fault-injection sites in ``faults.py`` and the
+    worker-side read/decode/handoff spans) report to. Pass ``None`` to
+    reset. Returns the previous global tracer."""
     global _global_tracer
     previous = _global_tracer
     _global_tracer = tracer
@@ -138,6 +422,9 @@ class NullTracer(object):
         pass
 
     def counter(self, name, value, cat='pipeline'):
+        pass
+
+    def close(self):
         pass
 
 

@@ -394,6 +394,7 @@ def _worker_bootstrap(worker_class, worker_id, worker_args,
     import traceback
 
     from petastorm_tpu.faults import maybe_inject
+    from petastorm_tpu.trace import install_worker_tracer
 
     serializer = serializer_type()
     context = zmq.Context()
@@ -407,6 +408,12 @@ def _worker_bootstrap(worker_class, worker_id, worker_args,
     results_sender.connect('tcp://127.0.0.1:{}'.format(results_port))
 
     _start_orphan_watchdog(parent_pid)
+    # Cross-process tracing (trace.py): when PETASTORM_TPU_TRACE_DIR is set
+    # (inherited through the spawn environment), this worker's read/decode/
+    # handoff spans spill to a per-process JSONL sidecar the parent merges
+    # into one timeline. None when unarmed — spans then hit the NullTracer.
+    worker_tracer = install_worker_tracer(
+        role='worker-{}'.format(worker_id))
 
     current_seq = [None, 0]  # [item seq, chunk index within the item]
 
@@ -447,6 +454,8 @@ def _worker_bootstrap(worker_class, worker_id, worker_args,
                 current_seq[0] = None
     finally:
         worker.shutdown()
+        if worker_tracer is not None:
+            worker_tracer.close()
         for sock in (work_receiver, control_receiver, results_sender):
             sock.close(linger=_SOCKET_LINGER_MS)
         context.term()

@@ -89,6 +89,11 @@ class RowGroupWorkerBase(WorkerBase):
         buffers import zero-copy (Arrow C Data Interface). Falls back to
         pyarrow for remote stores, nested columns, or build failure.
         """
+        from petastorm_tpu.trace import get_global_tracer
+        with get_global_tracer().span('read', 'worker'):
+            return self._read_row_group_traced(piece, columns)
+
+    def _read_row_group_traced(self, piece, columns):
         from petastorm_tpu.faults import maybe_inject, rowgroup_fault_key
         fault_key = rowgroup_fault_key(piece.path, piece.row_group)
         maybe_inject('fs-read-delay', key=fault_key)

@@ -432,12 +432,17 @@ def _shm_worker_bootstrap(worker_class, worker_id, worker_args, in_name,
 
     from petastorm_tpu.faults import maybe_inject
     from petastorm_tpu.native.shm_ring import RingClosed, ShmRing
+    from petastorm_tpu.trace import install_worker_tracer
 
     serializer = serializer_type()
     work_ring = ShmRing.open(in_name)
     result_ring = ShmRing.open(out_name)
 
     _start_orphan_watchdog(parent_pid)
+    # Cross-process tracing: sidecar-spilling global tracer when
+    # PETASTORM_TPU_TRACE_DIR is set (see process_pool._worker_bootstrap).
+    worker_tracer = install_worker_tracer(
+        role='worker-{}'.format(worker_id))
 
     def send_control(obj):
         result_ring.write_tagged(_TAG_CONTROL, pickle.dumps(obj), timeout_ms=-1)
@@ -488,5 +493,7 @@ def _shm_worker_bootstrap(worker_class, worker_id, worker_args, in_name,
         pass
     finally:
         worker.shutdown()
+        if worker_tracer is not None:
+            worker_tracer.close()
         work_ring.close()
         result_ring.close()

@@ -247,10 +247,13 @@ class SupervisedPoolMixin(object):
                 self._handle_dead_worker(slot, process.returncode)
 
     def _handle_dead_worker(self, slot, exitcode):
+        from petastorm_tpu import metrics
         from petastorm_tpu.errors import WorkerLostError
         from petastorm_tpu.trace import get_global_tracer
 
         get_global_tracer().instant('worker-lost:{}'.format(slot), cat='fault')
+        metrics.counter('pst_worker_deaths_total',
+                        'Pool worker processes found dead').inc()
         self._rescue_dead_worker_output(slot)
         # Discard the slot's unsent payloads BEFORE snapshotting its
         # in-flight items: the ventilator thread may assign a new item to
@@ -281,6 +284,8 @@ class SupervisedPoolMixin(object):
                        'item(s)', self._pool_kind, slot, exitcode,
                        self._restarts, self._max_worker_restarts,
                        len(stranded))
+        metrics.counter('pst_worker_respawns_total',
+                        'Dead pool workers respawned within budget').inc()
         self._respawn_worker_transport(slot)
         for seq, item in stranded:
             new_slot = self._registry.requeue(seq, item)

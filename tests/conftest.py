@@ -159,6 +159,11 @@ def pytest_configure(config):
         'chunkstore: NVMe decoded-chunk-store tests '
         '(tests/test_chunk_store.py); the conftest guard deletes any '
         'leaked pst-chunk-store-* temp dirs after them.')
+    config.addinivalue_line(
+        'markers',
+        'observability: tracing/metrics/flight-recorder tests '
+        '(tests/test_trace.py, tests/test_metrics.py); the conftest guard '
+        'sweeps leaked trace sidecar and flight-dump temp dirs after them.')
 
 
 # ---------------------------------------------------------------------------
@@ -248,6 +253,70 @@ def _autotune_thread_guard():
 # only they create prefix-named stores, and a global sweep could race another
 # test's live store.
 # ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
+# Metrics-exporter leak guard (mirrors the autotuner guard): the opt-in HTTP
+# scrape endpoint (petastorm_tpu.metrics.MetricsExporter) runs on a daemon
+# thread named pst-metrics-exporter; a test that starts one must stop() it,
+# or the leaked listener would hold a port (and a registry reference) for
+# the rest of the session. Runs on every test — cheap when nothing leaked.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _metrics_exporter_thread_guard():
+    import threading
+    import time as _time
+
+    yield
+    deadline = _time.monotonic() + 2.0
+    leaked = []
+    while _time.monotonic() < deadline:
+        leaked = [t.name for t in threading.enumerate()
+                  if t.is_alive() and t.name.startswith('pst-metrics-exporter')]
+        if not leaked:
+            return
+        _time.sleep(0.05)   # stop() joins with a timeout: allow it to land
+    pytest.fail('metrics exporter thread(s) leaked past stop(): '
+                '{}'.format(leaked))
+
+
+# ---------------------------------------------------------------------------
+# Observability temp-dir guard: trace sidecar dirs and flight-recorder dumps
+# created during an observability-marked test must not accumulate on the CI
+# host. Snapshot-diff (same rationale as the chunk-store guard): only dirs
+# that appeared during this test are this test's leaks.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _observability_dir_guard(request):
+    if request.node.get_closest_marker('observability') is None:
+        yield
+        return
+    import glob
+    import shutil
+    import tempfile
+
+    from petastorm_tpu.flight_recorder import DUMP_DIR_PREFIX
+    # What an env-armed run can actually leak into the shared tempdir:
+    # flight-recorder dump dirs (pst-flight-*), trace dirs following the
+    # documented /tmp/pst-trace convention, and bare sidecar files from a
+    # PETASTORM_TPU_TRACE_DIR pointed at the tempdir itself.
+    tmp = tempfile.gettempdir()
+    patterns = [os.path.join(tmp, 'pst-trace*'),
+                os.path.join(tmp, 'trace-*.jsonl'),
+                os.path.join(tmp, DUMP_DIR_PREFIX + '*')]
+    before = {p for pat in patterns for p in glob.glob(pat)}
+    yield
+    for pat in patterns:
+        for leaked in set(glob.glob(pat)) - before:
+            if os.path.isdir(leaked):
+                shutil.rmtree(leaked, ignore_errors=True)
+            else:
+                try:
+                    os.unlink(leaked)
+                except OSError:
+                    pass
+
 
 @pytest.fixture(autouse=True)
 def _chunk_store_dir_guard(request):

@@ -1,14 +1,32 @@
-"""Tracer tests: spans recorded, chrome-trace export valid, loader wiring."""
+"""Tracer tests: spans recorded, chrome-trace export valid, loader wiring,
+cross-process sidecar spill + merge (subprocess harness, torn-file
+tolerance), and the trace_merge CLI."""
 
 import json
+import os
+import subprocess
+import sys
+import time
 
 import numpy as np
+import pytest
 
-from petastorm_tpu.trace import NullTracer, Tracer
+import petastorm_tpu
+from petastorm_tpu.trace import (TRACE_DIR_ENV, NullTracer, Tracer,
+                                 read_sidecar_file)
+
+pytestmark = pytest.mark.observability
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(petastorm_tpu.__file__))
+
+
+def _child_env():
+    env = dict(os.environ)
+    env['PYTHONPATH'] = _REPO_ROOT + os.pathsep + env.get('PYTHONPATH', '')
+    return env
 
 
 def test_spans_and_summary():
-    import time
     tracer = Tracer()
     with tracer.span('decode', 'worker'):
         time.sleep(0.01)
@@ -16,11 +34,34 @@ def test_spans_and_summary():
         time.sleep(0.01)
     tracer.instant('epoch-end')
     s = tracer.summary()
-    assert s['decode'] >= 0.02
+    assert s['decode']['count'] == 2
+    assert s['decode']['total_s'] >= 0.02
     assert len(tracer.events) == 3
 
 
-def test_chrome_trace_export(tmp_path):
+def test_summary_percentiles():
+    tracer = Tracer()
+    # Synthesize spans with known durations: 100 at ~1ms, 1 at ~500ms.
+    for dur_us in [1000.0] * 100 + [500000.0]:
+        tracer._append({'name': 'op', 'cat': 'x', 'ph': 'X', 'ts': 0.0,
+                        'dur': dur_us, 'pid': os.getpid(), 'tid': 1})
+    s = tracer.summary()['op']
+    assert s['count'] == 101
+    assert abs(s['p50_s'] - 0.001) < 1e-6
+    assert s['p99_s'] >= 0.001        # tail pulled up, median not
+    assert s['p99_s'] <= 0.5
+
+
+def test_events_carry_real_pid():
+    tracer = Tracer()
+    with tracer.span('x'):
+        pass
+    tracer.instant('y')
+    tracer.counter('z', 1)
+    assert all(e['pid'] == os.getpid() for e in tracer.events)
+
+
+def test_chrome_trace_export_atomic(tmp_path):
     tracer = Tracer()
     with tracer.span('stage', 'device'):
         pass
@@ -28,6 +69,11 @@ def test_chrome_trace_export(tmp_path):
     doc = json.load(open(path))
     (e,) = [x for x in doc['traceEvents'] if x['ph'] == 'X']
     assert e['name'] == 'stage' and 'dur' in e and 'ts' in e
+    # process_name metadata labels this pid's track
+    meta = [x for x in doc['traceEvents'] if x.get('ph') == 'M']
+    assert any(m['pid'] == os.getpid() for m in meta)
+    # atomic: no tmp leftovers next to the output
+    assert [f for f in os.listdir(str(tmp_path))] == ['trace.json']
 
 
 def test_bounded_events():
@@ -43,7 +89,132 @@ def test_null_tracer_is_noop():
     with t.span('x'):
         pass
     t.instant('y')
+    t.counter('z', 1)
+    t.close()
 
+
+# ---------------------------------------------------------------------------
+# sidecar spill + merge
+# ---------------------------------------------------------------------------
+
+def test_sidecar_spill_writes_header_and_events(tmp_path):
+    d = str(tmp_path / 'spill')
+    tracer = Tracer(spill_dir=d, role='unit')
+    with tracer.span('decode', 'worker'):
+        pass
+    tracer.instant('mark')
+    tracer.close()
+    (path,) = [os.path.join(d, f) for f in os.listdir(d)]
+    header, events = read_sidecar_file(path)
+    assert header['pid'] == os.getpid()
+    assert header['role'] == 'unit'
+    assert 'wall0' in header
+    assert [e['name'] for e in events] == ['decode', 'mark']
+
+
+def test_sidecar_spill_bounded(tmp_path):
+    d = str(tmp_path / 'spill')
+    tracer = Tracer(spill_dir=d, spill_max_events=3)
+    for i in range(10):
+        tracer.instant('e{}'.format(i))
+    tracer.close()
+    header, events = read_sidecar_file(tracer.spill_path)
+    # 3 events + one truncation marker; memory ring still has all 10
+    names = [e['name'] for e in events]
+    assert names[:3] == ['e0', 'e1', 'e2']
+    assert 'trace-spill-truncated' in names
+    assert len(tracer.events) == 10
+
+
+def test_merge_subprocess_sidecars(tmp_path):
+    """Two child processes spill sidecars; the parent merges them into its
+    own timeline under distinct real pids, aligned on the wall clock."""
+    d = str(tmp_path / 'spill')
+    child = (
+        "import sys, time\n"
+        "sys.path.insert(0, {root!r})\n"
+        "from petastorm_tpu.trace import Tracer\n"
+        "t = Tracer(spill_dir={d!r}, role='worker-t')\n"
+        "with t.span('decode', 'worker'):\n"
+        "    time.sleep(0.01)\n"
+        "t.close()\n").format(root=_REPO_ROOT, d=d)
+    for _ in range(2):
+        subprocess.check_call([sys.executable, '-c', child],
+                              env=_child_env())
+    parent = Tracer(spill_dir=False)
+    with parent.span('assemble', 'host'):
+        pass
+    assert parent.merge_process_files(d) == 2
+    pids = {e['pid'] for e in parent.events}
+    assert os.getpid() in pids and len(pids) == 3
+    decode_pids = {e['pid'] for e in parent.events if e['name'] == 'decode'}
+    assert os.getpid() not in decode_pids and len(decode_pids) == 2
+    # merged spans land in the summary alongside local ones
+    s = parent.summary()
+    assert s['decode']['count'] == 2 and s['assemble']['count'] == 1
+    # export labels every process track
+    doc = json.load(open(parent.export_chrome_trace(
+        str(tmp_path / 'merged.json'))))
+    labeled = {m['pid'] for m in doc['traceEvents'] if m.get('ph') == 'M'}
+    assert pids <= labeled
+
+
+def test_merge_tolerates_torn_and_corrupt_lines(tmp_path):
+    """A worker SIGKILLed mid-write leaves a torn trailing line; merge must
+    read every complete line and skip the garbage."""
+    d = str(tmp_path / 'spill')
+    writer = Tracer(spill_dir=d, role='doomed')
+    with writer.span('decode', 'worker'):
+        pass
+    with writer.span('decode', 'worker'):
+        pass
+    writer.close()
+    with open(writer.spill_path, 'a') as f:
+        f.write('{"name": "torn-eve')       # torn tail (no newline, cut JSON)
+    with open(os.path.join(d, 'trace-999-deadbeef.jsonl'), 'w') as f:
+        f.write('not json at all\n')        # fully corrupt sidecar
+        f.write(json.dumps({'name': 'late', 'ph': 'i', 'ts': 1.0,
+                            'pid': 999, 'tid': 1}) + '\n')
+    parent = Tracer(spill_dir=False)
+    assert parent.merge_process_files(d) == 2
+    names = [e['name'] for e in parent.events]
+    assert names.count('decode') == 2
+    assert 'late' in names
+    assert not any('torn' in n for n in names)
+
+
+def test_merge_since_wall0_skips_stale_runs(tmp_path):
+    """A reused trace dir holds a previous run's sidecars; since_wall0
+    (an anchor captured before the pipeline was built) excludes them."""
+    d = str(tmp_path / 'spill')
+    old = Tracer(spill_dir=d, role='previous-run')
+    old._wall0 -= 3600.0        # pretend it anchored an hour ago
+    with old.span('decode', 'worker'):
+        pass
+    old.close()
+    cutoff = __import__('time').time() - 60.0
+    fresh = Tracer(spill_dir=d, role='current-run')
+    with fresh.span('decode', 'worker'):
+        pass
+    fresh.close()
+    parent = Tracer(spill_dir=False)
+    assert parent.merge_process_files(d, since_wall0=cutoff) == 1
+    assert sum(1 for e in parent.events if e['name'] == 'decode') == 1
+    # and without the cutoff both runs merge (the documented hazard)
+    parent2 = Tracer(spill_dir=False)
+    assert parent2.merge_process_files(d) == 2
+
+
+def test_merge_requires_a_directory(monkeypatch):
+    monkeypatch.delenv(TRACE_DIR_ENV, raising=False)
+    tracer = Tracer(spill_dir=False)
+    with pytest.raises(ValueError, match='spill directory'):
+        tracer.merge_process_files()
+
+
+# ---------------------------------------------------------------------------
+# pipeline wiring
+# ---------------------------------------------------------------------------
 
 def test_loader_records_pipeline_spans(synthetic_dataset):
     from petastorm_tpu import make_tensor_reader
@@ -58,4 +229,83 @@ def test_loader_records_pipeline_spans(synthetic_dataset):
                 np.asarray(b.id)
     names = {e['name'] for e in tracer.events}
     assert {'assemble', 'stage', 'wait'} <= names
-    assert tracer.summary()['stage'] > 0
+    assert tracer.summary()['stage']['total_s'] > 0
+
+
+def test_thread_pool_worker_spans_via_global_tracer(synthetic_dataset):
+    """Thread-pool workers run in-process: with a global tracer installed
+    their read/decode/handoff spans land on the same timeline."""
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.trace import set_global_tracer
+
+    tracer = Tracer()
+    previous = set_global_tracer(tracer)
+    try:
+        with make_tensor_reader(synthetic_dataset.url,
+                                schema_fields=['id', 'matrix'],
+                                reader_pool_type='thread', workers_count=2,
+                                shuffle_row_groups=False) as reader:
+            for _ in reader:
+                pass
+    finally:
+        set_global_tracer(previous)
+    names = {e['name'] for e in tracer.events}
+    assert {'read', 'decode', 'handoff'} <= names
+
+
+@pytest.mark.processpool
+def test_process_pool_merged_trace(synthetic_dataset, tmp_path, monkeypatch):
+    """The acceptance path: a process-pool tensor-reader run exports ONE
+    merged Chrome trace where worker-process decode spans sit under
+    distinct (non-parent) pids alongside the loader-side spans."""
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    trace_dir = str(tmp_path / 'trace')
+    monkeypatch.setenv(TRACE_DIR_ENV, trace_dir)
+    tracer = Tracer(spill_dir=False)   # parent stays in-memory; workers spill
+    with make_tensor_reader(synthetic_dataset.url,
+                            schema_fields=['id', 'matrix'],
+                            reader_pool_type='process-zmq', workers_count=2,
+                            shuffle_row_groups=False) as reader:
+        with JaxLoader(reader, 10, tracer=tracer, last_batch='drop') as loader:
+            batches = sum(1 for _ in loader)
+    assert batches == 5
+    assert tracer.merge_process_files(trace_dir) >= 1
+    decode_pids = {e['pid'] for e in tracer.events if e['name'] == 'decode'}
+    assert decode_pids and os.getpid() not in decode_pids
+    loader_spans = {e['name'] for e in tracer.events
+                    if e['pid'] == os.getpid() and e['ph'] == 'X'}
+    assert {'assemble', 'stage'} <= loader_spans
+    doc = json.load(open(tracer.export_chrome_trace(
+        str(tmp_path / 'merged.json'))))
+    trace_names = {e.get('name') for e in doc['traceEvents']}
+    assert {'decode', 'read', 'handoff', 'assemble', 'process_name'} \
+        <= trace_names
+
+
+def test_trace_merge_cli(tmp_path):
+    d = str(tmp_path / 'spill')
+    writer = Tracer(spill_dir=d, role='worker-cli')
+    with writer.span('decode', 'worker'):
+        pass
+    writer.close()
+    out = str(tmp_path / 'merged.json')
+    result = subprocess.run(
+        [sys.executable, '-m', 'petastorm_tpu.tools.trace_merge',
+         '--dir', d, '--out', out, '--summary'],
+        env=_child_env(), capture_output=True, text=True, check=True)
+    report = json.loads(result.stdout)
+    assert report['merged_files'] == 1
+    assert report['summary']['decode']['count'] == 1
+    doc = json.load(open(out))
+    assert any(e.get('name') == 'decode' for e in doc['traceEvents'])
+
+
+def test_trace_merge_cli_empty_dir(tmp_path):
+    result = subprocess.run(
+        [sys.executable, '-m', 'petastorm_tpu.tools.trace_merge',
+         '--dir', str(tmp_path)],
+        env=_child_env(), capture_output=True, text=True)
+    assert result.returncode == 1
+    assert 'no sidecar files' in result.stderr
