@@ -22,6 +22,8 @@ from petastorm_tpu.errors import (PipelineStallError,  # noqa: F401
                                   RowGroupQuarantinedError, WorkerLostError)
 from petastorm_tpu.flight_recorder import FlightRecorder  # noqa: F401
 from petastorm_tpu.job_checkpoint import JobCheckpointer  # noqa: F401
+from petastorm_tpu.lineage import (LineageTracker,  # noqa: F401
+                                   replay_record, verify_record)
 from petastorm_tpu.metrics import (MetricsExporter,  # noqa: F401
                                    MetricsRegistry, start_http_exporter)
 from petastorm_tpu.reader import (Reader, make_batch_reader,  # noqa: F401

@@ -26,6 +26,9 @@ from petastorm_tpu.workers.rowgroup_worker_base import (RowGroupWorkerBase,
 class ArrowWorker(RowGroupWorkerBase):
     """Same args dict as PyDictWorker (see its docstring)."""
 
+    #: Reader-mode tag for batch provenance contexts (lineage.py).
+    lineage_mode = 'arrow'
+
     def process(self, piece_index, worker_predicate=None, shuffle_row_drop_partition=None):
         from petastorm_tpu.faults import maybe_inject, rowgroup_fault_key
 
@@ -39,7 +42,7 @@ class ArrowWorker(RowGroupWorkerBase):
         # three-span vocabulary as the dict/tensor workers on a merged
         # timeline even though codecs don't run here.
         with get_global_tracer().span('decode', 'worker'):
-            table = self._load_table_cached(piece, worker_predicate)
+            table, read_fresh = self._load_table_cached(piece, worker_predicate)
         if table is None or table.num_rows == 0:
             return
 
@@ -65,10 +68,25 @@ class ArrowWorker(RowGroupWorkerBase):
             table = table.take(pa.array(perm))
 
         if table.num_rows:
-            # Ventilation key rides in the schema metadata (survives the Arrow
-            # IPC serializer) for checkpoint/resume consumption tracking.
+            import json as json_mod
+
+            from petastorm_tpu.lineage import chunk_lineage
+            # Ventilation key + provenance segment ride in the schema
+            # metadata (survives the Arrow IPC serializer) for checkpoint/
+            # resume tracking and the batch provenance ledger. Arrow mode
+            # ships raw cells, so a cache hit serves the same bytes a read
+            # would — the tier distinguishes disk-cache hits from reads.
             md = dict(table.schema.metadata or {})
             md[b'pst.key'] = chunk_key(piece_index, shuffle_row_drop_partition).encode()
+            tier = ('decode' if read_fresh
+                    else getattr(self.args['cache'], 'lineage_tier', 'cache'))
+            lineage = chunk_lineage(
+                piece, piece_index, shuffle_row_drop_partition,
+                table.num_rows, tier,
+                permuted=bool(self.args.get('shuffle_rows_in_chunk')),
+                filtered=worker_predicate is not None,
+                worker_id=self.worker_id)
+            md[b'pst.lineage'] = json_mod.dumps(lineage).encode()
             with get_global_tracer().span('handoff', 'worker'):
                 self.publish_func(table.replace_schema_metadata(md))
 
@@ -86,23 +104,28 @@ class ArrowWorker(RowGroupWorkerBase):
     # --- loading ------------------------------------------------------
 
     def _load_table_cached(self, piece, worker_predicate):
+        """``(table, read_fresh)`` — the flag says whether this call paid a
+        store read (lineage tier 'decode') or was served by the cache."""
         schema = self.args['schema']
         field_names = list(schema.fields)
         partition_names = set(self.args['partition_names'])
         physical = [n for n in field_names if n not in partition_names]
 
         if worker_predicate is not None:
-            return self._load_with_predicate(piece, physical, field_names, worker_predicate)
+            return (self._load_with_predicate(piece, physical, field_names,
+                                              worker_predicate), True)
 
         cache_key = '{}:{}:{}:{}'.format(
             self.args['dataset_path_hash'], piece.path, piece.row_group,
             hashlib.md5(','.join(field_names).encode()).hexdigest()[:8])
+        fresh = []
 
         def load():
+            fresh.append(True)
             table = self._read_row_group(piece, physical)
             return self._append_partition_columns(table, piece, field_names)
 
-        return self.args['cache'].get(cache_key, load)
+        return self.args['cache'].get(cache_key, load), bool(fresh)
 
     def _append_partition_columns(self, table, piece, field_names):
         for name, value in piece.partition_values.items():
@@ -147,25 +170,44 @@ class ArrowResultsQueueReader(DeferredRowAccounting):
     ``enable_deferred_rows`` (see ``checkpoint.DeferredRowAccounting``).
     """
 
+    _last_lineage = None
+
     @property
     def batched_output(self):
         return True
 
+    @property
+    def last_chunk_lineage(self):
+        """Provenance segment of the most recent chunk (see
+        ``TensorResultsQueueReader.last_chunk_lineage``)."""
+        return self._last_lineage
+
     def read_next(self, pool, schema, ngram):
+        import json as json_mod
         if ngram is not None:
             raise NotImplementedError('NGram is not supported with batch (Arrow) readers '
                                       '(parity: arrow_reader_worker.py:97-98)')
         while True:
             table = pool.get_results()
-            key = (table.schema.metadata or {}).get(b'pst.key')
+            md = table.schema.metadata or {}
+            key = md.get(b'pst.key')
             key = key.decode() if key is not None else None
+            lineage = md.get(b'pst.lineage')
+            if lineage is not None:
+                try:
+                    lineage = json_mod.loads(lineage.decode())
+                except ValueError:
+                    lineage = None
             if self._tracker is not None and key is not None:
                 skip = self._tracker.on_chunk(key, table.num_rows)
                 if skip:
                     table = table.slice(skip)
+                    if lineage is not None:
+                        lineage['row_start'] = lineage.get('row_start', 0) + skip
                 if table.num_rows == 0:
                     continue
                 self._record_chunk(key, table.num_rows)
+            self._last_lineage = lineage
             break
         columns = {}
         for name in schema.fields:

@@ -24,6 +24,11 @@ from petastorm_tpu.errors import CorruptChunkError
 
 
 class CacheBase(object):
+    #: Serving-tier label stamped into batch provenance segments
+    #: (``petastorm_tpu.lineage``) when a worker's chunk comes out of this
+    #: cache instead of a fresh decode.
+    lineage_tier = 'cache'
+
     def get(self, key, fill_cache_func):
         """Return the cached value for ``key``; on miss call ``fill_cache_func``
         and store its result."""
@@ -35,6 +40,8 @@ class CacheBase(object):
 
 class NullCache(CacheBase):
     """No-op cache: always calls the fill function."""
+
+    lineage_tier = 'decode'     # every get() is a fresh decode
 
     def get(self, key, fill_cache_func):
         return fill_cache_func()
@@ -57,6 +64,8 @@ class MemoryCache(CacheBase):
     (``petastorm_tpu.chunk_store``) for cross-process sharing of decoded
     chunks on NVMe.
     """
+
+    lineage_tier = 'memory'
 
     def __init__(self, size_limit_bytes=None):
         from collections import OrderedDict
@@ -157,6 +166,7 @@ class LocalDiskCache(CacheBase):
     """
 
     _SUFFIX = '.pkl'
+    lineage_tier = 'disk'
 
     def __init__(self, path, size_limit=None, expected_row_size_bytes=None,
                  shards=None, cleanup=False, **_):

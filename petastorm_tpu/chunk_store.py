@@ -344,6 +344,9 @@ class DecodedChunkStore(CacheBase):
 
     #: Diagnostics gate (``Reader.diagnostics()['chunk_store']``).
     is_chunk_store = True
+    #: Provenance serving-tier label (``petastorm_tpu.lineage``): a chunk
+    #: served from this store is an NVMe mmap hit, not a fresh decode.
+    lineage_tier = 'chunk-store'
 
     def __init__(self, path=None, size_limit=None, writer_queue_depth=16,
                  throttle_delay_s=0.05, validate='open', cleanup=False,

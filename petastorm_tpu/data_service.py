@@ -205,12 +205,17 @@ class DataServer(object):
         retry loop (derived control/rpc ports may clash with unrelated
         sockets); defaults to a short jittered-backoff policy retrying
         only ``zmq.ZMQError``.
+    :param lineage: ship each chunk's provenance segment on the wire
+        (``petastorm_tpu.lineage``; default True). Set False while any
+        consumer predates the sidecar — an old trainer crashes unpacking
+        the reserved ``__pst_lineage__`` key.
     """
 
     def __init__(self, reader, bind, control_bind=None, rpc_bind=None,
                  sndhwm=4, auth_key=None, snapshot_path=None,
                  snapshot_every=16, snapshot_resume=None,
-                 replay_ring_chunks=None, bind_retry_policy=None):
+                 replay_ring_chunks=None, bind_retry_policy=None,
+                 lineage=True):
         import zmq
 
         if not getattr(reader, 'batched_output', False):
@@ -222,6 +227,11 @@ class DataServer(object):
                 'make_batch_reader); got a per-row reader. Per-row decode '
                 'belongs on the trainer for row-granular pipelines.')
         self._reader = reader
+        # The provenance sidecar adds a reserved '__pst_lineage__' key to
+        # every wire payload; consumers older than it crash unpacking the
+        # chunk (underscore namedtuple field), so a mixed-version fleet
+        # disables it server-side until every trainer is upgraded.
+        self._lineage_enabled = bool(lineage)
         self._zmq = zmq
         from petastorm_tpu import metrics as metrics_mod
         self._m_served = metrics_mod.counter(
@@ -367,8 +377,19 @@ class DataServer(object):
                     sample = next(rows)
                 except StopIteration:
                     break
-                frames = _dump_frames(
-                    {name: getattr(sample, name) for name in sample._fields})
+                payload = {name: getattr(sample, name)
+                           for name in sample._fields}
+                # Batch provenance across the wire (petastorm_tpu.lineage):
+                # the chunk's segment rides a reserved key next to the
+                # column blocks (tiny next to MB payloads; the consumer
+                # pops it before the columns reach the loader).
+                chunk_lineage = getattr(self._reader, 'last_chunk_lineage',
+                                        None) \
+                    if self._lineage_enabled else None
+                if chunk_lineage is not None:
+                    payload['__pst_lineage__'] = {'seg': chunk_lineage,
+                                                  'endpoint': self.data_endpoint}
+                frames = _dump_frames(payload)
                 seq = self._served_chunks
                 self._ring.append((seq, frames))
                 if not self._send_chunk(seq, frames, count=True):
@@ -554,6 +575,13 @@ class DataServer(object):
             # without a store connection of their own.
             return {'schema': getattr(self._reader, 'transformed_schema', None),
                     'ngram': getattr(self._reader, 'ngram', None)}
+        if cmd == 'lineage_ctx':
+            # The serving reader's provenance context (petastorm_tpu.
+            # lineage): what a trainer-side ledger needs so its records of
+            # remote batches stay replayable against the source dataset.
+            ctx_fn = getattr(self._reader, 'lineage_context', None)
+            return {'server_id': self._server_id,
+                    'ctx': ctx_fn() if ctx_fn is not None else None}
         if cmd == 'metrics':
             # This server process's full metrics-registry snapshot
             # (petastorm_tpu.metrics — JSON-safe, so the pickle reply is
@@ -635,7 +663,7 @@ def load_server_snapshot(path):
 def serve_dataset(dataset_url, bind, reader_factory=None, start=True,
                   sndhwm=4, auth_key=None, snapshot_path=None,
                   snapshot_every=16, snapshot_resume=None,
-                  replay_ring_chunks=None, **reader_kwargs):
+                  replay_ring_chunks=None, lineage=True, **reader_kwargs):
     """Convenience: build a tensor reader over ``dataset_url`` and serve it.
 
     Returns the started :class:`DataServer` (context-manage it). Extra
@@ -669,7 +697,8 @@ def serve_dataset(dataset_url, bind, reader_factory=None, start=True,
                             snapshot_path=snapshot_path,
                             snapshot_every=snapshot_every,
                             snapshot_resume=snapshot_resume,
-                            replay_ring_chunks=replay_ring_chunks)
+                            replay_ring_chunks=replay_ring_chunks,
+                            lineage=lineage)
     except Exception:
         # e.g. bind: address already in use — don't leak the started pool.
         reader.stop()
@@ -808,6 +837,7 @@ class RemoteReader(object):
         self._server_errors = {}
         self._stopped = False
         self._nt_cache = {}
+        self._last_lineage = None   # provenance of the latest chunk
         self._chunks = 0        # unique chunks received (dupes excluded)
         self._auth_key = auth_key
         self._seen = {}         # server_id -> _SeqTracker (under _acct_lock)
@@ -968,10 +998,51 @@ class RemoteReader(object):
     def _deliver(self, cols):
         """Chunk is leaving the reader: retain it for row-granular
         checkpoint accounting (caller holds _acct_lock or is pre-start)."""
+        info = cols.pop('__pst_lineage__', None)
+        if info is not None:
+            # Trainer-side provenance: keep the server-side segment (path,
+            # row-group, worker, upstream tier) but re-tier it as 'remote'
+            # — that IS this trainer's serving tier; the decode-side tier
+            # survives as remote_tier for audits.
+            segment = dict(info.get('seg') or {})
+            segment['remote_tier'] = segment.get('tier')
+            segment['tier'] = 'remote'
+            segment['endpoint'] = info.get('endpoint')
+            self._last_lineage = segment
+        else:
+            self._last_lineage = None
         if self._row_granular:
             first = next(iter(cols.values()))
             self._unacked.append((cols, len(first)))
         return self._to_namedtuple(cols)
+
+    @property
+    def last_chunk_lineage(self):
+        """Provenance segment of the most recently delivered chunk
+        (``petastorm_tpu.lineage``), tier ``'remote'`` with the serving
+        endpoint and the server-side tier under ``remote_tier``."""
+        return self._last_lineage
+
+    def lineage_context(self):
+        """Provenance context for a trainer-side ledger: the first
+        answering server's own reader context (dataset url, schema hash,
+        seed — what replay needs) wrapped with the service endpoints.
+        Falls back to a minimal non-replayable context when no server
+        answers the ``lineage_ctx`` rpc."""
+        ctx = None
+        for endpoint in self._rpc_endpoints:
+            try:
+                reply = self._one_shot_rpc(endpoint, {'cmd': 'lineage_ctx'})
+            except Exception:  # noqa: BLE001 - any server may do
+                reply = None
+            if reply is not None and reply.get('ctx'):
+                ctx = dict(reply['ctx'])
+                break
+        if ctx is None:
+            ctx = {'mode': None}
+        ctx['remote'] = True
+        ctx['rpc_endpoints'] = list(self._rpc_endpoints)
+        return ctx
 
     # -- row-granular checkpoint protocol (JaxLoader probes by hasattr) --
 

@@ -15,6 +15,8 @@ is minted) dumps everything to a timestamped directory::
         metrics_ring.json # recent periodic registry samples (wall-clocked)
         diagnosis.json    # classification, stage, detail, beats, probes
         stacks.txt        # the all-thread stack dump
+        lineage.json      # every live provenance ring (petastorm_tpu.
+                          # lineage): the exact rows in flight at the stall
 
 Arm it process-wide by pointing the ``PETASTORM_TPU_FLIGHT_RECORDER``
 environment variable at a directory (the watchdog-owning Reader/JaxLoader
@@ -111,6 +113,7 @@ class FlightRecorder(object):
         self._write_trace(os.path.join(path, 'trace.json'))
         self._write_metrics(path)
         self._write_diagnosis(path, diagnosis)
+        self._write_lineage(path)
         with self._lock:
             self.dumps.append(path)
         logger.warning('flight recorder dumped stall evidence to %s', path)
@@ -142,6 +145,20 @@ class FlightRecorder(object):
                 json.dump(samples, f, default=repr)
         except Exception:  # noqa: BLE001
             logger.debug('flight recorder ring dump failed', exc_info=True)
+
+    def _write_lineage(self, dump_dir):
+        """Every live tracker's provenance ring (the last N batch records,
+        with their reader contexts) — what names the exact rows that were
+        in flight when the pipeline stalled. Trackers register themselves
+        process-wide (``lineage.live_rings``), so no construction-order
+        coupling with the watchdog; an unarmed pipeline writes ``[]``."""
+        try:
+            from petastorm_tpu import lineage
+            rings = lineage.live_rings()
+            with open(os.path.join(dump_dir, 'lineage.json'), 'w') as f:
+                json.dump(rings, f, default=repr)
+        except Exception:  # noqa: BLE001
+            logger.debug('flight recorder lineage dump failed', exc_info=True)
 
     def _write_diagnosis(self, dump_dir, diagnosis):
         if diagnosis is None:

@@ -136,7 +136,8 @@ def _sanitize_array(array, x64=False):
 def iter_numpy_batches(reader, batch_size, shape_policies=None,
                        shuffling_queue_capacity=0, min_after_dequeue=None,
                        seed=None, last_batch='drop', x64=False,
-                       strict_fields=False, batch_buffers=None, views_ok=True):
+                       strict_fields=False, batch_buffers=None, views_ok=True,
+                       lineage=None):
     """Yield dicts of numpy arrays with exact leading dim ``batch_size``.
 
     Works over both row readers (``make_reader``) and batch readers
@@ -157,6 +158,11 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
     ``views_ok=False`` additionally forces batches that would be zero-copy
     chunk views into the buffers — transfer backends that don't alias host
     memory prefer stable recycled buffers over views.
+
+    ``lineage`` (a :class:`petastorm_tpu.lineage.LineageCollector`): batch
+    provenance capture — each arriving chunk's segment metadata is pushed
+    and each emitted batch pops the FIFO spans composing it (exact without
+    a shuffling buffer; a shuffling buffer flags records inexact).
     """
     if last_batch not in ('drop', 'pad', 'partial'):
         raise ValueError("last_batch must be drop|pad|partial, got {!r}".format(last_batch))
@@ -175,6 +181,11 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
         shuffler = RandomShufflingBuffer(shuffling_queue_capacity,
                                          min_after_dequeue, seed=seed,
                                          extra_capacity=100000)
+        if lineage is not None:
+            # Row-level shuffling breaks the FIFO chunk->batch mapping:
+            # records still name the contributing chunks, but row spans
+            # are no longer exact (replay refuses such records).
+            lineage.mark_inexact()
 
     def _is_tensor_like(probe, name):
         """True if a sample value can become a TPU tensor (possibly via policy)."""
@@ -269,6 +280,8 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
                 # batch is pure overhead — stop asking for them.
                 arenas_effective = any(batch[name] is out_bufs[name]
                                        for name in field_names)
+            if lineage is not None:
+                lineage.on_batch(batch_size, batch=batch)
             yield batch
         if final and count:
             if last_batch == 'drop':
@@ -276,6 +289,7 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
                 count = 0
             elif last_batch in ('pad', 'partial'):
                 batch = {}
+                source_rows = count
                 for name in field_names:
                     col = columns[name]
                     if last_batch == 'pad':
@@ -283,6 +297,10 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
                     batch[name] = _stack_column(col, name, shape_policies, x64)
                 columns = {}
                 count = 0
+                if lineage is not None:
+                    lineage.on_batch(source_rows, batch=batch,
+                                     padded=(batch_size - source_rows
+                                             if last_batch == 'pad' else 0))
                 yield batch
 
     if getattr(reader, 'batched_output', False) and shuffler is None:
@@ -296,7 +314,7 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
         yield from _iter_block_batches(reader, batch_size, shape_policies,
                                        last_batch, x64, strict_fields,
                                        batch_buffers=batch_buffers,
-                                       views_ok=views_ok)
+                                       views_ok=views_ok, lineage=lineage)
         return
 
     for sample in reader:
@@ -306,6 +324,9 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
             rows = to_rows(sample)
         else:
             rows = [tuple(getattr(sample, n) for n in field_names)]
+        if lineage is not None:
+            lineage.on_chunk(getattr(reader, 'last_chunk_lineage', None),
+                             len(rows))
         if shuffler is not None:
             shuffler.add_many(rows)
             while shuffler.can_retrieve():
@@ -335,7 +356,8 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
 
 
 def _iter_block_batches(reader, batch_size, shape_policies, last_batch, x64,
-                        strict_fields, batch_buffers=None, views_ok=True):
+                        strict_fields, batch_buffers=None, views_ok=True,
+                        lineage=None):
     """Fixed-size batches assembled from column blocks (no per-row Python).
 
     Chunks (one per row-group) are sanitized once on arrival; batches are
@@ -473,13 +495,24 @@ def _iter_block_batches(reader, batch_size, shape_policies, last_batch, x64,
             from petastorm_tpu.staging import willneed_arrays
             willneed_arrays(chunk.values())
         chunks.append([chunk, private or all_copied])
-        have += len(chunk[field_names[0]]) if field_names else 0
+        chunk_rows = len(chunk[field_names[0]]) if field_names else 0
+        have += chunk_rows
+        if lineage is not None:
+            lineage.on_chunk(getattr(reader, 'last_chunk_lineage', None),
+                             chunk_rows)
         while have >= batch_size:
-            yield take(batch_size)
+            batch = take(batch_size)
+            if lineage is not None:
+                lineage.on_batch(batch_size, batch=batch)
+            yield batch
 
     if have and field_names:
         if last_batch == 'partial':
-            yield take(have)
+            source_rows = have
+            batch = take(have)
+            if lineage is not None:
+                lineage.on_batch(source_rows, batch=batch)
+            yield batch
         elif last_batch == 'pad':
             # Repeat-pad the tail into a full-size buffer. Never in place:
             # the tail chunk may be a cache-shared block, which is strictly
@@ -494,7 +527,10 @@ def _iter_block_batches(reader, batch_size, shape_policies, last_batch, x64,
                 pos += k
             for name in field_names:
                 out[name][pos:] = out[name][pos - 1]
-            have = 0
+            source_rows, have = have, 0
+            if lineage is not None:
+                lineage.on_batch(source_rows, batch=out,
+                                 padded=batch_size - source_rows)
             yield out
 
 
@@ -613,6 +649,19 @@ class JaxLoader(object):
         :class:`~petastorm_tpu.autotune.AutotuneConfig` for custom clamps
         and pacing; ``None`` defers to ``PETASTORM_TPU_AUTOTUNE``. The
         decision log and knob trajectory ride ``stats['autotune']``.
+    :param lineage: batch provenance ledger (``petastorm_tpu.lineage``):
+        every delivered batch gets a record — monotonic batch id, the
+        ordered (parquet file, row-group, row-range) spans composing it,
+        producing worker + serving tier per span, shuffle state, and a
+        per-field CRC32 content digest — kept in a ring (dumped by the
+        stall flight recorder) and spilled to a crash-tolerant JSONL
+        ledger replayable with ``python -m petastorm_tpu.tools.replay``.
+        ``True`` arms it (ledger dir from ``PETASTORM_TPU_LINEAGE_DIR``
+        or a fresh temp dir); a string is the ledger directory; a
+        :class:`~petastorm_tpu.lineage.LineageTracker` is adopted as-is;
+        ``None`` defers to the environment variable; ``False`` disables.
+        The record of the latest batch is ``last_batch_provenance``;
+        counters ride ``stats['lineage']``.
     """
 
     def __init__(self, reader, batch_size, mesh=None, sharding=None,
@@ -620,7 +669,8 @@ class JaxLoader(object):
                  shuffling_queue_capacity=0, min_after_dequeue=None, seed=None,
                  last_batch='drop', strict_fields=False, echo=1, tracer=None,
                  stage_chunks=1, arena_depth=None, inflight=2,
-                 watchdog=None, stall_timeout_s=None, autotune=None):
+                 watchdog=None, stall_timeout_s=None, autotune=None,
+                 lineage=None):
         import jax
 
         if tracer is None:
@@ -709,6 +759,33 @@ class JaxLoader(object):
             attach = getattr(reader, 'attach_health', None)
             if attach is not None:
                 attach(self._health.registry)
+        # Batch provenance (petastorm_tpu.lineage): ring + ledger of what
+        # exactly composed every delivered batch. Collector hooks ride the
+        # host-batch iterators; records are minted at delivery in __next__.
+        from petastorm_tpu import lineage as lineage_mod
+        self._lineage = None
+        self._lineage_owned = False
+        self._last_provenance = None
+        if isinstance(lineage, lineage_mod.LineageTracker):
+            # Adopted as-is: lifecycle stays with the caller (stop()
+            # flushes but must not close — the caller may ledger several
+            # loaders through one tracker).
+            self._lineage = lineage
+        elif lineage_mod.lineage_enabled(lineage):
+            ctx_fn = getattr(reader, 'lineage_context', None)
+            ctx = ctx_fn() if ctx_fn is not None else {'mode': None}
+            ctx['x64'] = x64
+            ctx['batch_size'] = local_batch
+            ctx['last_batch'] = last_batch
+            ctx['shape_policies'] = sorted(shape_policies) \
+                if shape_policies else None
+            ctx['shuffling_queue_capacity'] = int(shuffling_queue_capacity or 0)
+            self._lineage = lineage_mod.LineageTracker(
+                ctx,
+                ledger_dir=lineage_mod.resolve_ledger_dir(
+                    lineage if isinstance(lineage, str) else None),
+                state_fn=getattr(reader, 'lineage_state', None))
+            self._lineage_owned = True
         self._namedtuple_cache = {}
         # Metrics-registry instruments (petastorm_tpu.metrics): the
         # machine-scrapable mirror of the `stats` dict. Cached here — one
@@ -800,7 +877,9 @@ class JaxLoader(object):
             shuffling_queue_capacity=shuffling_queue_capacity,
             min_after_dequeue=min_after_dequeue, seed=seed,
             last_batch=last_batch, x64=x64, strict_fields=strict_fields,
-            batch_buffers=arena_buffers, views_ok=views_ok)
+            batch_buffers=arena_buffers, views_ok=views_ok,
+            lineage=(self._lineage.collector
+                     if self._lineage is not None else None))
 
         # Start the engine LAST: it touches the state above immediately.
         if not self._consumer_staging:
@@ -819,7 +898,12 @@ class JaxLoader(object):
                 holds_mode=aliasing, tracer=self._tracer,
                 meter=meter,
                 health=self._health.registry
-                if self._health is not None else None).start()
+                if self._health is not None else None,
+                # Provenance accounting is FIFO-paired with delivered
+                # batches: a batch the engine assembles but drops at stop
+                # time must retract its pending record too.
+                on_drop=(self._lineage.drop_newest
+                         if self._lineage is not None else None)).start()
         # The watchdog starts only once every stage had the chance to
         # register, so its first classification sees the full beat table.
         if self._health is not None:
@@ -1121,6 +1205,12 @@ class JaxLoader(object):
         nt = cached_namedtuple(self._namedtuple_cache, 'JaxBatch', names)
         self._batches_delivered += 1
         self._m_batches.inc()
+        if self._lineage is not None and fresh:
+            # Mint this batch's provenance record (FIFO against the host-
+            # batch iterator's collector pushes — the staging engine
+            # preserves delivery order). Echoed re-deliveries reuse the
+            # source batch's record.
+            self._last_provenance = self._lineage.deliver()
         if self._hb_consumer is not None:
             # 'delivered' + stale = the training loop took this batch and
             # never came back (consumer-not-draining, never escalated).
@@ -1269,7 +1359,26 @@ class JaxLoader(object):
             # (grow/shrink/revert/pause with bottleneck classifications),
             # and the knob trajectory over time.
             out['autotune'] = self._autotuner.stats()
+        if self._lineage is not None:
+            # Provenance ledger health: records minted vs dropped, the
+            # write-behind lag, and where the ledger landed on disk.
+            out['lineage'] = self._lineage.stats()
         return out
+
+    @property
+    def last_batch_provenance(self):
+        """The provenance record of the most recently delivered batch
+        (``None`` when ``lineage`` is unarmed): batch id, source spans,
+        serving tiers, shuffle state, content digest. See
+        ``petastorm_tpu.lineage``."""
+        return self._last_provenance
+
+    @property
+    def lineage_tracker(self):
+        """The loader's :class:`~petastorm_tpu.lineage.LineageTracker`
+        (``None`` when unarmed) — ring access for tests and the bench's
+        replay self-check."""
+        return self._lineage
 
     def state_dict(self):
         """Mid-epoch resume state (see ``Reader.state_dict``).
@@ -1313,6 +1422,17 @@ class JaxLoader(object):
             self._engine.stop()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        if self._lineage is not None:
+            if self._lineage_owned:
+                # Drain + close the ledger write-behind (don't leave a
+                # daemon writer spilling into a directory the caller may
+                # be deleting).
+                self._lineage.close()
+            else:
+                # Adopted tracker: the caller owns its lifecycle (it may
+                # ledger another loader next) — just drain what this
+                # loader produced.
+                self._lineage.flush()
         self._reader.stop()
         self._reader.join()
 

@@ -32,6 +32,9 @@ class PyDictWorker(RowGroupWorkerBase):
 
     _prefer_native_parquet = False  # pyarrow is faster for the to-rows path
 
+    #: Reader-mode tag for batch provenance contexts (lineage.py).
+    lineage_mode = 'py_dict'
+
     def process(self, piece_index, worker_predicate=None, shuffle_row_drop_partition=None):
         from petastorm_tpu.faults import maybe_inject, rowgroup_fault_key
 
@@ -41,10 +44,12 @@ class PyDictWorker(RowGroupWorkerBase):
         maybe_inject('decode-corrupt',
                      key=rowgroup_fault_key(piece.path, piece.row_group))
 
+        decoded_fresh = []
         if worker_predicate is not None:
             rows = self._load_rows_with_predicate(piece, worker_predicate)
+            decoded_fresh.append(True)
         else:
-            rows = self._load_rows_cached(piece)
+            rows = self._load_rows_cached(piece, decoded_fresh)
 
         row_slice = compute_row_slice(len(rows), shuffle_row_drop_partition, ngram)
         if row_slice is not None:
@@ -63,11 +68,25 @@ class PyDictWorker(RowGroupWorkerBase):
         if rows:
             # Envelope tags the chunk with its ventilation key so the consumer
             # can track per-row-group consumption for checkpoint/resume
-            # (petastorm_tpu.checkpoint).
+            # (petastorm_tpu.checkpoint), plus its provenance segment for the
+            # batch lineage ledger (petastorm_tpu.lineage). NGram windows
+            # re-index rows (a window is not a storage row), so their
+            # lineage is omitted — batch records over ngrams are inexact.
+            from petastorm_tpu.lineage import chunk_lineage
             from petastorm_tpu.trace import get_global_tracer
+            lineage = None
+            if ngram is None:
+                tier = ('decode' if decoded_fresh
+                        else getattr(self.args['cache'], 'lineage_tier',
+                                     'cache'))
+                lineage = chunk_lineage(
+                    piece, piece_index, shuffle_row_drop_partition, len(rows),
+                    tier, filtered=worker_predicate is not None,
+                    worker_id=self.worker_id)
             with get_global_tracer().span('handoff', 'worker'):
                 self.publish_func({'__pst_chunk__': 1,
                                    'key': chunk_key(piece_index, shuffle_row_drop_partition),
+                                   'lineage': lineage,
                                    'rows': rows})
 
     def _apply_transform(self, row, transform_spec):
@@ -92,7 +111,7 @@ class PyDictWorker(RowGroupWorkerBase):
                     row[name] = value
         return encoded_rows
 
-    def _load_rows_cached(self, piece):
+    def _load_rows_cached(self, piece, decoded_fresh=None):
         schema = self.args['schema']
         if self.args['ngram'] is not None:
             field_names = sorted(self.args['ngram'].get_field_names_at_all_timesteps())
@@ -104,6 +123,8 @@ class PyDictWorker(RowGroupWorkerBase):
 
         def load():
             from petastorm_tpu.trace import get_global_tracer
+            if decoded_fresh is not None:
+                decoded_fresh.append(True)
             encoded_rows = self._read_columns(piece, field_names)
             decode_schema = (self.args['full_schema'].create_schema_view(
                 [n for n in field_names if n in self.args['full_schema'].fields])
@@ -160,6 +181,7 @@ class PyDictResultsQueueReader(object):
         from collections import deque
         self._buffer = deque()
         self._tracker = None
+        self._last_lineage = None
 
     def set_tracker(self, tracker):
         self._tracker = tracker
@@ -168,18 +190,34 @@ class PyDictResultsQueueReader(object):
     def batched_output(self):
         return False
 
+    @property
+    def last_chunk_lineage(self):
+        """Provenance segment of the single row most recently returned:
+        the producing chunk's segment narrowed to that row
+        (``row_start`` = the row's index within the published chunk;
+        consecutive rows coalesce downstream). ``None`` for untagged or
+        ngram payloads."""
+        return self._last_lineage
+
     def read_next(self, pool, schema, ngram):
         while not self._buffer:
             chunk = pool.get_results()
             if isinstance(chunk, dict) and chunk.get('__pst_chunk__'):
                 key, rows = chunk['key'], chunk['rows']
+                lineage = chunk.get('lineage')
             else:  # untagged payload (e.g. a custom worker)
-                key, rows = None, chunk
+                key, rows, lineage = None, chunk, None
             skip = 0
             if self._tracker is not None and key is not None:
                 skip = self._tracker.on_chunk(key, len(rows))
-            self._buffer.extend((key, row) for row in rows[skip:])
-        key, row = self._buffer.popleft()
+            self._buffer.extend(
+                (key, row, lineage, skip + i)
+                for i, row in enumerate(rows[skip:]))
+        key, row, lineage, row_index = self._buffer.popleft()
+        if lineage is not None:
+            self._last_lineage = dict(lineage, row_start=row_index)
+        else:
+            self._last_lineage = None
         if self._tracker is not None and key is not None:
             self._tracker.rows_yielded(key, 1)
         if ngram is not None:

@@ -524,6 +524,14 @@ class Reader(object):
         self._transform_spec = transform_spec
         self._transformed_schema = (transform_schema(self.schema, transform_spec)
                                     if transform_spec is not None else self.schema)
+        # Batch provenance context (petastorm_tpu.lineage): the static,
+        # JSON-safe facts a ledgered batch record needs to be replayed.
+        self._seed = seed
+        self._cur_shard = cur_shard
+        self._shard_count = shard_count
+        self._predicate = predicate
+        self._shuffle_rows_in_chunk = bool(shuffle_rows_in_chunk)
+        self._lineage_mode = getattr(worker_class, 'lineage_mode', None)
 
         if bool(cur_shard is None) != bool(shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
@@ -965,6 +973,53 @@ class Reader(object):
         for readers that don't track ownership — sharing must be assumed."""
         return bool(getattr(self._results_queue_reader, 'last_chunk_private',
                             False))
+
+    @property
+    def last_chunk_lineage(self):
+        """Provenance segment of the most recently yielded chunk/row
+        (``petastorm_tpu.lineage``): the producing row-group span, worker
+        pid/slot, and serving tier. ``None`` when the results-queue
+        reader doesn't attach lineage (e.g. ngram payloads)."""
+        return getattr(self._results_queue_reader, 'last_chunk_lineage', None)
+
+    def lineage_context(self):
+        """The static reader facts a batch provenance record needs for
+        deterministic replay (``petastorm_tpu.lineage.replay_record``):
+        dataset identity + schema hash, shuffle seed, shard, transform/
+        predicate descriptors, and the reader mode that picks the replay
+        decode path. JSON-safe."""
+        transform = None
+        if self._transform_spec is not None:
+            func = self._transform_spec.func
+            transform = {
+                'version': getattr(self._transform_spec, 'version', None),
+                'func': getattr(func, '__qualname__', None)
+                if func is not None else None}
+        return {
+            'mode': self._lineage_mode,
+            'url': self._store.url,
+            'dataset_path_hash': hashlib.md5(
+                self._store.url.encode()).hexdigest()[:12],
+            'fields': sorted(self.schema.fields),
+            'schema_hash': hashlib.md5(
+                ','.join(sorted(self.schema.fields)).encode()).hexdigest()[:8],
+            'seed': self._seed,
+            'cur_shard': self._cur_shard,
+            'shard_count': self._shard_count,
+            'num_epochs': self._num_epochs,
+            'shuffle_rows_in_chunk': self._shuffle_rows_in_chunk,
+            'n_row_groups': len(self._row_groups),
+            'transform': transform,
+            'predicate': _describe_filter(self._predicate),
+            'ngram': self.ngram is not None,
+        }
+
+    def lineage_state(self):
+        """The reader's *live* shuffle state, sampled into each provenance
+        record: epoch counter and the per-epoch ventilation-order digest
+        (advisory at epoch boundaries — a multi-worker pool interleaves
+        chunks across the roll)."""
+        return self._ventilator.lineage_state()
 
     @property
     def chunk_store(self):

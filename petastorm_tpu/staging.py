@@ -511,12 +511,17 @@ class StagingEngine(object):
     :param holds_mode: staged arrays alias arena memory (zero-copy
         backends): register GC holds so an arena is never recycled while
         the consumer can still observe it.
+    :param on_drop: optional zero-arg callback fired when an assembled
+        batch is discarded without reaching the consumer (stop-time
+        races). The loader's provenance tracker pairs pending records
+        FIFO with delivered batches, so a dropped batch must retract its
+        record or every later record would describe the wrong batch.
     """
 
     def __init__(self, host_iter, stage_fn, out_queue, stop_event,
                  end_sentinel, pool=None, inflight=2, ready_fn=None,
                  is_ready_fn=None, holds_mode=False, tracer=None,
-                 meter=None, health=None):
+                 meter=None, health=None, on_drop=None):
         self._host_iter = host_iter
         self._stage_fn = stage_fn
         self._out = out_queue
@@ -527,6 +532,7 @@ class StagingEngine(object):
         self._ready_fn = ready_fn or (lambda staged: None)
         self._is_ready_fn = is_ready_fn
         self._holds_mode = holds_mode
+        self._on_drop = on_drop
         if tracer is None:
             from petastorm_tpu.trace import NullTracer
             tracer = NullTracer()
@@ -630,6 +636,7 @@ class StagingEngine(object):
                 if not self._put(self._stage_q, (batch, arena)):
                     if arena is not None:
                         arena.retire()
+                    self._notify_drop()
                     return
         except Exception as e:  # noqa: BLE001 - surfaced to consumer
             if self._pool is not None:
@@ -637,6 +644,15 @@ class StagingEngine(object):
             self._put(self._stage_q, _StageError(e))
             return
         self._put(self._stage_q, _DONE)
+
+    def _notify_drop(self):
+        """An assembled batch will never reach the consumer: tell the
+        owner (provenance accounting) exactly once per dropped batch."""
+        if self._on_drop is not None:
+            try:
+                self._on_drop()
+            except Exception:  # noqa: BLE001 - advisory accounting only
+                logger.debug('staging on_drop callback failed', exc_info=True)
 
     # -- dispatch stage ---------------------------------------------------
 
@@ -697,6 +713,7 @@ class StagingEngine(object):
                     # device a put can hang past the join timeout, leaving
                     # a leaked thread holding reader views whose teardown
                     # it races.
+                    self._notify_drop()
                     return
                 if hb is not None:
                     hb.beat('device_put')
@@ -717,6 +734,7 @@ class StagingEngine(object):
                 if hb is not None:
                     hb.beat('out-put')
                 if not self._put(self._out, staged):
+                    self._notify_drop()
                     return
                 del staged
                 # Opportunistic early retirement, then hard backpressure:

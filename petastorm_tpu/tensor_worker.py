@@ -64,6 +64,10 @@ class TensorWorker(RowGroupWorkerBase):
     bench's read/decode/transport/assemble/stage profile (VERDICT r2 #1).
     """
 
+    #: Reader-mode tag for batch provenance contexts (lineage.py replay
+    #: picks its decode path by this).
+    lineage_mode = 'tensor'
+
     def process(self, piece_index, worker_predicate=None, shuffle_row_drop_partition=None):
         from petastorm_tpu.faults import maybe_inject, rowgroup_fault_key
 
@@ -72,11 +76,13 @@ class TensorWorker(RowGroupWorkerBase):
         maybe_inject('decode-corrupt',
                      key=rowgroup_fault_key(piece.path, piece.row_group))
         timings = {}
+        decoded_fresh = []    # load() ran => served from decode, not a cache
 
         def load():
             from petastorm_tpu import metrics
             from petastorm_tpu.trace import get_global_tracer
 
+            decoded_fresh.append(True)
             t0 = time.perf_counter()
             table = self._load_table(piece, worker_predicate)
             timings['read_s'] = time.perf_counter() - t0
@@ -170,12 +176,25 @@ class TensorWorker(RowGroupWorkerBase):
             private = True
 
         if n_rows:
+            from petastorm_tpu.lineage import chunk_lineage
             from petastorm_tpu.trace import get_global_tracer
+            # Serving tier: a fresh decode when load() actually ran (incl.
+            # every predicate read, which bypasses the cache), else the
+            # cache's own tier label (memory / chunk-store / disk).
+            tier = ('decode' if decoded_fresh or worker_predicate is not None
+                    else getattr(self.args['cache'], 'lineage_tier', 'cache'))
+            lineage = chunk_lineage(
+                piece, piece_index, shuffle_row_drop_partition, n_rows,
+                tier, permuted=bool(n_rows
+                                    and self.args.get('shuffle_rows_in_chunk')),
+                filtered=worker_predicate is not None,
+                worker_id=self.worker_id)
             with get_global_tracer().span('handoff', 'worker'):
                 self.publish_func({'__pst_tensor_chunk__': 1,
                                    'key': chunk_key(piece_index, shuffle_row_drop_partition),
                                    'cols': cols,
                                    'private': private,
+                                   'lineage': lineage,
                                    'timings': timings})
 
     # --- loading ------------------------------------------------------
@@ -247,6 +266,7 @@ class TensorResultsQueueReader(DeferredRowAccounting):
         self._timings = {'read_s': 0.0, 'decode_s': 0.0, 'cache_s': 0.0,
                          'chunks': 0}
         self._last_private = False
+        self._last_lineage = None
         #: Optional health.Heartbeat (wired by ``Reader.attach_health``):
         #: beaten per decoded chunk crossing the pool->consumer handoff,
         #: so the watchdog sees TensorWorker output flow directly.
@@ -270,6 +290,14 @@ class TensorResultsQueueReader(DeferredRowAccounting):
         private block is still unshared."""
         return self._last_private
 
+    @property
+    def last_chunk_lineage(self):
+        """Provenance segment of the chunk most recently returned by
+        ``read_next`` (``petastorm_tpu.lineage``): published-chunk
+        coordinates with ``row_start`` advanced past any resume skip.
+        ``None`` for payloads without lineage metadata."""
+        return self._last_lineage
+
     def read_next(self, pool, schema, ngram):
         if ngram is not None:
             raise NotImplementedError('NGram is not supported with tensor readers')
@@ -279,6 +307,7 @@ class TensorResultsQueueReader(DeferredRowAccounting):
                 self.heartbeat.beat('handoff')
             cols, key = chunk['cols'], chunk['key']
             self._last_private = bool(chunk.get('private'))
+            lineage = chunk.get('lineage')
             t = chunk.get('timings') or {}
             for k in ('read_s', 'decode_s', 'cache_s'):
                 if k in t:
@@ -290,9 +319,17 @@ class TensorResultsQueueReader(DeferredRowAccounting):
                 if skip:
                     cols = {k: v[skip:] for k, v in cols.items()}
                     n_rows -= skip
+                    if lineage is not None:
+                        # Resume re-delivery: the prior session consumed the
+                        # chunk's leading rows — the delivered span starts
+                        # past them (chunk_rows stays the published length,
+                        # which is what replay's permutation recompute needs).
+                        lineage = dict(lineage)
+                        lineage['row_start'] = lineage.get('row_start', 0) + skip
                 if n_rows <= 0:
                     continue
                 self._record_chunk(key, n_rows)
+            self._last_lineage = lineage
             break
         names = [n for n in schema.fields if n in cols]
         return schema.make_namedtuple(**{n: cols[n] for n in names})

@@ -55,6 +55,20 @@ def main(argv=None):
                              'stream is served: lets zmq flush queued '
                              'chunks and the END broadcast reach slow '
                              'consumers before teardown (default 5)')
+    parser.add_argument('--metrics-port', type=int, default=None,
+                        metavar='PORT',
+                        help='start the Prometheus scrape endpoint '
+                             '(petastorm_tpu.metrics.MetricsExporter) on '
+                             'this port; 0 binds an ephemeral port. The '
+                             'bound URL is printed as metrics_endpoint in '
+                             'the JSON status line. Until now the exporter '
+                             'was reachable only programmatically — this '
+                             'makes a shell-deployed decode tier scrapable.')
+    parser.add_argument('--no-lineage', action='store_true',
+                        help='do not ship per-chunk provenance segments on '
+                             'the wire; required while any trainer predates '
+                             'the lineage sidecar (old consumers crash '
+                             'unpacking the reserved payload key)')
     args = parser.parse_args(argv)
 
     from petastorm_tpu.data_service import serve_dataset
@@ -97,14 +111,31 @@ def main(argv=None):
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
 
-    server = serve_dataset(args.dataset_url, args.bind,
-                           sndhwm=args.sndhwm, auth_key=auth_key,
-                           snapshot_path=args.snapshot_path,
-                           snapshot_every=args.snapshot_every,
-                           snapshot_resume=args.resume, **reader_kwargs)
-    print(json.dumps({'data_endpoint': server.data_endpoint,
-                      'control_endpoint': server.control_endpoint,
-                      'rpc_endpoint': server.rpc_endpoint}), flush=True)
+    exporter = None
+    if args.metrics_port is not None:
+        # Before the (possibly slow) dataset open: a supervisor's scrape
+        # target should answer from process start, and a bind failure on a
+        # chosen port must fail fast, not after minutes of store listing.
+        from petastorm_tpu.metrics import start_http_exporter
+        exporter = start_http_exporter(port=args.metrics_port)
+
+    try:
+        server = serve_dataset(args.dataset_url, args.bind,
+                               sndhwm=args.sndhwm, auth_key=auth_key,
+                               snapshot_path=args.snapshot_path,
+                               snapshot_every=args.snapshot_every,
+                               snapshot_resume=args.resume,
+                               lineage=not args.no_lineage, **reader_kwargs)
+    except BaseException:
+        if exporter is not None:
+            exporter.stop()
+        raise
+    status = {'data_endpoint': server.data_endpoint,
+              'control_endpoint': server.control_endpoint,
+              'rpc_endpoint': server.rpc_endpoint}
+    if exporter is not None:
+        status['metrics_endpoint'] = exporter.address
+    print(json.dumps(status), flush=True)
 
     # wait() fires when the READER is exhausted — up to sndhwm chunks can
     # still sit in the zmq send queue and the END broadcast keeps repeating
@@ -115,6 +146,8 @@ def main(argv=None):
             stop.wait(args.drain_grace)
             break
     server.stop()
+    if exporter is not None:
+        exporter.stop()
     return 0
 
 

@@ -10,7 +10,8 @@ from petastorm_tpu.unischema import Unischema, UnischemaField
 
 
 class TransformSpec(object):
-    def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None):
+    def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None,
+                 version=None):
         """
         :param func: callable applied inside the worker. For row readers it
             receives/returns a dict; for batch (Arrow) readers a pandas
@@ -21,11 +22,16 @@ class TransformSpec(object):
         :param removed_fields: list of field names removed by ``func``.
         :param selected_fields: if set, the output schema keeps only these
             field names (applied after edits/removals).
+        :param version: optional caller-owned version tag (str/int) recorded
+            into batch provenance records (``petastorm_tpu.lineage``): user
+            transform code cannot be hashed, so the tag is what lets an
+            audit tell two trainings apart when only the transform changed.
         """
         self.func = func
         self.edit_fields = [self._as_field(f) for f in (edit_fields or [])]
         self.removed_fields = list(removed_fields or [])
         self.selected_fields = list(selected_fields) if selected_fields is not None else None
+        self.version = version
 
     @staticmethod
     def _as_field(f):

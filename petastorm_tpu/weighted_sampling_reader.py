@@ -3,8 +3,14 @@
 Parity: reference ``petastorm/weighted_sampling_reader.py`` — cumsum draw
 (``:90-92``), schema/batched/ngram compatibility validation (``:64-77``).
 
-TPU-first improvement: the draw RNG is seedable so every pod host mixes
-identically when given the same seed.
+TPU-first improvements: the draw RNG is seedable so every pod host mixes
+identically when given the same seed, per-source draw counts ride the
+metrics registry (``pst_weighted_reader_draws_total{source=...}`` — the
+live mixture-balance signal ROADMAP item 5 needs), and mixture batches
+carry provenance: each delivered chunk's lineage segment records which
+source reader produced it (``source`` index), so a ledgered batch of a
+multi-dataset mixture replays against the right dataset per span
+(``petastorm_tpu.lineage``).
 """
 
 import numpy as np
@@ -22,6 +28,8 @@ class WeightedSamplingReader(object):
         self._readers = list(readers)
         self._cum = np.cumsum([p / total for p in probabilities])
         self._rng = np.random.default_rng(seed)
+        self._seed = seed
+        self._last_source = None
 
         first = readers[0]
         for other in readers[1:]:
@@ -32,6 +40,15 @@ class WeightedSamplingReader(object):
             if (first.ngram is None) != (other.ngram is None):
                 raise ValueError('Cannot mix ngram and non-ngram readers')
         self.last_row_consumed = False
+        # Per-source draw counters (petastorm_tpu.metrics): the scrapable
+        # mixture balance — a source starving (or dominating) shows up as
+        # label-series drift long before epoch accounting would notice.
+        from petastorm_tpu import metrics
+        draws = metrics.counter(
+            'pst_weighted_reader_draws_total',
+            'Samples drawn from each source of a WeightedSamplingReader',
+            labelnames=('source',))
+        self._m_draws = [draws.labels(str(i)) for i in range(len(readers))]
 
     @property
     def batched_output(self):
@@ -49,6 +66,52 @@ class WeightedSamplingReader(object):
     def schema(self):
         return self._readers[0].schema
 
+    @property
+    def last_chunk_private(self):
+        """Block-handoff ownership of the most recent draw, proxied from
+        the chosen source (see ``Reader.last_chunk_private``) — without it
+        a JaxLoader over a mixture would treat every private chunk as
+        cache-shared and copy defensively."""
+        if self._last_source is None:
+            return False
+        return bool(getattr(self._readers[self._last_source],
+                            'last_chunk_private', False))
+
+    @property
+    def last_chunk_lineage(self):
+        """Provenance segment of the most recent draw: the chosen source
+        reader's segment plus its ``source`` index (what lets replay pick
+        the right dataset context per span)."""
+        if self._last_source is None:
+            return None
+        segment = getattr(self._readers[self._last_source],
+                          'last_chunk_lineage', None)
+        if segment is None:
+            return None
+        return dict(segment, source=self._last_source)
+
+    def lineage_context(self):
+        """Mixture provenance context: ``mode='mixture'`` wrapping each
+        source reader's own context (``sources[i]`` resolves a segment's
+        ``source`` index)."""
+        sources = []
+        for reader in self._readers:
+            ctx_fn = getattr(reader, 'lineage_context', None)
+            sources.append(ctx_fn() if ctx_fn is not None else {'mode': None})
+        return {'mode': 'mixture',
+                'seed': self._seed,
+                'probabilities': [round(float(p), 6) for p in
+                                  np.diff(np.concatenate([[0.0], self._cum]))],
+                'sources': sources}
+
+    def lineage_state(self):
+        """Per-source live shuffle state (advisory, like the readers')."""
+        states = []
+        for reader in self._readers:
+            state_fn = getattr(reader, 'lineage_state', None)
+            states.append(state_fn() if state_fn is not None else None)
+        return {'sources': states}
+
     def __iter__(self):
         return self
 
@@ -57,10 +120,13 @@ class WeightedSamplingReader(object):
         chosen = int(np.searchsorted(self._cum, draw, side='right'))
         chosen = min(chosen, len(self._readers) - 1)
         try:
-            return next(self._readers[chosen])
+            row = next(self._readers[chosen])
         except StopIteration:
             self.last_row_consumed = True
             raise
+        self._last_source = chosen
+        self._m_draws[chosen].inc()
+        return row
 
     next = __next__
 

@@ -13,6 +13,7 @@ TPU-first improvement: shuffling is **seeded and reproducible**
 synchronized input pipelines (SURVEY.md §7 "Determinism across hosts").
 """
 
+import hashlib
 import random
 import threading
 
@@ -89,6 +90,12 @@ class ConcurrentVentilator(Ventilator):
         self._current_item_to_ventilate = 0
         self._in_flight = 0
         self._in_flight_lock = threading.Lock()
+        # Batch provenance (petastorm_tpu.lineage): which epoch is being
+        # fed and a digest of THIS epoch's item order — what pins "what
+        # the shuffle chose" into ledgered batch records. epochs_started
+        # counts feed epochs (1-based once start() ran).
+        self.epochs_started = 0
+        self._epoch_order_digest = None
         self._ventilation_thread = None
         self._started = False
         self._stop_event = threading.Event()
@@ -117,6 +124,7 @@ class ConcurrentVentilator(Ventilator):
             return
         if self._randomize_item_order:
             self._rng.shuffle(self._items_to_ventilate)
+        self._on_epoch_order()
         if self.inline:
             return
         self._ventilation_thread = threading.Thread(target=self._ventilate, daemon=True)
@@ -134,7 +142,39 @@ class ConcurrentVentilator(Ventilator):
             self._current_item_to_ventilate = 0
             if self._randomize_item_order:
                 self._rng.shuffle(self._items_to_ventilate)
+            self._on_epoch_order()
         return True
+
+    def _on_epoch_order(self):
+        """A new epoch's item order is fixed: bump the epoch counter and
+        invalidate the order-digest memo. The digest itself (by each
+        item's JSON-safe identity keys — what lets the provenance ledger
+        prove two runs claiming the same seed fed identically) is O(items)
+        and only ever read by lineage probes, so it is computed lazily on
+        first probe rather than stalling every epoch roll for pipelines
+        that never arm lineage."""
+        self.epochs_started += 1
+        self._epoch_order_digest = None
+
+    def lineage_state(self):
+        """``{'epoch', 'order_digest', 'position'}`` — the live shuffle
+        state stamped into provenance records (advisory near epoch rolls:
+        a multi-worker pool interleaves chunks across the boundary, and a
+        roll may invalidate the memo mid-probe)."""
+        epoch = self.epochs_started
+        memo = self._epoch_order_digest
+        if memo is None or memo[0] != epoch:
+            digest = hashlib.md5()
+            for index, item in enumerate(self._items_to_ventilate):
+                identity = (item.get('piece_index', index),
+                            item.get('shuffle_row_drop_partition')) \
+                    if isinstance(item, dict) else index
+                digest.update(repr(identity).encode())
+            memo = (epoch, digest.hexdigest()[:12])
+            self._epoch_order_digest = memo
+        return {'epoch': epoch,
+                'order_digest': memo[1],
+                'position': self._current_item_to_ventilate}
 
     def _backpressured(self):
         """Tri-state sample of the saturation signal: ``None`` = no signal

@@ -164,6 +164,11 @@ def pytest_configure(config):
         'observability: tracing/metrics/flight-recorder tests '
         '(tests/test_trace.py, tests/test_metrics.py); the conftest guard '
         'sweeps leaked trace sidecar and flight-dump temp dirs after them.')
+    config.addinivalue_line(
+        'markers',
+        'lineage: batch-provenance/replay tests (tests/test_lineage.py); '
+        'the conftest guard sweeps leaked pst-lineage-* ledger temp dirs '
+        'after them.')
 
 
 # ---------------------------------------------------------------------------
@@ -316,6 +321,46 @@ def _observability_dir_guard(request):
                     os.unlink(leaked)
                 except OSError:
                     pass
+
+
+# ---------------------------------------------------------------------------
+# Lineage ledger guard (mirrors the trace/flight guards): ledgers created
+# without an explicit directory (lineage=True with no env var, bench
+# children) land under tempfile.gettempdir() with the pst-lineage- prefix;
+# a dying test must not leave them accumulating on the CI host. Also fails
+# the test when the ledger write-behind thread (pst-lineage-writer) leaks
+# past the loader's close — a leaked writer holds the ledger file open.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _lineage_dir_guard(request):
+    if request.node.get_closest_marker('lineage') is None:
+        yield
+        return
+    import glob
+    import shutil
+    import tempfile
+    import threading
+    import time as _time
+
+    from petastorm_tpu.lineage import TEMP_DIR_PREFIX
+    pattern = os.path.join(tempfile.gettempdir(), TEMP_DIR_PREFIX + '*')
+    before = set(glob.glob(pattern))
+    yield
+    deadline = _time.monotonic() + 2.0
+    leaked_threads = []
+    while _time.monotonic() < deadline:
+        leaked_threads = [t.name for t in threading.enumerate()
+                          if t.is_alive()
+                          and t.name.startswith('pst-lineage-writer')]
+        if not leaked_threads:
+            break
+        _time.sleep(0.05)   # close() joins with a timeout: allow it to land
+    for leaked in set(glob.glob(pattern)) - before:
+        shutil.rmtree(leaked, ignore_errors=True)
+    if leaked_threads:
+        pytest.fail('lineage ledger writer thread(s) leaked past close(): '
+                    '{}'.format(leaked_threads))
 
 
 @pytest.fixture(autouse=True)

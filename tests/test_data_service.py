@@ -864,6 +864,37 @@ def test_serve_cli_end_to_end(service_dataset):
             proc.kill()
 
 
+def test_serve_cli_metrics_port(service_dataset):
+    """--metrics-port: a shell-deployed data-service server exposes the
+    PR-6 Prometheus scrape endpoint (until now programmatic-only) and
+    prints the bound URL in its JSON status line; the exposition carries
+    the server's chunk counter."""
+    import json
+    import subprocess
+    import sys
+    import urllib.request
+
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'petastorm_tpu.tools.serve_cli',
+         service_dataset, '--bind', 'tcp://127.0.0.1:*', '--workers', '2',
+         '--epochs', '1', '--metrics-port', '0', '--drain-grace', '1'],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        endpoints = json.loads(proc.stdout.readline())
+        assert endpoints['metrics_endpoint'].startswith('http://127.0.0.1:')
+        # Scrapable while serving (before the stream is drained).
+        body = urllib.request.urlopen(endpoints['metrics_endpoint'],
+                                      timeout=10).read().decode()
+        assert '# TYPE pst_data_service_chunks_served_total counter' in body
+        with RemoteReader(endpoints['data_endpoint']) as remote:
+            ids = _drain_ids(remote)
+        assert sorted(ids) == list(range(N_ROWS))
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
 @pytest.mark.slow
 def test_serve_cli_sigkill_resume(kill_dataset, tmp_path):
     """Crash recovery through the shell entry point alone: a

@@ -1,0 +1,593 @@
+"""Batch provenance ledger + deterministic replay (petastorm_tpu.lineage).
+
+Covers the ISSUE-7 contract end to end: segment metadata flowing worker ->
+results queue -> loader, FIFO batch records with content digests, the
+crash-tolerant JSONL ledger (torn tails, bounds, write-behind lag), the
+flight-recorder lineage dump, the ``tools.replay`` CLI, and — the
+acceptance criterion — bit-identical replay of an arbitrary mid-epoch
+batch from a process-pool tensor reader with shuffling enabled.
+"""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import lineage as lineage_mod
+from petastorm_tpu import make_batch_reader, make_reader, make_tensor_reader
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.jax_loader import JaxLoader
+from petastorm_tpu.lineage import (LineageCollector, LineageTracker,
+                                   ReplayError, read_ledger_dir,
+                                   read_ledger_file, replay_record,
+                                   verify_record)
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+pytestmark = pytest.mark.lineage
+
+ROWS = 64
+ROWS_PER_GROUP = 8
+
+LineageSchema = Unischema('LineageSchema', [
+    UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField('vec', np.float32, (4,), NdarrayCodec(), False),
+])
+
+
+@pytest.fixture(scope='module')
+def lineage_dataset(tmp_path_factory):
+    url = 'file://' + str(tmp_path_factory.mktemp('lineage') / 'ds')
+    rng = np.random.default_rng(11)
+    rows = [{'id': i, 'vec': rng.random(4, dtype=np.float32)}
+            for i in range(ROWS)]
+    write_dataset(url, LineageSchema, rows, rows_per_row_group=ROWS_PER_GROUP)
+    return url
+
+
+def _run_loader(reader, batch_size, ledger_dir, **loader_kwargs):
+    """Drain a loader with lineage armed; returns (live batches, records,
+    ctx) with live batches keyed by record batch_id order."""
+    live = []
+    with reader:
+        with JaxLoader(reader, batch_size, prefetch=2, lineage=str(ledger_dir),
+                       **loader_kwargs) as loader:
+            for batch in loader:
+                live.append({name: np.asarray(getattr(batch, name))
+                             for name in batch._fields})
+            assert loader.stats['lineage']['records'] == len(live)
+    entries = read_ledger_dir(str(ledger_dir))
+    assert len(entries) == 1
+    _, ctx, records = entries[0]
+    return live, records, ctx
+
+
+def _assert_replay_matches(records, ctx, live):
+    for record in records:
+        replayed = verify_record(record, ctx)
+        for name in record['fields']:
+            assert replayed[name].tobytes() == \
+                live[record['batch_id']][name].tobytes(), \
+                'batch {} field {} replayed differently'.format(
+                    record['batch_id'], name)
+
+
+# ---------------------------------------------------------------------------
+# collector unit tests
+# ---------------------------------------------------------------------------
+
+class _SinkTracker(object):
+    def __init__(self):
+        self.pending = []
+
+    def _push_pending(self, entry):
+        self.pending.append(entry)
+
+
+def _segment(path='p', row_group=0, rows=10, start=0):
+    return {'path': path, 'row_group': row_group, 'drop': None,
+            'chunk_rows': rows, 'row_start': start, 'tier': 'decode',
+            'permuted': False, 'filtered': False}
+
+
+def test_collector_fifo_spans():
+    sink = _SinkTracker()
+    collector = LineageCollector(sink, digest=False)
+    collector.on_chunk(_segment(row_group=0, rows=10), 10)
+    collector.on_chunk(_segment(row_group=1, rows=10), 10)
+    collector.on_batch(6)
+    collector.on_batch(6)
+    collector.on_batch(8)
+    spans = [[(s['row_group'], s['row_start'], s['row_stop'])
+              for s in e['segments']] for e in sink.pending]
+    assert spans == [[(0, 0, 6)],
+                     [(0, 6, 10), (1, 0, 2)],
+                     [(1, 2, 10)]]
+    assert all(e['exact'] for e in sink.pending)
+
+
+def test_collector_coalesces_contiguous_rows():
+    """Per-row readers push one row at a time; contiguous rows of one
+    chunk must merge into a single span, not 8 one-row segments."""
+    sink = _SinkTracker()
+    collector = LineageCollector(sink, digest=False)
+    for i in range(8):
+        collector.on_chunk(dict(_segment(rows=8), row_start=i), 1)
+    collector.on_batch(8)
+    (entry,) = sink.pending
+    assert len(entry['segments']) == 1
+    assert (entry['segments'][0]['row_start'],
+            entry['segments'][0]['row_stop']) == (0, 8)
+
+
+def test_collector_unknown_chunk_marks_inexact():
+    sink = _SinkTracker()
+    collector = LineageCollector(sink, digest=False)
+    collector.on_chunk(None, 4)
+    collector.on_batch(4)
+    assert sink.pending[0]['exact'] is False
+
+
+# ---------------------------------------------------------------------------
+# end-to-end capture + replay
+# ---------------------------------------------------------------------------
+
+def test_tensor_lineage_records_structure(lineage_dataset, tmp_path):
+    reader = make_tensor_reader(lineage_dataset, reader_pool_type='thread',
+                                workers_count=2, shuffle_row_groups=True,
+                                seed=7, num_epochs=1)
+    live, records, ctx = _run_loader(reader, 16, tmp_path / 'ledger')
+    assert [r['batch_id'] for r in records] == list(range(len(live)))
+    assert ctx['mode'] == 'tensor'
+    assert ctx['url'] == lineage_dataset
+    assert ctx['seed'] == 7
+    for record in records:
+        assert record['rows'] == 16
+        assert record['exact'] is True
+        assert sum(s['row_stop'] - s['row_start']
+                   for s in record['segments']) == 16
+        for segment in record['segments']:
+            assert segment['tier'] == 'decode'
+            assert segment['worker_pid'] == os.getpid()  # thread pool
+            assert segment['path'].endswith('.parquet')
+        assert set(record['digest']) == set(record['fields'])
+        assert record['shuffle']['epoch'] >= 1
+        assert record['shuffle']['order_digest']
+
+
+def test_replay_bit_identical_thread_pool(lineage_dataset, tmp_path):
+    reader = make_tensor_reader(lineage_dataset, reader_pool_type='thread',
+                                workers_count=3, shuffle_row_groups=True,
+                                seed=13, num_epochs=2,
+                                shuffle_rows_in_chunk=True)
+    live, records, ctx = _run_loader(reader, 16, tmp_path / 'ledger')
+    assert len(records) == len(live) == (2 * ROWS) // 16
+    assert any(s['permuted'] for r in records for s in r['segments'])
+    _assert_replay_matches(records, ctx, live)
+
+
+def test_replay_pad_and_partial_batches(lineage_dataset, tmp_path):
+    reader = make_tensor_reader(lineage_dataset, reader_pool_type='thread',
+                                workers_count=2, shuffle_row_groups=False,
+                                num_epochs=1)
+    live, records, ctx = _run_loader(reader, 24, tmp_path / 'ledger',
+                                     last_batch='pad')
+    assert records[-1]['padded'] == 24 - ROWS % 24
+    _assert_replay_matches(records, ctx, live)
+
+
+def test_py_dict_reader_replay(lineage_dataset, tmp_path):
+    reader = make_reader(lineage_dataset, reader_pool_type='thread',
+                         workers_count=2, shuffle_row_groups=True, seed=3,
+                         num_epochs=1)
+    live, records, ctx = _run_loader(reader, 8, tmp_path / 'ledger')
+    assert ctx['mode'] == 'py_dict'
+    # Per-row delivery coalesces: one chunk's contiguous rows = one span.
+    assert all(len(r['segments']) <= 2 for r in records)
+    _assert_replay_matches(records, ctx, live)
+
+
+def test_arrow_batch_reader_replay(scalar_dataset, tmp_path):
+    reader = make_batch_reader(scalar_dataset.url, reader_pool_type='thread',
+                               workers_count=2, shuffle_row_groups=True,
+                               seed=5, num_epochs=1)
+    live, records, ctx = _run_loader(reader, 16, tmp_path / 'ledger')
+    assert ctx['mode'] == 'arrow'
+    _assert_replay_matches(records, ctx, live)
+
+
+def test_memory_cache_tier_recorded(lineage_dataset, tmp_path):
+    """Epoch 2 of a memory-cached tensor reader serves chunks from RAM —
+    the provenance tier must say so (the NaN-debug question 'was this
+    batch decoded or served stale from a cache?')."""
+    # One worker: multi-worker completion order could interleave epoch-2
+    # cache hits into the first batch (the single-flight cache fills as
+    # epoch 1 decodes while epoch 2 is already ventilated).
+    reader = make_tensor_reader(lineage_dataset, reader_pool_type='thread',
+                                workers_count=1, shuffle_row_groups=False,
+                                num_epochs=2, cache_type='memory')
+    live, records, ctx = _run_loader(reader, ROWS, tmp_path / 'ledger')
+    tiers = [{s['tier'] for s in r['segments']} for r in records]
+    assert tiers[0] == {'decode'}
+    assert tiers[-1] == {'memory'}
+    _assert_replay_matches(records, ctx, live)
+
+
+def test_shuffling_buffer_marks_records_inexact(lineage_dataset, tmp_path):
+    reader = make_reader(lineage_dataset, reader_pool_type='thread',
+                         workers_count=2, shuffle_row_groups=False,
+                         num_epochs=1)
+    live, records, ctx = _run_loader(reader, 8, tmp_path / 'ledger',
+                                     shuffling_queue_capacity=32, seed=1)
+    assert records and all(r['exact'] is False for r in records)
+    with pytest.raises(ReplayError, match='not exact'):
+        replay_record(records[0], ctx)
+
+
+def test_transform_refuses_replay(lineage_dataset, tmp_path):
+    from petastorm_tpu.transform import TransformSpec
+
+    def double(cols):
+        cols['vec'] = cols['vec'] * 2
+        return cols
+
+    reader = make_tensor_reader(
+        lineage_dataset, reader_pool_type='thread', workers_count=1,
+        shuffle_row_groups=False, num_epochs=1,
+        transform_spec=TransformSpec(double, version='v2'))
+    live, records, ctx = _run_loader(reader, 16, tmp_path / 'ledger')
+    assert ctx['transform']['version'] == 'v2'
+    with pytest.raises(ReplayError, match='TransformSpec'):
+        replay_record(records[0], ctx)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: process pool + shuffle, arbitrary mid-epoch batch, CLI replay
+# ---------------------------------------------------------------------------
+
+@pytest.mark.processpool
+def test_replay_process_pool_mid_epoch_batch(lineage_dataset, tmp_path):
+    """ISSUE-7 acceptance: a process-pool tensor reader with shuffling
+    enabled; an arbitrary mid-epoch batch re-materializes bit-identically
+    from its ledger record — through the library API and through the
+    ``python -m petastorm_tpu.tools.replay`` CLI."""
+    from petastorm_tpu.tools import replay as replay_cli
+
+    ledger_dir = tmp_path / 'ledger'
+    reader = make_tensor_reader(lineage_dataset, reader_pool_type='process',
+                                workers_count=2, shuffle_row_groups=True,
+                                seed=29, num_epochs=2)
+    live, records, ctx = _run_loader(reader, 16, ledger_dir)
+    # Real worker processes produced the chunks, not the consumer.
+    worker_pids = {s['worker_pid'] for r in records for s in r['segments']}
+    assert worker_pids and os.getpid() not in worker_pids
+
+    target = records[len(records) // 2]     # arbitrary mid-epoch batch
+    replayed = verify_record(target, ctx)
+    for name in target['fields']:
+        assert replayed[name].tobytes() == \
+            live[target['batch_id']][name].tobytes()
+
+    out_npz = tmp_path / 'replayed.npz'
+    rc = replay_cli.main(['--ledger', str(ledger_dir),
+                          '--batch-id', str(target['batch_id']),
+                          '--verify', '--out', str(out_npz)])
+    assert rc == 0
+    loaded = np.load(str(out_npz))
+    for name in target['fields']:
+        assert loaded[name].tobytes() == \
+            live[target['batch_id']][name].tobytes()
+
+
+def test_replay_cli_lookup_errors(lineage_dataset, tmp_path, capsys):
+    from petastorm_tpu.tools import replay as replay_cli
+
+    ledger_dir = tmp_path / 'ledger'
+    reader = make_tensor_reader(lineage_dataset, reader_pool_type='thread',
+                                workers_count=1, shuffle_row_groups=False,
+                                num_epochs=1)
+    _run_loader(reader, 16, ledger_dir)
+    assert replay_cli.main(['--ledger', str(ledger_dir),
+                            '--batch-id', '999']) == 1
+    assert 'batch ids' in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# ledger durability
+# ---------------------------------------------------------------------------
+
+def test_ledger_torn_tail_line_tolerated(lineage_dataset, tmp_path):
+    """A SIGKILLed trainer leaves at most one torn trailing line; the
+    reader must skip it (and any corrupt middle line) and keep every
+    complete record replayable."""
+    ledger_dir = tmp_path / 'ledger'
+    reader = make_tensor_reader(lineage_dataset, reader_pool_type='thread',
+                                workers_count=2, shuffle_row_groups=True,
+                                seed=2, num_epochs=1)
+    live, records, ctx = _run_loader(reader, 16, ledger_dir)
+    (path,) = [os.path.join(ledger_dir, f) for f in os.listdir(ledger_dir)]
+    with open(path, 'a') as f:
+        f.write('{"v": 1, "batch_id": 999, "truncated-mid-wr')   # torn tail
+    with open(path, 'r') as f:
+        lines = f.read().splitlines()
+    lines.insert(2, 'garbage not json at all')                   # corrupt line
+    with open(path, 'w') as f:
+        f.write('\n'.join(lines))
+    ctx2, records2 = read_ledger_file(path)
+    assert ctx2 == ctx
+    assert [r['batch_id'] for r in records2] == \
+        [r['batch_id'] for r in records]
+    _assert_replay_matches(records2, ctx2, live)
+
+
+def test_ledger_bounds_and_drop_accounting(tmp_path):
+    """Past max_records the file stops growing, records keep landing in
+    the ring, and the loss is counted (never silent)."""
+    from petastorm_tpu import metrics
+    registry = metrics.MetricsRegistry()
+    previous = metrics.set_registry(registry)
+    try:
+        tracker = LineageTracker({'mode': 'tensor'},
+                                 ledger_dir=str(tmp_path / 'ledger'),
+                                 max_records=3, ring_size=8, digest=False)
+        collector = tracker.collector
+        for i in range(6):
+            collector.on_chunk(_segment(row_group=i, rows=4), 4)
+            collector.on_batch(4)
+            assert tracker.deliver() is not None
+        assert tracker.flush()
+        tracker.close()
+        assert tracker.records == 6
+        assert tracker.dropped == 3
+        assert len(tracker.ring()) == 6
+        _, records = read_ledger_file(tracker.ledger_path)
+        assert len(records) == 3
+        snapshot = registry.collect()
+        assert snapshot['pst_lineage_records_total']['samples'][0]['value'] == 6
+        assert snapshot['pst_lineage_dropped_total']['samples'][0]['value'] == 3
+        assert 'pst_lineage_ledger_lag' in snapshot
+    finally:
+        metrics.set_registry(previous)
+
+
+def test_ledger_lag_gauge_is_per_ledger(tmp_path):
+    """Two armed pipelines in one process scrape distinct lag samples
+    (the PR-6 per-instance-label pattern), and a closed ledger's child
+    leaves the registry instead of scraping as a live 0."""
+    from petastorm_tpu import metrics
+    registry = metrics.MetricsRegistry()
+    previous = metrics.set_registry(registry)
+    try:
+        a = LineageTracker({'mode': 'tensor'},
+                           ledger_dir=str(tmp_path / 'a'), digest=False)
+        b = LineageTracker({'mode': 'tensor'},
+                           ledger_dir=str(tmp_path / 'b'), digest=False)
+        samples = registry.collect()['pst_lineage_ledger_lag']['samples']
+        assert len(samples) == 2
+        assert len({s['labels']['ledger'] for s in samples}) == 2
+        a.close()
+        samples = registry.collect()['pst_lineage_ledger_lag']['samples']
+        assert len(samples) == 1
+        b.close()
+        assert not registry.collect()['pst_lineage_ledger_lag']['samples']
+    finally:
+        metrics.set_registry(previous)
+
+
+def test_closed_ledger_refuses_appends_as_drops(tmp_path):
+    """append() after close() must return False (counted as dropped), not
+    silently enqueue to a dead writer while stats claim the record durable."""
+    tracker = LineageTracker({'mode': 'tensor'},
+                             ledger_dir=str(tmp_path / 'ledger'),
+                             digest=False)
+    collector = tracker.collector
+    collector.on_chunk(_segment(row_group=0, rows=4), 4)
+    collector.on_batch(4)
+    assert tracker.deliver() is not None
+    tracker.close()
+    collector.on_chunk(_segment(row_group=1, rows=4), 4)
+    collector.on_batch(4)
+    assert tracker.deliver() is not None   # ring still records it...
+    assert tracker.dropped == 1            # ...but the ledger loss is counted
+    _, records = read_ledger_file(tracker.ledger_path)
+    assert [r['batch_id'] for r in records] == [0]
+
+
+def test_adopted_tracker_survives_loader_stop(lineage_dataset, tmp_path):
+    """A caller-owned tracker passed to JaxLoader stays open across the
+    loader's stop() — the caller may ledger several loaders through one
+    tracker — and records from a second loader still reach the ledger."""
+    ids = []
+    tracker = LineageTracker({'mode': 'tensor'},
+                             ledger_dir=str(tmp_path / 'ledger'),
+                             digest=False)
+    try:
+        for _ in range(2):
+            reader = make_tensor_reader(lineage_dataset,
+                                        reader_pool_type='thread',
+                                        workers_count=1, num_epochs=1)
+            with reader:
+                with JaxLoader(reader, 16, prefetch=2,
+                               lineage=tracker) as loader:
+                    for _ in loader:
+                        pass
+                    ids.append(loader.last_batch_provenance['batch_id'])
+        assert tracker.flush()
+    finally:
+        tracker.close()
+    _, records = read_ledger_file(tracker.ledger_path)
+    # One monotonic id space across both loaders, every record durable.
+    assert [r['batch_id'] for r in records] == list(range(ids[-1] + 1))
+    assert ids[0] < ids[1]
+    assert tracker.dropped == 0
+
+
+def test_tracker_without_ledger_keeps_ring_only(tmp_path):
+    tracker = LineageTracker({'mode': 'tensor'}, ledger_dir=None,
+                             ring_size=2, digest=False)
+    collector = tracker.collector
+    for i in range(4):
+        collector.on_chunk(_segment(row_group=i, rows=4), 4)
+        collector.on_batch(4)
+        tracker.deliver()
+    assert tracker.ledger_path is None
+    assert [r['batch_id'] for r in tracker.ring()] == [2, 3]
+    tracker.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: worker kill mid-epoch
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+@pytest.mark.processpool
+def test_worker_kill_leaves_replayable_ledger(lineage_dataset, tmp_path):
+    """A pool worker SIGKILLed mid-epoch: PR-1 supervision respawns it and
+    redelivers; the ledger stays readable and every surviving record —
+    including chunks decoded by the dead worker AND by its replacement —
+    replays bit-identically."""
+    ledger_dir = tmp_path / 'ledger'
+    reader = make_tensor_reader(lineage_dataset, reader_pool_type='process',
+                                workers_count=2, shuffle_row_groups=True,
+                                seed=17, num_epochs=1)
+    live = []
+    killed = []
+    with reader:
+        with JaxLoader(reader, 8, prefetch=2,
+                       lineage=str(ledger_dir)) as loader:
+            it = iter(loader)
+            for batch in it:
+                live.append({name: np.asarray(getattr(batch, name))
+                             for name in batch._fields})
+                if len(live) == 2 and not killed:
+                    victim = reader._workers_pool._processes[0]
+                    os.kill(victim.pid, signal.SIGKILL)
+                    killed.append(victim.pid)
+            respawns = reader.diagnostics()['worker_respawns']
+    assert killed and respawns >= 1
+    _, ctx, records = read_ledger_dir(str(ledger_dir))[0]
+    assert len(records) == len(live) == ROWS // 8
+    _assert_replay_matches(records, ctx, live)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder integration
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_dump_includes_lineage_ring(lineage_dataset, tmp_path):
+    """The stall post-mortem must name the exact rows in flight: a dump
+    taken while a lineage-armed pipeline is live carries its ring (with
+    context) in lineage.json."""
+    from petastorm_tpu.flight_recorder import FlightRecorder
+
+    reader = make_tensor_reader(lineage_dataset, reader_pool_type='thread',
+                                workers_count=2, shuffle_row_groups=True,
+                                seed=4, num_epochs=1)
+    with reader:
+        with JaxLoader(reader, 16, prefetch=2,
+                       lineage=str(tmp_path / 'ledger')) as loader:
+            batches = 0
+            for _ in loader:
+                batches += 1
+                if batches == 2:
+                    recorder = FlightRecorder(str(tmp_path / 'flight'))
+                    dump = recorder.dump(reason='test')
+    assert dump is not None
+    with open(os.path.join(dump, 'lineage.json')) as f:
+        rings = json.load(f)
+    ours = [ring for ring in rings
+            if ring.get('ctx', {}).get('url') == lineage_dataset]
+    assert ours, 'live tracker ring missing from the flight dump'
+    records = ours[0]['records']
+    assert [r['batch_id'] for r in records] == list(range(len(records)))
+    assert records and records[0]['segments']
+
+
+def test_watchdog_stall_dump_carries_lineage(lineage_dataset, tmp_path,
+                                             monkeypatch):
+    """End-to-end ISSUE-7 acceptance leg: a fault-injected hard stall
+    escalates through the watchdog, and the flight dump's lineage.json
+    names the batches that were in flight."""
+    from petastorm_tpu.errors import PipelineStallError
+    from petastorm_tpu.faults import ENV_VAR as FAULTS_ENV
+    from petastorm_tpu.flight_recorder import DUMP_DIR_PREFIX
+    from petastorm_tpu.flight_recorder import ENV_VAR as FLIGHT_ENV
+
+    flight_dir = tmp_path / 'flight'
+    monkeypatch.setenv(FLIGHT_ENV, str(flight_dir))
+    monkeypatch.setenv(FAULTS_ENV, 'device-put-delay:delay=30:max=1')
+    reader = make_tensor_reader(lineage_dataset, reader_pool_type='thread',
+                                workers_count=2, shuffle_row_groups=False,
+                                num_epochs=None)
+    with pytest.raises(PipelineStallError):
+        with reader:
+            with JaxLoader(reader, 8, prefetch=2, watchdog=True,
+                           stall_timeout_s=0.4,
+                           lineage=str(tmp_path / 'ledger')) as loader:
+                deadline = time.monotonic() + 60
+                for _ in loader:
+                    if time.monotonic() > deadline:  # pragma: no cover
+                        pytest.fail('stall never escalated')
+    dumps = [d for d in os.listdir(flight_dir)
+             if d.startswith(DUMP_DIR_PREFIX)]
+    assert dumps
+    with open(os.path.join(flight_dir, dumps[0], 'lineage.json')) as f:
+        rings = json.load(f)
+    ours = [ring for ring in rings
+            if ring.get('ctx', {}).get('url') == lineage_dataset]
+    assert ours
+    # The injected stall hits the FIRST device put, so nothing was ever
+    # delivered — the post-mortem's value is the in-flight list: the
+    # exact rows the pipeline died holding.
+    in_flight = ours[0]['in_flight']
+    assert in_flight and in_flight[0]['segments']
+    assert in_flight[0]['segments'][0]['path'].endswith('.parquet')
+
+
+# ---------------------------------------------------------------------------
+# remote (data service) provenance
+# ---------------------------------------------------------------------------
+
+def test_remote_reader_lineage_over_the_wire(lineage_dataset, tmp_path):
+    """Segments survive the zmq hop: trainer-side records re-tier chunks
+    as 'remote' (keeping the server-side tier + endpoint), the server's
+    reader context arrives over rpc, and replay against the source
+    dataset stays bit-identical."""
+    zmq = pytest.importorskip('zmq')  # noqa: F841
+    from petastorm_tpu.data_service import RemoteReader, serve_dataset
+
+    with serve_dataset(lineage_dataset, 'tcp://127.0.0.1:*', num_epochs=1,
+                       seed=0, workers_count=1,
+                       shuffle_row_groups=True) as server:
+        remote = RemoteReader(server.data_endpoint)
+        live, records, ctx = _run_loader(remote, 16, tmp_path / 'ledger')
+    assert ctx['remote'] is True
+    assert ctx['mode'] == 'tensor'
+    assert ctx['url'] == lineage_dataset
+    for record in records:
+        for segment in record['segments']:
+            assert segment['tier'] == 'remote'
+            assert segment['remote_tier'] == 'decode'
+            assert segment['endpoint']
+    _assert_replay_matches(records, ctx, live)
+
+
+def test_server_lineage_opt_out_keeps_wire_clean(lineage_dataset):
+    """serve_dataset(lineage=False): no '__pst_lineage__' key reaches the
+    wire — the escape hatch for fleets whose trainers predate the sidecar
+    (an old consumer crashes unpacking the reserved key)."""
+    zmq = pytest.importorskip('zmq')  # noqa: F841
+    from petastorm_tpu.data_service import RemoteReader, serve_dataset
+
+    with serve_dataset(lineage_dataset, 'tcp://127.0.0.1:*', num_epochs=1,
+                       workers_count=1, lineage=False) as server:
+        with RemoteReader(server.data_endpoint) as remote:
+            rows = 0
+            for chunk in remote:
+                assert '__pst_lineage__' not in chunk._fields
+                assert remote.last_chunk_lineage is None
+                rows += len(chunk.id)
+    assert rows == ROWS

@@ -370,3 +370,71 @@ def test_flight_recorder_dump_on_injected_stall(synthetic_dataset, tmp_path,
     # and the metrics textfile carries the stall counter
     prom = open(os.path.join(dump, 'metrics.prom')).read()
     assert 'pst_watchdog_stalls_total' in prom
+
+
+# ---------------------------------------------------------------------------
+# metric-name documentation lint (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+#: pst_-prefixed string literals that are NOT metric names (native shared-
+#: library build targets).
+_NON_METRIC_PST_LITERALS = {'pst_image', 'pst_parquet', 'pst_shm_ring'}
+
+
+def _source_metric_names():
+    """Every pst_* instrument name registrable by the package source:
+    plain literals plus the chunk store's formatted family."""
+    import glob
+    import re
+
+    import petastorm_tpu
+
+    root = os.path.dirname(petastorm_tpu.__file__)
+    paths = glob.glob(os.path.join(root, '**', '*.py'), recursive=True)
+    paths.append(os.path.join(root, os.pardir, 'bench.py'))
+    names = set()
+    for path in paths:
+        with open(path) as f:
+            text = f.read()
+        names.update(re.findall(r"['\"](pst_[a-z0-9_]+)['\"]", text))
+        # Formatted family: 'pst_chunk_store_{}_total'.format(name) over a
+        # literal tuple — expand it so a newly added counter must be
+        # documented too.
+        fmt = re.search(
+            r"['\"](pst_[a-z0-9_]*)\{\}([a-z0-9_]*)['\"][\s\S]{0,200}?"
+            r"for name in \(([^)]+)\)", text)
+        if fmt:
+            prefix, suffix, tuple_body = fmt.groups()
+            for item in re.findall(r"'([a-z0-9_]+)'", tuple_body):
+                names.add('{}{}{}'.format(prefix, item, suffix))
+    return names - _NON_METRIC_PST_LITERALS
+
+
+def _documented_metric_names():
+    import re
+    docs = os.path.join(os.path.dirname(__file__), os.pardir, 'docs',
+                        'tpu_guide.rst')
+    with open(docs) as f:
+        text = f.read()
+    start = text.index('Metric name reference')
+    end = text.index('Input-bound escape hatches', start)
+    return set(re.findall(r"``(pst_[a-z0-9_]+)``", text[start:end]))
+
+
+@pytest.mark.observability
+def test_every_registered_metric_is_documented():
+    """Lint: the docs/tpu_guide.rst canonical metric table must cover
+    every pst_* instrument the source can register — a new metric without
+    a documented meaning fails here, and a table row whose metric was
+    removed fails the other direction (the table claims to be canonical)."""
+    source = _source_metric_names()
+    documented = _documented_metric_names()
+    undocumented = sorted(source - documented)
+    stale = sorted(documented - source)
+    assert not undocumented, (
+        'metrics registered in source but missing from the docs table '
+        '(docs/tpu_guide.rst "Metric name reference"): {}'.format(
+            undocumented))
+    assert not stale, (
+        'docs table rows with no registering source site (remove them or '
+        're-add the metric): {}'.format(stale))
