@@ -671,6 +671,33 @@ def _child_pipeline(url, workers, cache_tiers=None):
                     rates.append(batch * measure_batches / elapsed)
                 stats = loader.stats
                 t_read = stats.get('worker_stage_timings', {})
+        # Deterministic-mode overhead (ISSUE 8): the same pipeline with
+        # deterministic=True (Feistel epoch order + consumer-side
+        # resequencer), measured INSIDE the probe flock like the
+        # default-mode reps — an opportunistic probe landing between the
+        # two runs would load the box during only one of them and skew the
+        # det/default ratio the >= 0.7 acceptance gate reads.
+        # BENCH_PIPELINE_DETERMINISM=0 skips.
+        det_rate = None
+        if os.environ.get('BENCH_PIPELINE_DETERMINISM', '1') == '1':
+            det_reader = make_tensor_reader(
+                url, schema_fields=['image', 'label'],
+                reader_pool_type='thread', workers_count=workers,
+                num_epochs=None, shuffle_row_groups=True, seed=0,
+                cache_type='memory', deterministic=True)
+            with det_reader:
+                with JaxLoader(det_reader, batch, prefetch=prefetch,
+                               inflight=inflight) as det_loader:
+                    det_it = iter(det_loader)
+                    for _ in range(warm_batches):
+                        b = next(det_it)
+                    jax.block_until_ready(b.image)
+                    start = time.perf_counter()
+                    for _ in range(measure_batches):
+                        b = next(det_it)
+                    jax.block_until_ready(b.image)
+                    det_rate = batch * measure_batches / (time.perf_counter()
+                                                          - start)
         load_after = os.getloadavg()
     finally:
         lock.close()   # releases the flock if held
@@ -690,6 +717,11 @@ def _child_pipeline(url, workers, cache_tiers=None):
     lineage_rec = _lineage_summary(loader, ledger_dir)
     if lineage_rec is not None:
         profile['lineage'] = lineage_rec
+    if det_rate is not None:
+        profile['determinism'] = {
+            'img_per_sec': round(det_rate, 2),
+            'default_img_per_sec': round(median, 2),
+            'ratio_vs_default': round(det_rate / median, 4) if median else None}
     # Cache-tier sweep (ISSUE 5): --cache-tiers=null,memory,chunk-store on
     # the child command line, or BENCH_PIPELINE_CACHE_TIERS in the env.
     cache_tiers = cache_tiers or os.environ.get('BENCH_PIPELINE_CACHE_TIERS')

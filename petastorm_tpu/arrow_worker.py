@@ -18,6 +18,7 @@ import numpy as np
 import pyarrow as pa
 
 from petastorm_tpu.checkpoint import DeferredRowAccounting, chunk_key
+from petastorm_tpu.determinism import ResequencedReads
 from petastorm_tpu.workers.rowgroup_worker_base import (RowGroupWorkerBase,
                                                         chunk_row_permutation,
                                                         compute_row_slice)
@@ -29,7 +30,8 @@ class ArrowWorker(RowGroupWorkerBase):
     #: Reader-mode tag for batch provenance contexts (lineage.py).
     lineage_mode = 'arrow'
 
-    def process(self, piece_index, worker_predicate=None, shuffle_row_drop_partition=None):
+    def process(self, piece_index, worker_predicate=None,
+                shuffle_row_drop_partition=None, pst_det=None):
         from petastorm_tpu.faults import maybe_inject, rowgroup_fault_key
 
         from petastorm_tpu.trace import get_global_tracer
@@ -44,14 +46,14 @@ class ArrowWorker(RowGroupWorkerBase):
         with get_global_tracer().span('decode', 'worker'):
             table, read_fresh = self._load_table_cached(piece, worker_predicate)
         if table is None or table.num_rows == 0:
-            return
+            return self._publish_hole(pst_det)
 
         row_slice = compute_row_slice(table.num_rows, shuffle_row_drop_partition)
         if row_slice is not None:
             start, stop = row_slice
             table = table.slice(start, stop - start)
             if table.num_rows == 0:
-                return
+                return self._publish_hole(pst_det)
 
         transform_spec = self.args.get('transform_spec')
         if transform_spec is not None and transform_spec.func is not None:
@@ -87,8 +89,24 @@ class ArrowWorker(RowGroupWorkerBase):
                 filtered=worker_predicate is not None,
                 worker_id=self.worker_id)
             md[b'pst.lineage'] = json_mod.dumps(lineage).encode()
+            if pst_det is not None:
+                md[b'pst.det'] = json_mod.dumps(pst_det).encode()
             with get_global_tracer().span('handoff', 'worker'):
                 self.publish_func(table.replace_schema_metadata(md))
+        else:
+            self._publish_hole(pst_det)
+
+    def _publish_hole(self, pst_det):
+        """Arrow transports serialize tables (never dicts): the sequence-
+        hole placeholder is a zero-row, zero-column table whose schema
+        metadata carries the ``pst.det`` tag — it survives the IPC
+        serializer and the consumer recognizes ``num_rows == 0``."""
+        if pst_det is None:
+            return
+        import json as json_mod
+        empty = pa.table({}).replace_schema_metadata(
+            {b'pst.det': json_mod.dumps(pst_det).encode()})
+        self.publish_func(empty)
 
     def _apply_transform(self, table, transform_spec):
         """Pandas-based batch transform (parity: ``arrow_reader_worker.py:163-178``)."""
@@ -162,15 +180,18 @@ class ArrowWorker(RowGroupWorkerBase):
         return table.select(keep).take(pa.array(indices))
 
 
-class ArrowResultsQueueReader(DeferredRowAccounting):
+class ArrowResultsQueueReader(DeferredRowAccounting, ResequencedReads):
     """Consumer-side: one Arrow table -> namedtuple of numpy arrays (a batch).
 
     Parity: reference ``arrow_reader_worker.py:39-79``. Checkpoint
     accounting is chunk-level by default, row-granular after
     ``enable_deferred_rows`` (see ``checkpoint.DeferredRowAccounting``).
+    In deterministic mode chunk pops route through the reader's
+    resequencer (``ResequencedReads``).
     """
 
     _last_lineage = None
+    _last_det = None
 
     @property
     def batched_output(self):
@@ -182,13 +203,22 @@ class ArrowResultsQueueReader(DeferredRowAccounting):
         ``TensorResultsQueueReader.last_chunk_lineage``)."""
         return self._last_lineage
 
+    @property
+    def last_chunk_det(self):
+        """Deterministic-mode tag of the most recent chunk, or None."""
+        return self._last_det
+
     def read_next(self, pool, schema, ngram):
         import json as json_mod
         if ngram is not None:
             raise NotImplementedError('NGram is not supported with batch (Arrow) readers '
                                       '(parity: arrow_reader_worker.py:97-98)')
         while True:
-            table = pool.get_results()
+            table = self._pull(pool)
+            if table.num_rows == 0:
+                # Deterministic-mode sequence-hole placeholder (a worker
+                # never publishes a genuinely empty chunk).
+                continue
             md = table.schema.metadata or {}
             key = md.get(b'pst.key')
             key = key.decode() if key is not None else None
@@ -198,8 +228,14 @@ class ArrowResultsQueueReader(DeferredRowAccounting):
                     lineage = json_mod.loads(lineage.decode())
                 except ValueError:
                     lineage = None
+            det = md.get(b'pst.det')
+            if det is not None:
+                try:
+                    det = json_mod.loads(det.decode())
+                except ValueError:
+                    det = None
             if self._tracker is not None and key is not None:
-                skip = self._tracker.on_chunk(key, table.num_rows)
+                skip = self._tracker.on_chunk(key, table.num_rows, det=det)
                 if skip:
                     table = table.slice(skip)
                     if lineage is not None:
@@ -208,6 +244,7 @@ class ArrowResultsQueueReader(DeferredRowAccounting):
                     continue
                 self._record_chunk(key, table.num_rows)
             self._last_lineage = lineage
+            self._last_det = det
             break
         columns = {}
         for name in schema.fields:

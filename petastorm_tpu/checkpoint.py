@@ -29,7 +29,12 @@ Semantics:
 
 Determinism requirements: same dataset, same reader configuration. Worker
 interleaving may reorder rows — the guarantee is multiset-exactness, not
-order.
+order. For an *order-exact* (bit-identical stream) resume build the reader
+with ``deterministic=True``: consumption tracking then collapses to the
+compact stream cursor of :class:`petastorm_tpu.determinism.
+DeterministicCursor` and resume fast-forwards the seed-stable permutation
+instead of skipping chunks consumer-side (see ``docs/failure_model.rst``,
+"Determinism & elastic resume").
 """
 
 import logging
@@ -145,14 +150,20 @@ class ConsumptionTracker(object):
 
     # -- consumption events (called by results-queue readers) --------------
 
-    def on_chunk(self, key, total_rows):
+    def on_chunk(self, key, total_rows, det=None):
         """A new instance of ``key`` arrived with ``total_rows`` rows.
         Returns how many leading rows the consumer must drop.
+
+        ``det`` (the chunk's deterministic-mode tag) is accepted for call-
+        site uniformity with :class:`~petastorm_tpu.determinism.
+        DeterministicCursor` and ignored here — multiset accounting does
+        not care about order.
 
         Skipped instances/rows re-deliver consumption that prior sessions
         already counted in ``done``/``partial`` — they must NOT be counted
         again, or a resume-of-a-resume would over-skip.
         """
+        del det
         with self._lock:
             self._totals[key] = total_rows
             if self._skip_instances.get(key, 0) > 0:

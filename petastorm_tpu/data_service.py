@@ -383,12 +383,23 @@ class DataServer(object):
                 # the chunk's segment rides a reserved key next to the
                 # column blocks (tiny next to MB payloads; the consumer
                 # pops it before the columns reach the loader).
-                chunk_lineage = getattr(self._reader, 'last_chunk_lineage',
-                                        None) \
-                    if self._lineage_enabled else None
-                if chunk_lineage is not None:
-                    payload['__pst_lineage__'] = {'seg': chunk_lineage,
-                                                  'endpoint': self.data_endpoint}
+                # The deterministic-mode tag (seq/epoch/pos of the server
+                # reader's ventilation) rides the SAME reserved key as the
+                # provenance segment — no new wire-compat surface, the
+                # existing `lineage=False` fleet gate covers both. Either
+                # half may be present alone: a deterministic reader with
+                # provenance capture off still ships its stream cursor.
+                if self._lineage_enabled:
+                    chunk_lineage = getattr(self._reader,
+                                            'last_chunk_lineage', None)
+                    chunk_det = getattr(self._reader, 'last_chunk_det', None)
+                    if chunk_lineage is not None or chunk_det is not None:
+                        sidecar = {'endpoint': self.data_endpoint}
+                        if chunk_lineage is not None:
+                            sidecar['seg'] = chunk_lineage
+                        if chunk_det is not None:
+                            sidecar['det'] = chunk_det
+                        payload['__pst_lineage__'] = sidecar
                 frames = _dump_frames(payload)
                 seq = self._served_chunks
                 self._ring.append((seq, frames))
@@ -838,6 +849,7 @@ class RemoteReader(object):
         self._stopped = False
         self._nt_cache = {}
         self._last_lineage = None   # provenance of the latest chunk
+        self._last_det = None       # deterministic-mode tag of the latest chunk
         self._chunks = 0        # unique chunks received (dupes excluded)
         self._auth_key = auth_key
         self._seen = {}         # server_id -> _SeqTracker (under _acct_lock)
@@ -1004,13 +1016,20 @@ class RemoteReader(object):
             # row-group, worker, upstream tier) but re-tier it as 'remote'
             # — that IS this trainer's serving tier; the decode-side tier
             # survives as remote_tier for audits.
-            segment = dict(info.get('seg') or {})
-            segment['remote_tier'] = segment.get('tier')
-            segment['tier'] = 'remote'
-            segment['endpoint'] = info.get('endpoint')
-            self._last_lineage = segment
+            if info.get('seg') is not None:
+                segment = dict(info['seg'])
+                segment['remote_tier'] = segment.get('tier')
+                segment['tier'] = 'remote'
+                segment['endpoint'] = info.get('endpoint')
+                self._last_lineage = segment
+            else:
+                # det-only sidecar (provenance capture off server-side):
+                # no segment to re-tier.
+                self._last_lineage = None
+            self._last_det = info.get('det')
         else:
             self._last_lineage = None
+            self._last_det = None
         if self._row_granular:
             first = next(iter(cols.values()))
             self._unacked.append((cols, len(first)))
@@ -1022,6 +1041,17 @@ class RemoteReader(object):
         (``petastorm_tpu.lineage``), tier ``'remote'`` with the serving
         endpoint and the server-side tier under ``remote_tier``."""
         return self._last_lineage
+
+    @property
+    def last_chunk_det(self):
+        """Deterministic-mode tag of the most recently delivered chunk —
+        the serving reader's ventilation ``{'seq', 'epoch', 'pos'}``,
+        carried across the wire inside the lineage sidecar. A sole
+        consumer of one deterministic server receives chunks already in
+        seq order (the server's resequenced stream is FIFO over zmq);
+        multi-server shared streams interleave and are NOT order-
+        deterministic (see docs/failure_model.rst)."""
+        return getattr(self, '_last_det', None)
 
     def lineage_context(self):
         """Provenance context for a trainer-side ledger: the first

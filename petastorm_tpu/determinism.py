@@ -1,0 +1,539 @@
+"""Deterministic pipeline mode: order-stable shuffle, resequencing, cursor.
+
+The default pipeline guarantees only multiset-exactness across a
+checkpoint/resume (``checkpoint.py``): worker interleaving reorders rows, so
+a killed-and-resumed job trains on a *different batch sequence* than an
+uninterrupted one, and changing the worker or host count on restart changes
+the stream entirely. The reproducible-pipelines literature (PAPERS.md,
+arxiv 2604.21275) shows order-determinism is achievable without giving up
+parallel decode, and the elastic tf.data-service work (arxiv 2210.14826)
+makes it the precondition for elastic scaling. ``deterministic=True`` on the
+reader factories turns the batch stream into a pure function of
+``(dataset, schema, seed, epoch, position)`` via three mechanisms hosted
+here:
+
+:func:`epoch_order` / :func:`feistel_permute`
+    A counter-based pseudorandom permutation over the epoch's ventilation
+    items: a 4-round Feistel network over the item-index space, keyed by
+    ``(seed, epoch)`` through a hash, with cycle-walking to fit an
+    arbitrary domain size. Pure Python-int arithmetic — identical on every
+    platform, numpy version, and host — so any process can recompute "what
+    the shuffle chose for epoch e" from two scalars, with no RNG state to
+    carry. This is what makes resume *fast-forward* (recompute the
+    permutation, start feeding at the cursor) instead of skip-on-arrival,
+    and what makes the order independent of worker topology.
+
+:class:`Resequencer`
+    Workers tag every published chunk with its ventilation sequence number
+    (``pst_det`` item kwarg -> ``det`` chunk metadata, carried by all three
+    pool transports and the data-service wire). The resequencer sits
+    between the results queue and the consumer, holding out-of-order
+    chunks in a bounded buffer and releasing them strictly in ventilation
+    order. Its buffer is naturally bounded by the ventilator's in-flight
+    cap (at most that many items can be outstanding). A seq hole that
+    never fills (a wedged worker publish) surfaces through
+    :meth:`Resequencer.stats` — registered as a watchdog probe so the
+    PR-3 health machinery classifies it ``resequencer-stalled`` and
+    escalates instead of deadlocking.
+
+:class:`DeterministicCursor`
+    The deterministic replacement for ``checkpoint.ConsumptionTracker``:
+    because delivery order equals ventilation order, the whole consumption
+    state collapses to a compact stream cursor ``(epoch, global position,
+    rows into the open chunk)``. Resume fast-forwards the ventilator to
+    the cursor rather than skipping chunks consumer-side.
+
+Resharding: in deterministic mode ``cur_shard``/``shard_count`` is applied
+as a **stride over the global deterministic order** inside the ventilator
+(not a static row-group partition at filter time): host ``h`` of ``M``
+feeds global positions ``p`` with ``(p - resume_base) % M == h``. The
+global item sequence is the same for every ``M``, so a job checkpointed on
+N hosts resumes on M hosts — each host derives its positions from the same
+global cursor — and the round-robin concatenation of the per-host streams
+is identical to a single-host run. ``tests/test_determinism.py`` proves
+bit-identity (via the PR-7 per-field CRC32 lineage digests) across
+restarts, worker counts, pool types, and 1<->2<->3-shard strides.
+"""
+
+import hashlib
+import json
+import threading
+import time
+from collections import deque
+
+MODE = 'deterministic'
+STATE_VERSION = 1
+
+_M64 = (1 << 64) - 1
+_MISSING = object()
+
+
+# --------------------------------------------------------------------------
+# seed-stable permutation (counter-based PRP: Feistel + cycle-walking)
+# --------------------------------------------------------------------------
+
+def epoch_key(seed, epoch):
+    """64-bit permutation key for ``(seed, epoch)`` — hashed, so nearby
+    seeds/epochs produce unrelated permutations."""
+    digest = hashlib.md5('pst-det:{}:{}'.format(seed, epoch).encode()).digest()
+    return int.from_bytes(digest[:8], 'little')
+
+
+def _mix64(v):
+    """splitmix64 finalizer on a Python int (wraps mod 2^64): well-mixed,
+    platform-independent — deliberately NOT a numpy Generator, whose
+    bit-exactness across versions is not guaranteed."""
+    v &= _M64
+    v = ((v ^ (v >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    v = ((v ^ (v >> 27)) * 0x94D049BB133111EB) & _M64
+    return v ^ (v >> 31)
+
+
+def feistel_permute(index, n, key):
+    """Position of ``index`` under the keyed permutation of ``[0, n)``.
+
+    4-round balanced Feistel over the smallest even-bit domain covering
+    ``n``, cycle-walking out-of-domain values back through the network
+    (the domain is < 4n, so the expected walk is short). A bijection on
+    ``[0, n)`` for every key; O(1) memory — no materialized shuffle state.
+    """
+    if n <= 1:
+        return 0
+    if not 0 <= index < n:
+        raise ValueError('index {} out of [0, {})'.format(index, n))
+    half_bits = ((n - 1).bit_length() + 1) // 2
+    mask = (1 << half_bits) - 1
+    x = index
+    while True:
+        left, right = x >> half_bits, x & mask
+        for rnd in range(4):
+            left, right = right, left ^ (
+                _mix64(right + key + 0x9E3779B97F4A7C15 * (rnd + 1)) & mask)
+        x = (left << half_bits) | right
+        if x < n:
+            return x
+
+
+def epoch_order(n, seed, epoch, shuffle=True):
+    """The full item order for ``epoch`` as a list of item indices:
+    ``order[p]`` is the canonical item fed at global position ``p``.
+    Recomputable from scalars — identical across hosts, restarts, and
+    worker topologies. ``shuffle=False`` (``shuffle_row_groups=False``)
+    keeps storage order: the identity, every epoch."""
+    if not shuffle:
+        return list(range(n))
+    key = epoch_key(seed, epoch)
+    return [feistel_permute(p, n, key) for p in range(n)]
+
+
+def shard_positions(n, base, cur_shard, shard_count, phase=0):
+    """The global positions host ``cur_shard`` of ``shard_count`` feeds for
+    one epoch: ``p`` in ``[base, n)`` with ``(p - base + phase) %
+    shard_count == cur_shard``. ``base`` is the resume cursor position for
+    the resumed epoch (0 for fresh epochs); ``phase`` is the count of
+    global positions fed in EARLIER epochs since the job's stride base
+    (mod ``shard_count``). The phase keeps host assignment continuous
+    across epoch rolls — without it, an epoch whose item count is not
+    divisible by ``shard_count`` would restart the round-robin at host 0
+    mid-round, desynchronizing the concatenated stream from the epoch
+    boundary on. With it, global item ``j`` (counted from the stride base,
+    across epochs) always lands on host ``j % shard_count``, so the
+    round-robin concatenation of the per-host streams is the global order
+    from the cursor on — the same sequence for every ``shard_count``,
+    which is the reshard-invariance mechanism."""
+    first = base + ((cur_shard - phase) % shard_count)
+    return list(range(first, n, shard_count))
+
+
+def order_digest(items, order):
+    """Short digest of an epoch's fed order (by each item's JSON-safe
+    identity keys) — the deterministic-mode twin of the ventilator's
+    lineage order digest."""
+    digest = hashlib.md5()
+    for index in order:
+        item = items[index]
+        identity = ((item.get('piece_index', index),
+                     item.get('shuffle_row_drop_partition'))
+                    if isinstance(item, dict) else index)
+        digest.update(repr(identity).encode())
+    return digest.hexdigest()[:12]
+
+
+# --------------------------------------------------------------------------
+# chunk metadata access
+# --------------------------------------------------------------------------
+
+HOLE_KEY = '__pst_det_hole__'
+
+
+def hole_marker(det):
+    """The placeholder a worker publishes for a ventilated item that
+    produced no chunk (empty after predicate/drop-partition slicing):
+    without it the item's seq would be a hole the resequencer waits on
+    forever. The results-queue readers consume and discard these after
+    the resequencer advances past them. (Arrow workers publish a zero-row
+    table carrying the ``b'pst.det'`` metadata instead — a dict can't
+    cross the Arrow IPC serializer.)"""
+    return {HOLE_KEY: 1, 'det': det}
+
+
+def is_hole(chunk):
+    """True for payloads that exist only to fill a sequence hole: the
+    dict marker above, or a zero-row Arrow table."""
+    if isinstance(chunk, dict):
+        return bool(chunk.get(HOLE_KEY))
+    return getattr(chunk, 'num_rows', None) == 0
+
+
+def chunk_det(chunk):
+    """The ``{'seq', 'epoch', 'pos'}`` deterministic tag of a published
+    chunk, or ``None``. Dict payloads (tensor/py_dict/markers) carry it
+    under ``'det'``; Arrow tables in their schema metadata (``b'pst.det'``,
+    which survives the IPC serializer and the data-service wire)."""
+    if isinstance(chunk, dict):
+        return chunk.get('det')
+    schema = getattr(chunk, 'schema', None)
+    md = getattr(schema, 'metadata', None) if schema is not None else None
+    if md and b'pst.det' in md:
+        try:
+            return json.loads(md[b'pst.det'].decode())
+        except ValueError:
+            return None
+    return None
+
+
+class ResequencedReads(object):
+    """Mixin for results-queue readers: route pool pops through the
+    reader's :class:`Resequencer` when deterministic mode armed one."""
+
+    _resequencer = None
+
+    def set_resequencer(self, resequencer):
+        self._resequencer = resequencer
+
+    def _pull(self, pool):
+        resequencer = self._resequencer
+        if resequencer is not None:
+            return resequencer.next_chunk(pool)
+        return pool.get_results()
+
+
+# --------------------------------------------------------------------------
+# order restoration
+# --------------------------------------------------------------------------
+
+class Resequencer(object):
+    """Bounded reorder buffer releasing chunks strictly in ventilation order.
+
+    Driven by the consumer thread (:meth:`next_chunk`); quarantine sinks
+    fill holes for items that will never publish (:meth:`mark_satisfied`);
+    the watchdog samples :meth:`stats` from its own thread — hence the
+    lock (all operations are off the per-row hot path: one acquisition
+    per *chunk*).
+
+    The buffer needs no explicit pacing: the ventilator feeds at most its
+    in-flight cap ahead of completion, so at most that many chunks can be
+    out of order. ``max_buffer`` is a safety net against seq-accounting
+    bugs, far above any real cap.
+    """
+
+    def __init__(self, max_buffer=4096):
+        self._lock = threading.Lock()
+        self._expected = 0
+        self._buffer = {}
+        self._satisfied = set()   # seqs satisfied without a chunk (quarantine)
+        self._wait_since = None   # monotonic time the current hole opened
+        self._max_buffer = max_buffer
+        self._out_of_order = 0
+
+    def next_chunk(self, pool):
+        """The next chunk in ventilation order (pulling from ``pool`` as
+        needed). End-of-data / timeout / stall errors from the pool
+        propagate unchanged; untagged payloads pass straight through."""
+        from petastorm_tpu.workers import EmptyResultError
+        while True:
+            with self._lock:
+                chunk = self._pop_ready_locked()
+            if chunk is not _MISSING:
+                return chunk
+            try:
+                result = pool.get_results()
+            except EmptyResultError:
+                with self._lock:
+                    buffered = len(self._buffer)
+                if buffered:
+                    # The pool declared end-of-data while chunks still sit
+                    # behind a hole: a seq was lost (not quarantined, not
+                    # published). Surface the accounting bug instead of
+                    # silently reordering or dropping the buffered chunks.
+                    raise RuntimeError(
+                        'Resequencer: pool exhausted with {} chunk(s) '
+                        'buffered behind missing ventilation seq {} — a '
+                        'published chunk was lost'.format(
+                            buffered, self._expected))
+                raise
+            det = chunk_det(result)
+            if det is None:
+                return result
+            seq = det.get('seq')
+            with self._lock:
+                if seq is None or seq == self._expected:
+                    self._advance_locked()
+                    return result
+                if seq < self._expected:
+                    # Stale duplicate (should not happen under the pools'
+                    # exactly-once redelivery); dropping preserves order.
+                    continue
+                self._out_of_order += 1
+                self._buffer[seq] = result
+                if self._wait_since is None:
+                    self._wait_since = time.monotonic()
+                if len(self._buffer) > self._max_buffer:
+                    raise RuntimeError(
+                        'Resequencer buffer overflow: {} chunks held waiting '
+                        'for ventilation seq {} — sequence accounting is '
+                        'broken'.format(len(self._buffer), self._expected))
+
+    def _pop_ready_locked(self):
+        while self._expected in self._satisfied:
+            self._satisfied.discard(self._expected)
+            self._expected += 1
+        chunk = self._buffer.pop(self._expected, _MISSING)
+        if chunk is not _MISSING:
+            self._advance_locked()
+        return chunk
+
+    def _advance_locked(self):
+        self._expected += 1
+        while self._expected in self._satisfied:
+            self._satisfied.discard(self._expected)
+            self._expected += 1
+        self._wait_since = time.monotonic() if self._buffer else None
+
+    def mark_satisfied(self, seq):
+        """Record that ``seq`` will never publish a chunk (its row-group
+        was quarantined): the hole is filled so ordered release continues
+        past it instead of deadlocking."""
+        with self._lock:
+            if seq == self._expected:
+                self._advance_locked()
+            elif seq > self._expected:
+                self._satisfied.add(seq)
+
+    def stats(self):
+        """Watchdog-probe snapshot: how long the stream has been held at a
+        hole, and how much is buffered behind it. ``waiting_s`` > 0 with
+        ``buffered`` > 0 is the ``resequencer-stalled`` signature
+        (``health.classify_stall``)."""
+        with self._lock:
+            waiting = (time.monotonic() - self._wait_since
+                       if self._wait_since is not None and self._buffer
+                       else 0.0)
+            return {'expected_seq': self._expected,
+                    'buffered': len(self._buffer),
+                    'waiting_s': round(waiting, 3),
+                    'out_of_order_total': self._out_of_order}
+
+    def reset(self):
+        """Restart sequence expectations (``Reader.reset()`` pairs this
+        with the ventilator's own reset)."""
+        with self._lock:
+            self._expected = 0
+            self._buffer.clear()
+            self._satisfied.clear()
+            self._wait_since = None
+
+
+# --------------------------------------------------------------------------
+# stream cursor
+# --------------------------------------------------------------------------
+
+class DeterministicCursor(object):
+    """Consumption tracking in deterministic mode: a compact stream cursor.
+
+    Chunks arrive strictly in ventilation order (the resequencer
+    guarantees it), so consumption state is just the frontier:
+    ``(epoch, global position of the open item, rows consumed into it)``.
+    Unlike ``ConsumptionTracker`` there are no per-key multisets and
+    resume does not skip chunks consumer-side — the ventilator
+    fast-forwards the recomputable permutation to the cursor instead; the
+    only consumer-side skip is the partial ``rows_into`` of the first
+    chunk.
+
+    Thread-safe for the same reason as ``ConsumptionTracker``: the
+    consuming side may be a background thread while ``state_dict()`` runs
+    from the training thread mid-iteration.
+
+    Entries for chunks delivered but not yet fully attributed (rows
+    buffered downstream under row-granular accounting) queue in ``_open``;
+    the frontier only advances past an item when all its rows were
+    attributed, so a checkpoint never counts a row the trainer has not
+    seen.
+    """
+
+    def __init__(self, resume_state=None):
+        self._lock = threading.Lock()
+        self._open = deque()     # [epoch, pos, total_rows, rows_done]
+        epoch, pos, rows = 1, 0, 0
+        if resume_state:
+            if resume_state.get('mode') != MODE:
+                raise ValueError(
+                    'resume_state is not a deterministic-mode cursor '
+                    '(mode={!r}); it was captured without '
+                    'deterministic=True'.format(resume_state.get('mode')))
+            if resume_state.get('version') != STATE_VERSION:
+                raise ValueError('Unsupported deterministic cursor version '
+                                 '{!r}'.format(resume_state.get('version')))
+            epoch = int(resume_state.get('epoch', 1))
+            pos = int(resume_state.get('pos', 0))
+            rows = int(resume_state.get('rows_into', 0))
+        self.start_epoch = epoch
+        self.start_pos = pos
+        self.start_rows = rows
+        self._frontier = (epoch, pos, rows)
+        self._resume_pending = rows > 0
+
+    def normalize(self, n_items):
+        """Fold a cursor sitting exactly at an epoch's end (``pos ==
+        n_items``) onto the next epoch's start, so the ventilator's
+        fast-forward never targets a position past the permutation."""
+        with self._lock:
+            while n_items and self.start_pos >= n_items:
+                self.start_epoch += 1
+                self.start_pos = 0
+                self.start_rows = 0
+                self._resume_pending = False
+                self._frontier = (self.start_epoch, 0, 0)
+
+    # -- consumption events (same protocol as ConsumptionTracker) ----------
+
+    def on_chunk(self, key, total_rows, det=None):
+        """A chunk for global position ``det['pos']`` arrived (in order).
+        Returns leading rows to drop (non-zero only for the resume
+        chunk's prior-session partial)."""
+        if det is None:
+            return 0
+        with self._lock:
+            skip = 0
+            if self._resume_pending:
+                if (det.get('epoch') == self.start_epoch
+                        and det.get('pos') == self.start_pos):
+                    skip = min(self.start_rows, total_rows)
+                    self._resume_pending = False
+                elif (det.get('epoch', 0) > self.start_epoch
+                      or (det.get('epoch') == self.start_epoch
+                          and det.get('pos', 0) > self.start_pos)):
+                    # Delivery is strictly ordered, so a chunk PAST the
+                    # cursor means the cursor chunk will never arrive on
+                    # this host — a resharded resume strides it to shard 0
+                    # while shards 1..M-1 start one position later. Clear
+                    # the flag or their checkpoints would stay pinned to
+                    # the prior session's cursor forever.
+                    self._resume_pending = False
+            self._open.append([det.get('epoch'), det.get('pos'),
+                               total_rows, skip])
+            self._commit_locked()
+            return skip
+
+    def rows_yielded(self, key, n):
+        """Attribute ``n`` consumed rows to open items in delivery order
+        (``key`` is unused: order IS the identity here)."""
+        with self._lock:
+            while n > 0 and self._open:
+                head = self._open[0]
+                free = head[2] - head[3]
+                if free <= 0:
+                    self._commit_locked()
+                    continue
+                take = min(n, free)
+                head[3] += take
+                n -= take
+                self._commit_locked()
+
+    def _commit_locked(self):
+        while self._open:
+            head = self._open[0]
+            if head[3] < head[2]:
+                self._frontier = (head[0], head[1], head[3])
+                return
+            self._open.popleft()
+            self._frontier = (head[0], head[1] + 1, 0)
+
+    # -- persistence -------------------------------------------------------
+
+    def state_dict(self):
+        with self._lock:
+            epoch, pos, rows = self._frontier
+            if self._resume_pending:
+                # Prior-session partial not yet re-observed: carry forward.
+                epoch, pos, rows = (self.start_epoch, self.start_pos,
+                                    self.start_rows)
+            return {'version': STATE_VERSION, 'mode': MODE,
+                    'epoch': int(epoch), 'pos': int(pos),
+                    'rows_into': int(rows)}
+
+
+def merge_cursors(states):
+    """The global stream cursor of a sharded job: the *least-advanced*
+    per-host cursor.
+
+    Each host of an N-shard deterministic job checkpoints its own frontier
+    (the global position of ITS open item — strided positions, so hosts
+    differ by at most ``shard_count``). Resuming on M hosts needs ONE
+    global cursor every new host derives its stride from; the conservative
+    choice is the minimum frontier — positions between it and faster
+    hosts' frontiers re-deliver at most ``N - 1`` items (and any partial
+    ``rows_into`` of a faster host is dropped: a merged resume restarts
+    those few items from their first row). For exactly-once across a
+    reshard, checkpoint at an aligned step on every host (the usual
+    synchronous-training case) so the frontiers agree.
+
+    The merge is **mandatory** for every multi-host resume: a host's own
+    cursor is its private strided frontier, and resuming from it
+    duplicates some positions across hosts while never delivering others
+    — so the reader refuses unmerged multi-shard cursors. Pass ALL N
+    hosts' cursors here (validated when they carry their shard identity)
+    and hand the single merged result to every resuming host.
+    """
+    cursors, configs = [], []
+    shard_counts, shards_seen = set(), set()
+    for state in states:
+        if not isinstance(state, dict) or state.get('mode') != MODE:
+            raise ValueError('merge_cursors needs deterministic-mode '
+                             'cursors, got {!r}'.format(state))
+        if state.get('shard_count') is not None:
+            shard_counts.add(int(state['shard_count']))
+            if state.get('cur_shard') is not None:
+                shards_seen.add(int(state['cur_shard']))
+        if isinstance(state.get('config'), dict):
+            configs.append(state['config'])
+        cursors.append((int(state.get('epoch', 1)), int(state.get('pos', 0)),
+                        int(state.get('rows_into', 0))))
+    if not cursors:
+        raise ValueError('merge_cursors needs at least one cursor')
+    if len(shard_counts) > 1:
+        raise ValueError('cursors disagree on shard_count ({}) — they were '
+                         'not captured by one job'.format(sorted(shard_counts)))
+    if shard_counts:
+        count = shard_counts.pop()
+        if shards_seen and shards_seen != set(range(count)):
+            raise ValueError(
+                'merge_cursors got shards {} of a {}-shard job; the global '
+                'cursor needs every host\'s cursor (a missing fast shard '
+                'could silently re-deliver, a missing slow one could skip '
+                'rows)'.format(sorted(shards_seen), count))
+    if configs and any(c != configs[0] for c in configs[1:]):
+        raise ValueError('cursors carry differing reader config '
+                         'fingerprints — they were not captured by one job')
+    epoch, pos, rows = min(cursors)
+    if (epoch, pos) != max(cursors)[:2]:
+        rows = 0   # partial row offsets only make sense on an agreed item
+    merged = {'version': STATE_VERSION, 'mode': MODE, 'merged': True,
+              'epoch': epoch, 'pos': pos, 'rows_into': rows}
+    if configs:
+        # Carry the fingerprint so a resharded resume still gets the
+        # config-drift warning at resume time (the deterministic
+        # fingerprint already nulls cur_shard/shard_count, so every
+        # host of one job stores the identical dict).
+        merged['config'] = configs[0]
+    return merged

@@ -75,6 +75,7 @@ DISPATCH_HUNG = 'dispatch-hung'
 CONSUMER_NOT_DRAINING = 'consumer-not-draining'
 ARENA_POOL_WEDGED = 'arena-pool-wedged'
 REMOTE_SERVER_DEAD = 'remote-server-dead'
+RESEQUENCER_STALLED = 'resequencer-stalled'
 #: Pseudo-classification: every stale stage is parked in a *waiting* state
 #: (on upstream or the consumer) and no culpable stage has crossed its own
 #: deadline yet — not an actionable stall, so the watchdog records nothing
@@ -287,6 +288,25 @@ def classify_stall(beats, probes):
                 'worker process(es) {} are dead (PR-1 supervision will '
                 'respawn on the next get_results poll if budget remains)'
                 .format(dead_workers))
+
+    # Deterministic mode: chunks buffered behind a ventilation-seq hole
+    # while the handoff went quiet means the stream is held hostage by ONE
+    # unpublished item (a wedged worker publish) — the other workers kept
+    # producing, so worker-pool/reader symptoms look healthy. Checked
+    # after dead-workers (a respawned worker re-delivers the hole) and
+    # before the starvation rules (which would mis-blame the decode tier).
+    resequencer = probes.get('resequencer') or {}
+    if resequencer.get('buffered', 0) > 0 \
+            and resequencer.get('waiting_s', 0) > 0 \
+            and (stale('reader-handoff') or stale('consumer')
+                 or (stale('assemble')
+                     and state('assemble') == 'reader-wait')):
+        return (RESEQUENCER_STALLED, 'resequencer',
+                'deterministic resequencer has held {} chunk(s) for {}s '
+                'waiting for ventilation seq {} — one item never '
+                'published'.format(resequencer.get('buffered'),
+                                   resequencer.get('waiting_s'),
+                                   resequencer.get('expected_seq')))
 
     if stale('assemble'):
         st = state('assemble')

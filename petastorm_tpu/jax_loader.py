@@ -133,11 +133,22 @@ def _sanitize_array(array, x64=False):
 # host-side batch assembly (no jax dependency — independently testable)
 # --------------------------------------------------------------------------
 
+def _build_shuffling_buffer(capacity, min_after_dequeue, seed):
+    """The one shuffling-buffer construction shared by ``JaxLoader`` and
+    standalone ``iter_numpy_batches`` callers — same decorrelation floor
+    default (4/5 of capacity) and add-overshoot headroom either way."""
+    from petastorm_tpu.shuffling_buffer import RandomShufflingBuffer
+    if min_after_dequeue is None:
+        min_after_dequeue = capacity * 4 // 5
+    return RandomShufflingBuffer(capacity, min_after_dequeue, seed=seed,
+                                 extra_capacity=100000)
+
+
 def iter_numpy_batches(reader, batch_size, shape_policies=None,
                        shuffling_queue_capacity=0, min_after_dequeue=None,
                        seed=None, last_batch='drop', x64=False,
                        strict_fields=False, batch_buffers=None, views_ok=True,
-                       lineage=None):
+                       lineage=None, shuffler=None, commit_rows=None):
     """Yield dicts of numpy arrays with exact leading dim ``batch_size``.
 
     Works over both row readers (``make_reader``) and batch readers
@@ -163,6 +174,12 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
     provenance capture — each arriving chunk's segment metadata is pushed
     and each emitted batch pops the FIFO spans composing it (exact without
     a shuffling buffer; a shuffling buffer flags records inexact).
+
+    ``shuffler``: a pre-built (possibly checkpoint-restored)
+    :class:`~petastorm_tpu.shuffling_buffer.RandomShufflingBuffer` to use
+    instead of constructing one from ``shuffling_queue_capacity`` — the
+    JaxLoader owns its buffer this way so ``state_dict()`` can snapshot
+    buffered-but-undelivered rows.
     """
     if last_batch not in ('drop', 'pad', 'partial'):
         raise ValueError("last_batch must be drop|pad|partial, got {!r}".format(last_batch))
@@ -173,19 +190,15 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
     columns = {}
     count = 0
 
-    shuffler = None
-    if shuffling_queue_capacity and shuffling_queue_capacity > 0:
-        from petastorm_tpu.shuffling_buffer import RandomShufflingBuffer
-        if min_after_dequeue is None:
-            min_after_dequeue = shuffling_queue_capacity * 4 // 5
-        shuffler = RandomShufflingBuffer(shuffling_queue_capacity,
-                                         min_after_dequeue, seed=seed,
-                                         extra_capacity=100000)
-        if lineage is not None:
-            # Row-level shuffling breaks the FIFO chunk->batch mapping:
-            # records still name the contributing chunks, but row spans
-            # are no longer exact (replay refuses such records).
-            lineage.mark_inexact()
+    if shuffler is None and shuffling_queue_capacity \
+            and shuffling_queue_capacity > 0:
+        shuffler = _build_shuffling_buffer(shuffling_queue_capacity,
+                                           min_after_dequeue, seed)
+    if shuffler is not None and lineage is not None:
+        # Row-level shuffling breaks the FIFO chunk->batch mapping:
+        # records still name the contributing chunks, but row spans
+        # are no longer exact (replay refuses such records).
+        lineage.mark_inexact()
 
     def _is_tensor_like(probe, name):
         """True if a sample value can become a TPU tensor (possibly via policy)."""
@@ -328,7 +341,12 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
             lineage.on_chunk(getattr(reader, 'last_chunk_lineage', None),
                              len(rows))
         if shuffler is not None:
-            shuffler.add_many(rows)
+            if commit_rows is not None:
+                # Loader-supplied atomic commit: buffer insert + checkpoint
+                # attribution under one lock (see JaxLoader._commit_rows).
+                commit_rows(rows)
+            else:
+                shuffler.add_many(rows)
             while shuffler.can_retrieve():
                 row = shuffler.retrieve()
                 for name, value in zip(field_names, row):
@@ -670,7 +688,7 @@ class JaxLoader(object):
                  last_batch='drop', strict_fields=False, echo=1, tracer=None,
                  stage_chunks=1, arena_depth=None, inflight=2,
                  watchdog=None, stall_timeout_s=None, autotune=None,
-                 lineage=None):
+                 lineage=None, resume_state=None):
         import jax
 
         if tracer is None:
@@ -710,6 +728,49 @@ class JaxLoader(object):
                                             # attributed (deferred mode)
         if not shuffling_queue_capacity and hasattr(reader, 'enable_row_granular_checkpoint'):
             self._row_granular_ckpt = reader.enable_row_granular_checkpoint()
+
+        # The loader OWNS its shuffling buffer (rather than letting
+        # iter_numpy_batches build one): state_dict() then snapshots
+        # buffered-but-undelivered rows + the RNG state, so a checkpoint
+        # with a row-level shuffle engaged no longer forces a drain —
+        # restore them via JaxLoader(resume_state=the same dict handed to
+        # the reader factory).
+        self._shuffler = None
+        self._ckpt_lock = threading.Lock()
+        self._buffer_entry_ckpt = False
+        if shuffling_queue_capacity and shuffling_queue_capacity > 0:
+            self._shuffler = _build_shuffling_buffer(
+                shuffling_queue_capacity, min_after_dequeue, seed)
+            if isinstance(resume_state, dict) \
+                    and resume_state.get('shuffling_buffer'):
+                self._shuffler.restore(resume_state['shuffling_buffer'])
+            # Rows drawn into staged-but-undelivered batches must ride the
+            # snapshot too (they are in neither the buffer nor the
+            # trainer's hands at checkpoint time); mark_delivered below
+            # releases them batch-by-batch as batches actually arrive.
+            self._shuffler.track_pending()
+            # Buffer-entry attribution: defer the reader's checkpoint
+            # cursor and advance it only when a chunk's rows actually land
+            # in the buffer — _commit_rows does both under _ckpt_lock, and
+            # state_dict() snapshots cursor + buffer under the same lock.
+            # Without this, rows moving reader->buffer between the two
+            # snapshots would be counted by neither (lost) or both
+            # (duplicated) on resume.
+            if hasattr(reader, 'enable_row_granular_checkpoint'):
+                self._buffer_entry_ckpt = \
+                    reader.enable_row_granular_checkpoint()
+        elif isinstance(resume_state, dict) \
+                and (resume_state.get('shuffling_buffer') or {}).get('rows'):
+            # The snapshot's rows were already counted consumed by the
+            # reader cursor at checkpoint time; with no buffer to restore
+            # them into they would silently never be delivered.
+            raise ValueError(
+                'resume_state carries a shuffling-buffer snapshot of {} '
+                'row(s) but the loader was rebuilt without '
+                'shuffling_queue_capacity; those rows would be lost — '
+                'resume with the same shuffling_queue_capacity the '
+                'checkpoint was taken under'.format(
+                    len(resume_state['shuffling_buffer']['rows'])))
 
         if echo < 1:
             raise ValueError('echo must be >= 1, got {}'.format(echo))
@@ -879,7 +940,10 @@ class JaxLoader(object):
             last_batch=last_batch, x64=x64, strict_fields=strict_fields,
             batch_buffers=arena_buffers, views_ok=views_ok,
             lineage=(self._lineage.collector
-                     if self._lineage is not None else None))
+                     if self._lineage is not None else None),
+            shuffler=self._shuffler,
+            commit_rows=(self._commit_rows if self._shuffler is not None
+                         else None))
 
         # Start the engine LAST: it touches the state above immediately.
         if not self._consumer_staging:
@@ -1231,6 +1295,12 @@ class JaxLoader(object):
                 self._pending_fresh_rows += self._local_batch
             else:
                 self._reader.rows_consumed(self._local_batch)
+        elif self._shuffler is not None and fresh:
+            # This batch's draws reached the trainer: release them from
+            # the buffer's pending FIFO so only genuinely undelivered
+            # draws fold into a checkpoint snapshot. (A padded/short
+            # final batch over-reports; mark_delivered drains empty.)
+            self._shuffler.mark_delivered(self._local_batch)
         return nt(**{k: item[k] for k in names})
 
     def superbatches(self, k):
@@ -1392,13 +1462,37 @@ class JaxLoader(object):
           loader enables row-granular accounting — rows still sitting in the
           prefetch queue at checkpoint time are NOT counted consumed and
           re-deliver on resume. Exactly-once AND no loss, any epoch count.
-        * **Shuffling buffer engaged, or per-row readers**: rows buffered
-          downstream count as consumed. With ``num_epochs=None`` they come
-          around on a later epoch; with a finite epoch count they are lost
-          to the resumed run — checkpoint between epochs (or drain the
-          loader) if finite-epoch completeness matters there.
+        * **Shuffling buffer engaged**: rows buffered in it count as
+          consumed, but the buffer itself rides the state
+          (``state['shuffling_buffer']``: rows + RNG state — binary-safe
+          through ``JobCheckpointer``, which pickles non-JSON loader
+          states): rebuild the loader with ``resume_state=`` the same dict
+          and the buffered rows re-deliver with the draw sequence intact.
+          Rows inside a partially-assembled batch (fewer than
+          ``batch_size``) still follow chunk-level semantics.
+        * **Per-row readers without a buffer**: rows buffered downstream
+          count as consumed; with ``num_epochs=None`` they come around on
+          a later epoch.
         """
+        if self._shuffler is not None \
+                and hasattr(self._shuffler, 'state_dict'):
+            # Atomic against _commit_rows: without the lock, rows moving
+            # reader->buffer between the two snapshots would appear in
+            # both (re-delivered twice on resume) or neither (lost).
+            with self._ckpt_lock:
+                state = dict(self._reader.state_dict())
+                state['shuffling_buffer'] = self._shuffler.state_dict()
+            return state
         return self._reader.state_dict()
+
+    def _commit_rows(self, rows):
+        """Move one chunk's rows into the shuffling buffer and advance the
+        reader's checkpoint cursor as one atomic step (the assemble
+        thread's side of the ``state_dict`` lock)."""
+        with self._ckpt_lock:
+            self._shuffler.add_many(rows)
+            if self._buffer_entry_ckpt:
+                self._reader.rows_consumed(len(rows))
 
     def stop(self):
         if self._autotuner is not None:

@@ -11,6 +11,7 @@ queue reader that buffers a chunk and pops single rows (``:64-97``).
 import hashlib
 
 from petastorm_tpu.checkpoint import chunk_key
+from petastorm_tpu.determinism import ResequencedReads, is_hole
 from petastorm_tpu.unischema import decode_rows
 from petastorm_tpu.workers.rowgroup_worker_base import (RowGroupWorkerBase,
                                                         compute_row_slice)
@@ -35,7 +36,8 @@ class PyDictWorker(RowGroupWorkerBase):
     #: Reader-mode tag for batch provenance contexts (lineage.py).
     lineage_mode = 'py_dict'
 
-    def process(self, piece_index, worker_predicate=None, shuffle_row_drop_partition=None):
+    def process(self, piece_index, worker_predicate=None,
+                shuffle_row_drop_partition=None, pst_det=None):
         from petastorm_tpu.faults import maybe_inject, rowgroup_fault_key
 
         piece = self.args['row_groups'][piece_index]
@@ -83,11 +85,16 @@ class PyDictWorker(RowGroupWorkerBase):
                     piece, piece_index, shuffle_row_drop_partition, len(rows),
                     tier, filtered=worker_predicate is not None,
                     worker_id=self.worker_id)
+            payload = {'__pst_chunk__': 1,
+                       'key': chunk_key(piece_index, shuffle_row_drop_partition),
+                       'lineage': lineage,
+                       'rows': rows}
+            if pst_det is not None:
+                payload['det'] = pst_det
             with get_global_tracer().span('handoff', 'worker'):
-                self.publish_func({'__pst_chunk__': 1,
-                                   'key': chunk_key(piece_index, shuffle_row_drop_partition),
-                                   'lineage': lineage,
-                                   'rows': rows})
+                self.publish_func(payload)
+        else:
+            self._publish_hole(pst_det)
 
     def _apply_transform(self, row, transform_spec):
         out = transform_spec.func(row)
@@ -171,10 +178,12 @@ class PyDictWorker(RowGroupWorkerBase):
         return [{k: v for k, v in row.items() if k in schema.fields}
                 for row, include in zip(decoded_pred_rows, mask) if include]
 
-class PyDictResultsQueueReader(object):
+class PyDictResultsQueueReader(ResequencedReads):
     """Consumer-side: buffers a published chunk, pops single rows.
 
-    Parity: reference ``py_dict_reader_worker.py:64-97``.
+    Parity: reference ``py_dict_reader_worker.py:64-97``. In deterministic
+    mode chunk pops route through the reader's resequencer
+    (``ResequencedReads``) so delivery order equals ventilation order.
     """
 
     def __init__(self):
@@ -182,6 +191,7 @@ class PyDictResultsQueueReader(object):
         self._buffer = deque()
         self._tracker = None
         self._last_lineage = None
+        self._last_det = None
 
     def set_tracker(self, tracker):
         self._tracker = tracker
@@ -199,25 +209,35 @@ class PyDictResultsQueueReader(object):
         ngram payloads."""
         return self._last_lineage
 
+    @property
+    def last_chunk_det(self):
+        """Deterministic-mode tag of the chunk the most recently returned
+        row came from, or None outside deterministic mode."""
+        return self._last_det
+
     def read_next(self, pool, schema, ngram):
         while not self._buffer:
-            chunk = pool.get_results()
+            chunk = self._pull(pool)
+            if is_hole(chunk):
+                continue
             if isinstance(chunk, dict) and chunk.get('__pst_chunk__'):
                 key, rows = chunk['key'], chunk['rows']
                 lineage = chunk.get('lineage')
+                det = chunk.get('det')
             else:  # untagged payload (e.g. a custom worker)
-                key, rows, lineage = None, chunk, None
+                key, rows, lineage, det = None, chunk, None, None
             skip = 0
             if self._tracker is not None and key is not None:
-                skip = self._tracker.on_chunk(key, len(rows))
+                skip = self._tracker.on_chunk(key, len(rows), det=det)
             self._buffer.extend(
-                (key, row, lineage, skip + i)
+                (key, row, lineage, skip + i, det)
                 for i, row in enumerate(rows[skip:]))
-        key, row, lineage, row_index = self._buffer.popleft()
+        key, row, lineage, row_index, det = self._buffer.popleft()
         if lineage is not None:
             self._last_lineage = dict(lineage, row_start=row_index)
         else:
             self._last_lineage = None
+        self._last_det = det
         if self._tracker is not None and key is not None:
             self._tracker.rows_yielded(key, 1)
         if ngram is not None:

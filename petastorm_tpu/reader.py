@@ -13,6 +13,7 @@ import logging
 import os
 import warnings
 
+from petastorm_tpu import determinism
 from petastorm_tpu.arrow_worker import ArrowResultsQueueReader, ArrowWorker
 from petastorm_tpu.cache import LocalDiskArrowTableCache, LocalDiskCache, NullCache
 from petastorm_tpu.checkpoint import ConsumptionTracker
@@ -132,11 +133,22 @@ def make_reader(dataset_url,
                 error_budget=None,
                 watchdog=None,
                 stall_timeout_s=None,
-                autotune=None):
+                autotune=None,
+                deterministic=False):
     """Reader for datasets materialized with petastorm_tpu codecs.
 
     Parity: reference ``petastorm/reader.py:50-174``. Rejects plain Parquet
     stores (use :func:`make_batch_reader`) — reference ``reader.py:131-135``.
+
+    ``deterministic=True`` makes the chunk stream a pure function of
+    ``(dataset, schema, seed, epoch, position)`` — independent of worker
+    count, pool type, timing, and restarts (``petastorm_tpu.determinism``):
+    epoch order comes from a seed-stable counter-based permutation, a
+    consumer-side resequencer restores exact ventilation order, sharding
+    becomes a stride over the global order (reshard-invariant), and
+    ``state_dict()`` collapses to a compact stream cursor whose resume
+    fast-forwards the permutation. See ``docs/failure_model.rst``,
+    "Determinism & elastic resume".
 
     ``error_budget`` (opt-in) enables poison row-group quarantine: decode/IO
     failures inside workers skip-and-record the offending row-group
@@ -195,7 +207,7 @@ def make_reader(dataset_url,
                   resume_state=resume_state,
                   error_budget=error_budget,
                   watchdog=watchdog, stall_timeout_s=stall_timeout_s,
-                  autotune=autotune)
+                  autotune=autotune, deterministic=deterministic)
 
 
 def make_tensor_reader(dataset_url,
@@ -219,7 +231,8 @@ def make_tensor_reader(dataset_url,
                        error_budget=None,
                        watchdog=None,
                        stall_timeout_s=None,
-                       autotune=None):
+                       autotune=None,
+                       deterministic=False):
     """Decoded-columnar reader: the TPU hot path (no reference equivalent).
 
     Like :func:`make_reader` (codecs run, values are decoded) but columnar
@@ -307,7 +320,7 @@ def make_tensor_reader(dataset_url,
                   shuffle_rows_in_chunk=shuffle_rows_in_chunk,
                   error_budget=error_budget,
                   watchdog=watchdog, stall_timeout_s=stall_timeout_s,
-                  autotune=autotune)
+                  autotune=autotune, deterministic=deterministic)
 
 
 def make_batch_reader(dataset_url,
@@ -331,7 +344,8 @@ def make_batch_reader(dataset_url,
                       error_budget=None,
                       watchdog=None,
                       stall_timeout_s=None,
-                      autotune=None):
+                      autotune=None,
+                      deterministic=False):
     """Columnar batch reader for **any** Parquet store (no codecs needed).
 
     Parity: reference ``petastorm/reader.py:177-289``. Warns when pointed at a
@@ -373,7 +387,7 @@ def make_batch_reader(dataset_url,
                   shuffle_rows_in_chunk=shuffle_rows_in_chunk,
                   error_budget=error_budget,
                   watchdog=watchdog, stall_timeout_s=stall_timeout_s,
-                  autotune=autotune)
+                  autotune=autotune, deterministic=deterministic)
 
 
 class _CallableDict(dict):
@@ -501,7 +515,8 @@ class Reader(object):
                  num_epochs=1, cur_shard=None, shard_count=None,
                  cache=None, transform_spec=None, ngram=None, resume_state=None,
                  shuffle_rows_in_chunk=False, error_budget=None,
-                 watchdog=None, stall_timeout_s=None, autotune=None):
+                 watchdog=None, stall_timeout_s=None, autotune=None,
+                 deterministic=False):
         self._store = store
         self.stored_schema = stored_schema
         self.ngram = ngram
@@ -538,9 +553,16 @@ class Reader(object):
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
             raise ValueError('cur_shard {} out of range [0, {})'.format(cur_shard, shard_count))
 
+        self._deterministic = bool(deterministic)
         all_pieces = store.row_groups()
+        # Deterministic mode applies the shard as a STRIDE over the global
+        # deterministic order inside the ventilator (reshard-invariant),
+        # not as a static row-group partition here — every host filters to
+        # the same global list.
         filtered, worker_predicate = self._filter_row_groups(
-            all_pieces, predicate, rowgroup_selector, cur_shard, shard_count)
+            all_pieces, predicate, rowgroup_selector,
+            None if self._deterministic else cur_shard,
+            None if self._deterministic else shard_count)
         logger.debug('Reader will read %d of %d row-groups', len(filtered), len(all_pieces))
         self._row_groups = filtered
 
@@ -559,7 +581,14 @@ class Reader(object):
             'url': store.url,
             'fields': sorted(self.schema.fields),
             'num_epochs': num_epochs,
-            'cur_shard': cur_shard, 'shard_count': shard_count,
+            # Deterministic fingerprints drop the shard: resharding is an
+            # invariant there (the whole point), so a 4-host checkpoint
+            # must resume warning-free on 8 hosts.
+            'cur_shard': None if self._deterministic else cur_shard,
+            'shard_count': None if self._deterministic else shard_count,
+            'deterministic': self._deterministic,
+            'shuffle_row_groups': bool(shuffle_row_groups),
+            'seed': seed if self._deterministic else None,
             'shuffle_row_drop_partitions': shuffle_row_drop_partitions,
             'shuffle_rows_in_chunk': bool(shuffle_rows_in_chunk),
             'n_row_groups': len(self._row_groups),
@@ -570,18 +599,60 @@ class Reader(object):
                               for p in self._row_groups],
         }
         if resume_state is not None:
+            if (not self._deterministic
+                    and resume_state.get('mode') == determinism.MODE):
+                raise ValueError(
+                    'resume_state is a deterministic-mode stream cursor; '
+                    'build the resumed reader with deterministic=True (a '
+                    'multiset tracker would silently ignore it)')
+            if (self._deterministic and not resume_state.get('merged')
+                    and int(resume_state.get('shard_count') or 1) > 1):
+                # A host's own cursor is its private strided frontier:
+                # resuming from it offsets the new stride into the wrong
+                # congruence class — some global positions feed twice
+                # (across hosts), others never. Silent corruption, so
+                # refuse rather than warn.
+                raise ValueError(
+                    'resume_state is host {} of {}\'s private cursor; a '
+                    'multi-host deterministic resume must pass ALL hosts\' '
+                    'cursors through determinism.merge_cursors() and give '
+                    'every resuming host the single merged result'.format(
+                        resume_state.get('cur_shard'),
+                        resume_state.get('shard_count')))
             stored_fp = resume_state.get('config')
-            if stored_fp is not None and stored_fp != self._config_fingerprint:
+            if stored_fp is not None:
+                # Compare only keys both sides know: a checkpoint written
+                # by an older (or newer) version lacks keys this version
+                # fingerprints, and warning on every such resume would
+                # train operators to ignore the warning that exists to
+                # catch real config drift.
                 diff_keys = sorted(
-                    k for k in set(stored_fp) | set(self._config_fingerprint)
-                    if stored_fp.get(k) != self._config_fingerprint.get(k))
-                warnings.warn(
-                    'resume_state was captured under a different reader '
-                    'configuration (differing: {}); resume positions may be '
-                    'meaningless'.format(diff_keys))
-        self._tracker = ConsumptionTracker(resume_state, num_epochs=num_epochs)
+                    k for k in set(stored_fp) & set(self._config_fingerprint)
+                    if stored_fp[k] != self._config_fingerprint[k])
+                if diff_keys:
+                    warnings.warn(
+                        'resume_state was captured under a different reader '
+                        'configuration (differing: {}); resume positions may '
+                        'be meaningless'.format(diff_keys))
+        if self._deterministic:
+            # Order-exact consumption tracking: a compact stream cursor
+            # (delivery order == ventilation order, enforced by the
+            # resequencer below) instead of per-key multisets.
+            self._tracker = determinism.DeterministicCursor(resume_state)
+        else:
+            self._tracker = ConsumptionTracker(resume_state,
+                                               num_epochs=num_epochs)
         if hasattr(results_queue_reader, 'set_tracker'):
             results_queue_reader.set_tracker(self._tracker)
+        self._resequencer = None
+        if self._deterministic:
+            if not hasattr(results_queue_reader, 'set_resequencer'):
+                raise ValueError(
+                    'deterministic=True requires a resequencing results-'
+                    'queue reader; {} does not support it'.format(
+                        type(results_queue_reader).__name__))
+            self._resequencer = determinism.Resequencer()
+            results_queue_reader.set_resequencer(self._resequencer)
 
         self._cache = cache if cache is not None else NullCache()
         worker_args = {
@@ -616,18 +687,54 @@ class Reader(object):
         self._quarantine_log = QuarantineLog(error_budget, len(items),
                                              self._row_groups)
         if error_budget is not None:
-            self._workers_pool.quarantine_sink = self._quarantine_log.record
+            quarantine_sink = self._quarantine_log.record
+            if self._resequencer is not None:
+                resequencer = self._resequencer
+
+                def quarantine_sink(record,
+                                    _record=self._quarantine_log.record):
+                    # A quarantined item never publishes a chunk: fill its
+                    # sequence hole FIRST (even when the budget raise below
+                    # fires, the stream must not also wedge) — the item's
+                    # pst_det rides the quarantine summary.
+                    det = (record.item or {}).get('pst_det') \
+                        if isinstance(record.item, dict) else None
+                    if isinstance(det, dict) and det.get('seq') is not None:
+                        resequencer.mark_satisfied(det['seq'])
+                    _record(record)
+
+            self._workers_pool.quarantine_sink = quarantine_sink
+
+        det_config = None
+        if self._deterministic:
+            if shard_count is not None and shard_count > len(items):
+                raise NoDataAvailableError(
+                    'deterministic shard stride needs at least one item per '
+                    'shard: {} items < {} shards'.format(len(items),
+                                                         shard_count))
+            # Fold a cursor parked exactly at an epoch boundary onto the
+            # next epoch's start so the ventilator never fast-forwards past
+            # the permutation's end.
+            self._tracker.normalize(len(items))
+            det_config = {'seed': seed,
+                          'shuffle': bool(shuffle_row_groups),
+                          'cur_shard': cur_shard or 0,
+                          'shard_count': shard_count or 1,
+                          'start_epoch': self._tracker.start_epoch,
+                          'start_pos': self._tracker.start_pos}
 
         self._ventilator = ConcurrentVentilator(
             ventilate_fn=None,  # bound by pool.start
             items_to_ventilate=items,
             iterations=num_epochs,
-            randomize_item_order=shuffle_row_groups,
+            randomize_item_order=(shuffle_row_groups
+                                  and not self._deterministic),
             random_seed=seed,
             max_ventilation_queue_size=self._pool_workers_count() + _VENTILATE_EXTRA_ROWGROUPS,
             # Synchronous pools (dummy) drive ventilation from the consumer
             # thread; a feeder thread there is only GIL contention.
-            inline=getattr(self._workers_pool, 'inline_ventilation', False))
+            inline=getattr(self._workers_pool, 'inline_ventilation', False),
+            deterministic=det_config)
         # NVMe chunk-store readahead rides the ventilator's dispatch order:
         # the moment a row-group item is scheduled (workers_count + 2 items
         # ahead of the workers), madvise(WILLNEED) its store extents so the
@@ -814,6 +921,11 @@ class Reader(object):
             return diag
 
         registry.register_probe('worker-pool', pool_probe)
+        if self._resequencer is not None:
+            # The resequencer-stalled signature (health.classify_stall):
+            # chunks buffered behind a ventilation-seq hole while the
+            # handoff goes quiet.
+            registry.register_probe('resequencer', self._resequencer.stats)
 
         def nudge_reader(diagnosis):
             # Safe from the watchdog thread: wake a parked ventilator so
@@ -982,6 +1094,20 @@ class Reader(object):
         reader doesn't attach lineage (e.g. ngram payloads)."""
         return getattr(self._results_queue_reader, 'last_chunk_lineage', None)
 
+    @property
+    def deterministic(self):
+        """True when this reader runs in deterministic mode (seed-stable
+        order, resequenced delivery, stream-cursor checkpoints)."""
+        return self._deterministic
+
+    @property
+    def last_chunk_det(self):
+        """Deterministic-mode tag (``{'seq', 'epoch', 'pos'}``) of the
+        most recently yielded chunk/row — what a data-service server
+        forwards on the wire so trainer-side consumers see the stream
+        cursor. ``None`` outside deterministic mode."""
+        return getattr(self._results_queue_reader, 'last_chunk_det', None)
+
     def lineage_context(self):
         """The static reader facts a batch provenance record needs for
         deterministic replay (``petastorm_tpu.lineage.replay_record``):
@@ -1008,6 +1134,7 @@ class Reader(object):
             'shard_count': self._shard_count,
             'num_epochs': self._num_epochs,
             'shuffle_rows_in_chunk': self._shuffle_rows_in_chunk,
+            'deterministic': self._deterministic,
             'n_row_groups': len(self._row_groups),
             'transform': transform,
             'predicate': _describe_filter(self._predicate),
@@ -1054,6 +1181,13 @@ class Reader(object):
         ``petastorm_tpu/checkpoint.py`` for the full semantics.
         """
         state = self._tracker.state_dict()
+        if self._deterministic:
+            # The cursor's shard identity: merge_cursors validates it got
+            # one cursor per shard, and resume rejects an unmerged
+            # multi-shard cursor (a private strided frontier is not a
+            # global stream position).
+            state['cur_shard'] = self._cur_shard or 0
+            state['shard_count'] = self._shard_count or 1
         state['config'] = self._config_fingerprint
         return state
 
@@ -1067,6 +1201,10 @@ class Reader(object):
             raise NotImplementedError(
                 'Currently reset() is supported only after all rows were consumed')
         self.last_row_consumed = False
+        if self._resequencer is not None:
+            # Before the ventilator restarts feeding: its seq counter
+            # restarts at 0, so expectations must too.
+            self._resequencer.reset()
         self._ventilator.reset()
 
     def stop(self):
@@ -1101,6 +1239,8 @@ class Reader(object):
         diag['quarantined_rowgroups'] = self._quarantine_log.snapshot()
         diag['error_budget'] = (self._quarantine_log.budget
                                 if self._quarantine_log.enabled else None)
+        if self._resequencer is not None:
+            diag['resequencer'] = self._resequencer.stats()
         if self._health is not None:
             diag['watchdog'] = self._health.stats()
         elif self._health_registry is not None:

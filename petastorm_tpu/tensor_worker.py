@@ -30,6 +30,7 @@ from petastorm_tpu.checkpoint import DeferredRowAccounting, chunk_key
 from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec,
                                   NdarrayCodec, ScalarCodec, _fast_npy_decode,
                                   _native_image)
+from petastorm_tpu.determinism import ResequencedReads, is_hole
 from petastorm_tpu.errors import DecodeFieldError
 from petastorm_tpu.workers.rowgroup_worker_base import (RowGroupWorkerBase,
                                                         chunk_row_permutation,
@@ -68,7 +69,8 @@ class TensorWorker(RowGroupWorkerBase):
     #: picks its decode path by this).
     lineage_mode = 'tensor'
 
-    def process(self, piece_index, worker_predicate=None, shuffle_row_drop_partition=None):
+    def process(self, piece_index, worker_predicate=None,
+                shuffle_row_drop_partition=None, pst_det=None):
         from petastorm_tpu.faults import maybe_inject, rowgroup_fault_key
 
         piece = self.args['row_groups'][piece_index]
@@ -133,14 +135,14 @@ class TensorWorker(RowGroupWorkerBase):
         else:
             cols = load()
         if cols is None:
-            return
+            return self._publish_hole(pst_det)
         n_rows = len(next(iter(cols.values())))
 
         row_slice = compute_row_slice(n_rows, shuffle_row_drop_partition)
         if row_slice is not None:
             start, stop = row_slice
             if stop <= start:
-                return
+                return self._publish_hole(pst_det)
             cols = {k: v[start:stop] for k, v in cols.items()}
             n_rows = stop - start
 
@@ -161,7 +163,7 @@ class TensorWorker(RowGroupWorkerBase):
             keep = self.args['transformed_schema'].fields
             cols = {k: np.asarray(v) for k, v in out.items() if k in keep}
             if not cols:
-                return
+                return self._publish_hole(pst_det)
             n_rows = len(next(iter(cols.values())))
 
         if n_rows and self.args.get('shuffle_rows_in_chunk'):
@@ -189,13 +191,18 @@ class TensorWorker(RowGroupWorkerBase):
                                     and self.args.get('shuffle_rows_in_chunk')),
                 filtered=worker_predicate is not None,
                 worker_id=self.worker_id)
+            payload = {'__pst_tensor_chunk__': 1,
+                       'key': chunk_key(piece_index, shuffle_row_drop_partition),
+                       'cols': cols,
+                       'private': private,
+                       'lineage': lineage,
+                       'timings': timings}
+            if pst_det is not None:
+                payload['det'] = pst_det
             with get_global_tracer().span('handoff', 'worker'):
-                self.publish_func({'__pst_tensor_chunk__': 1,
-                                   'key': chunk_key(piece_index, shuffle_row_drop_partition),
-                                   'cols': cols,
-                                   'private': private,
-                                   'lineage': lineage,
-                                   'timings': timings})
+                self.publish_func(payload)
+        else:
+            self._publish_hole(pst_det)
 
     # --- loading ------------------------------------------------------
 
@@ -255,11 +262,14 @@ class TensorWorker(RowGroupWorkerBase):
         return table.take(pa.array(np.flatnonzero(mask)))
 
 
-class TensorResultsQueueReader(DeferredRowAccounting):
+class TensorResultsQueueReader(DeferredRowAccounting, ResequencedReads):
     """Consumer side: one decoded chunk -> namedtuple of numpy blocks.
 
     Checkpoint accounting is chunk-level by default, row-granular after
     ``enable_deferred_rows`` (see ``checkpoint.DeferredRowAccounting``).
+    In deterministic mode chunk pops route through the reader's
+    resequencer (``ResequencedReads``) so delivery order equals
+    ventilation order.
     """
 
     def __init__(self):
@@ -267,6 +277,7 @@ class TensorResultsQueueReader(DeferredRowAccounting):
                          'chunks': 0}
         self._last_private = False
         self._last_lineage = None
+        self._last_det = None
         #: Optional health.Heartbeat (wired by ``Reader.attach_health``):
         #: beaten per decoded chunk crossing the pool->consumer handoff,
         #: so the watchdog sees TensorWorker output flow directly.
@@ -302,10 +313,15 @@ class TensorResultsQueueReader(DeferredRowAccounting):
         if ngram is not None:
             raise NotImplementedError('NGram is not supported with tensor readers')
         while True:
-            chunk = pool.get_results()
+            chunk = self._pull(pool)
             if self.heartbeat is not None:
                 self.heartbeat.beat('handoff')
+            if is_hole(chunk):
+                # Deterministic-mode placeholder: its only job (advancing
+                # the resequencer frontier) is already done.
+                continue
             cols, key = chunk['cols'], chunk['key']
+            det = chunk.get('det')
             self._last_private = bool(chunk.get('private'))
             lineage = chunk.get('lineage')
             t = chunk.get('timings') or {}
@@ -315,7 +331,7 @@ class TensorResultsQueueReader(DeferredRowAccounting):
             self._timings['chunks'] += 1
             n_rows = len(next(iter(cols.values())))
             if self._tracker is not None:
-                skip = self._tracker.on_chunk(key, n_rows)
+                skip = self._tracker.on_chunk(key, n_rows, det=det)
                 if skip:
                     cols = {k: v[skip:] for k, v in cols.items()}
                     n_rows -= skip
@@ -330,9 +346,16 @@ class TensorResultsQueueReader(DeferredRowAccounting):
                     continue
                 self._record_chunk(key, n_rows)
             self._last_lineage = lineage
+            self._last_det = det
             break
         names = [n for n in schema.fields if n in cols]
         return schema.make_namedtuple(**{n: cols[n] for n in names})
+
+    @property
+    def last_chunk_det(self):
+        """Deterministic-mode tag (``seq``/``epoch``/``pos``) of the chunk
+        most recently returned, or None outside deterministic mode."""
+        return self._last_det
 
 
 # --------------------------------------------------------------------------

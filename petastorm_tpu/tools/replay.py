@@ -16,6 +16,14 @@ dataset or decode stack drifted since the run). ``--print-record`` dumps
 the raw record JSON for audits. Exit codes: 0 ok, 1 usage/lookup error,
 2 not replayable (inexact record, transform, unsupported mode),
 3 digest mismatch.
+
+``--diff-ledgers A B`` compares two runs' ledgers batch-by-batch (per-
+field CRC32 digests) and reports the first batch id where they diverge —
+the triage entry point when a ``deterministic=True`` resume was supposed
+to be bit-identical but training curves split (see
+docs/troubleshoot.rst, "resumed stream diverged"). Exit 0 when the
+overlapping id range matches, 3 on divergence, 1 when a ledger is empty
+or unreadable.
 """
 
 import argparse
@@ -23,15 +31,72 @@ import json
 import sys
 
 
+def _ledger_digests(path):
+    """batch_id -> (digest dict, rows) across every ledger under
+    ``path`` (a directory or a single file)."""
+    import os
+
+    from petastorm_tpu import lineage
+
+    if os.path.isfile(path):
+        _, records = lineage.read_ledger_file(path)
+    else:
+        records = [r for _, _, recs in lineage.read_ledger_dir(path)
+                   for r in recs]
+    out = {}
+    for record in records:
+        batch_id = record.get('batch_id')
+        if batch_id is not None:
+            out[batch_id] = (record.get('digest'), record.get('rows'))
+    return out
+
+
+def diff_ledgers(path_a, path_b):
+    """Compare two ledgers' digest sequences. Returns a JSON-safe report
+    with ``diverged`` (first differing batch id or None) and coverage
+    facts; raises ``LookupError`` when either side has no records."""
+    a, b = _ledger_digests(path_a), _ledger_digests(path_b)
+    if not a:
+        raise LookupError('no ledger records under {!r}'.format(path_a))
+    if not b:
+        raise LookupError('no ledger records under {!r}'.format(path_b))
+    common = sorted(set(a) & set(b))
+    diverged = None
+    detail = None
+    for batch_id in common:
+        if a[batch_id] != b[batch_id]:
+            diverged = batch_id
+            digest_a, rows_a = a[batch_id]
+            digest_b, rows_b = b[batch_id]
+            fields = sorted(set(digest_a or {}) | set(digest_b or {}))
+            detail = {'fields_differing': [f for f in fields
+                                           if (digest_a or {}).get(f)
+                                           != (digest_b or {}).get(f)],
+                      'rows_a': rows_a, 'rows_b': rows_b}
+            break
+    return {'a': str(path_a), 'b': str(path_b),
+            'records_a': len(a), 'records_b': len(b),
+            'common_batches': len(common),
+            'common_range': [common[0], common[-1]] if common else None,
+            'only_in_a': len(set(a) - set(b)),
+            'only_in_b': len(set(b) - set(a)),
+            'diverged': diverged,
+            'divergence': detail}
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description='Deterministically re-materialize one batch from a '
                     'provenance ledger')
-    parser.add_argument('--ledger', required=True,
+    parser.add_argument('--ledger',
                         help='ledger directory (PETASTORM_TPU_LINEAGE_DIR '
                              'of the run) or a single ledger-*.jsonl file')
-    parser.add_argument('--batch-id', required=True, type=int,
+    parser.add_argument('--batch-id', type=int,
                         help='the batch to re-materialize (record batch_id)')
+    parser.add_argument('--diff-ledgers', nargs=2, metavar=('A', 'B'),
+                        help='compare two runs\' ledgers and report the '
+                             'first batch id whose per-field digests '
+                             'diverge (exit 3 on divergence)')
     parser.add_argument('--pid', type=int, default=None,
                         help='producing process pid, to disambiguate when '
                              'several pipelines ledgered into one directory')
@@ -44,6 +109,19 @@ def main(argv=None):
     parser.add_argument('--print-record', action='store_true',
                         help='dump the raw record JSON instead of a summary')
     args = parser.parse_args(argv)
+
+    if args.diff_ledgers:
+        try:
+            report = diff_ledgers(*args.diff_ledgers)
+        except LookupError as e:
+            print('replay: {}'.format(e), file=sys.stderr)
+            return 1
+        print(json.dumps(report))
+        return 3 if report['diverged'] is not None else 0
+
+    if args.ledger is None or args.batch_id is None:
+        parser.error('--ledger and --batch-id are required '
+                     '(or use --diff-ledgers A B)')
 
     import os
 

@@ -16,8 +16,11 @@ multi-dataset mixture replays against the right dataset per span
 import numpy as np
 
 
+STATE_VERSION = 1
+
+
 class WeightedSamplingReader(object):
-    def __init__(self, readers, probabilities, seed=None):
+    def __init__(self, readers, probabilities, seed=None, resume_state=None):
         if len(readers) != len(probabilities):
             raise ValueError('readers and probabilities must have equal length')
         if len(readers) < 1:
@@ -30,6 +33,24 @@ class WeightedSamplingReader(object):
         self._rng = np.random.default_rng(seed)
         self._seed = seed
         self._last_source = None
+        if resume_state is not None:
+            # Resumable mixture draws: restoring the RNG stream replays
+            # the exact per-source draw sequence the prior session would
+            # have continued with — the pst_weighted_reader_draws_total
+            # counters then track the same trajectory, making drift after
+            # a resume visible as label-series divergence. Source readers
+            # are resumed individually (build each with its entry from
+            # state['sources'] before passing them here).
+            if resume_state.get('version') != STATE_VERSION \
+                    or resume_state.get('mode') != 'mixture':
+                raise ValueError(
+                    'resume_state is not a WeightedSamplingReader state '
+                    '(mode={!r})'.format(resume_state.get('mode')))
+            if resume_state.get('n_sources') != len(readers):
+                raise ValueError(
+                    'resume_state captured {} sources; this mixture has {}'
+                    .format(resume_state.get('n_sources'), len(readers)))
+            self._rng.bit_generator.state = resume_state['rng_state']
 
         first = readers[0]
         for other in readers[1:]:
@@ -129,6 +150,21 @@ class WeightedSamplingReader(object):
         return row
 
     next = __next__
+
+    def state_dict(self):
+        """Resumable mixture state: the draw RNG (so the per-source draw
+        sequence continues identically) plus each source reader's own
+        ``state_dict()``. Rebuild each source with its entry from
+        ``state['sources']`` and pass the whole dict back as
+        ``resume_state=`` to restore the RNG."""
+        sources = []
+        for reader in self._readers:
+            state_fn = getattr(reader, 'state_dict', None)
+            sources.append(state_fn() if state_fn is not None else None)
+        return {'version': STATE_VERSION, 'mode': 'mixture',
+                'n_sources': len(self._readers),
+                'rng_state': self._rng.bit_generator.state,
+                'sources': sources}
 
     def stop(self):
         for reader in self._readers:

@@ -66,6 +66,13 @@ def _summarize_item(args, kwargs):
                     isinstance(value, tuple)
                     and all(isinstance(v, int) for v in value)):
                 summary[key] = value
+        det = kwargs.get('pst_det')
+        if isinstance(det, dict):
+            # Deterministic-mode identity: the consumer-side resequencer
+            # needs the quarantined item's seq to fill its hole (the item
+            # will never publish a chunk) — see Reader's quarantine sink.
+            summary['pst_det'] = {k: det.get(k)
+                                  for k in ('seq', 'epoch', 'pos')}
     if not summary and args:
         summary['args'] = repr(args)[:120]
     return summary

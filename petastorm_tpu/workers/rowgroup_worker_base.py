@@ -42,6 +42,17 @@ class RowGroupWorkerBase(WorkerBase):
     def initialize(self):
         self._store = self.args['store_factory']()
 
+    def _publish_hole(self, pst_det):
+        """Deterministic mode: a ventilated item that produced no chunk
+        (empty after predicate / drop-partition slicing) still publishes a
+        placeholder carrying its ``pst_det`` tag, so the consumer-side
+        resequencer's expected-seq frontier advances past it instead of
+        waiting forever. No-op outside deterministic mode. Arrow workers
+        override (their transport serializes tables, not dicts)."""
+        if pst_det is not None:
+            from petastorm_tpu.determinism import hole_marker
+            self.publish_func(hole_marker(pst_det))
+
     # --- row-group reads ----------------------------------------------
 
     def _native_parquet_enabled(self):

@@ -11,11 +11,25 @@ TPU-first improvement: shuffling is **seeded and reproducible**
 (``random_seed``), unlike the reference's unseeded ``random.shuffle``
 (``ventilator.py:143-144``) — determinism across pod hosts matters for
 synchronized input pipelines (SURVEY.md §7 "Determinism across hosts").
+
+Deterministic mode (``deterministic=`` dict, armed by ``Reader`` when built
+with ``deterministic=True``) goes further: the stateful ``random.Random``
+epoch shuffle is replaced by the counter-based Feistel permutation of
+``petastorm_tpu.determinism`` keyed by ``(seed, epoch)`` — epoch order is a
+pure function of scalars, so any process recomputes it and resume
+*fast-forwards* to a cursor position instead of replaying RNG history. Each
+fed item additionally carries a ``pst_det`` tag (host-local ``seq`` for the
+consumer-side resequencer, absolute ``epoch`` and global ``pos`` for the
+stream cursor), and ``cur_shard``/``shard_count`` is applied here as a
+stride over the *global* order — the reshard-invariance mechanism (see the
+``determinism`` module docstring).
 """
 
 import hashlib
 import random
 import threading
+
+from petastorm_tpu import determinism
 
 
 class Ventilator(object):
@@ -45,7 +59,8 @@ class ConcurrentVentilator(Ventilator):
                  max_ventilation_queue_size=None,
                  ventilation_interval=0.01,
                  inline=False,
-                 backpressure_fn=None):
+                 backpressure_fn=None,
+                 deterministic=None):
         """
         :param ventilate_fn: called with ``**item`` for each ventilated item.
         :param items_to_ventilate: list of dicts of kwargs.
@@ -65,6 +80,15 @@ class ConcurrentVentilator(Ventilator):
             this to a results-queue watermark so a saturated downstream
             stops new row-groups from being fed (bounding decoded-block
             memory and tail latency). Assignable after construction.
+        :param deterministic: ``None`` (default, classic seeded shuffle) or
+            a dict ``{'seed', 'cur_shard', 'shard_count', 'start_epoch',
+            'start_pos'}`` arming seed-stable deterministic feeding: epoch
+            order comes from the counter-based Feistel permutation
+            (``determinism.epoch_order``), sharding is a stride over the
+            global order, ``start_epoch``/``start_pos`` fast-forward to a
+            resume cursor, and every fed item gains a ``pst_det`` tag
+            (``seq``/``epoch``/``pos``) the workers echo on published
+            chunks for the consumer-side resequencer.
         :param inline: no ventilation thread — the consumer drives
             ventilation by calling :meth:`pump` (synchronous pools). A
             ventilator thread next to an inline pool is pure overhead: on a
@@ -86,6 +110,17 @@ class ConcurrentVentilator(Ventilator):
         self._ventilation_interval = ventilation_interval
         self.inline = inline
         self.backpressure_fn = backpressure_fn
+
+        # Deterministic mode (petastorm_tpu.determinism): epoch order is
+        # the counter-based Feistel permutation, sharding is a stride over
+        # the global order, and every fed item carries a pst_det tag.
+        self._det = dict(deterministic) if deterministic is not None else None
+        self._det_epoch = 0          # absolute epoch being fed (1-based)
+        self._det_order = None       # epoch_order(...) of the current epoch
+        self._det_positions = None   # this shard's global positions
+        self._det_epoch_base = 0     # resume base of the current epoch
+        self._det_phase = 0          # round-robin offset from earlier epochs
+        self._det_seq = 0            # host-local seq (resequencer ordering)
 
         self._current_item_to_ventilate = 0
         self._in_flight = 0
@@ -122,7 +157,12 @@ class ConcurrentVentilator(Ventilator):
         if not self._items_to_ventilate or (self._iterations is not None and self._iterations == 0):
             self._completed_flag.set()
             return
-        if self._randomize_item_order:
+        if self._det is not None:
+            if not self._det_start():
+                # The resume cursor already sits past the final epoch.
+                self._completed_flag.set()
+                return
+        elif self._randomize_item_order:
             self._rng.shuffle(self._items_to_ventilate)
         self._on_epoch_order()
         if self.inline:
@@ -130,17 +170,84 @@ class ConcurrentVentilator(Ventilator):
         self._ventilation_thread = threading.Thread(target=self._ventilate, daemon=True)
         self._ventilation_thread.start()
 
+    def _det_start(self):
+        """Position the deterministic feed at the resume cursor. False
+        when the cursor's epoch already exhausted a finite iteration
+        budget (nothing left to feed)."""
+        det = self._det
+        start_epoch = max(1, int(det.get('start_epoch') or 1))
+        if self._iterations is not None:
+            self._iterations_remaining = self._iterations - (start_epoch - 1)
+            if self._iterations_remaining <= 0:
+                return False
+        self._det_seq = 0
+        self._det_epoch_setup(start_epoch, int(det.get('start_pos') or 0),
+                              phase=0)
+        return True
+
+    def _det_epoch_setup(self, epoch, base, phase):
+        """Fix one epoch's deterministic feed plan: the full permuted
+        order (recomputed from scalars — O(items), comparable to the
+        classic mode's Fisher-Yates shuffle) and this shard's stride
+        positions over it. ``phase`` carries the round-robin offset
+        accumulated by earlier epochs (see ``determinism.shard_positions``)
+        so host assignment stays continuous across epoch rolls."""
+        det = self._det
+        n = len(self._items_to_ventilate)
+        self._det_epoch = epoch
+        self._det_epoch_base = base
+        self._det_phase = phase
+        self._det_order = determinism.epoch_order(
+            n, det.get('seed'), epoch, shuffle=det.get('shuffle', True))
+        self._det_positions = determinism.shard_positions(
+            n, base, det.get('cur_shard') or 0, det.get('shard_count') or 1,
+            phase=phase)
+
+    def _epoch_items(self):
+        """How many items this feeder ventilates in the current epoch."""
+        return (len(self._det_positions) if self._det is not None
+                else len(self._items_to_ventilate))
+
+    def _next_item(self):
+        """The next item to feed (advancing the epoch position). In
+        deterministic mode the canonical item is resolved through the
+        epoch permutation and tagged with its ``pst_det`` identity."""
+        i = self._current_item_to_ventilate
+        self._current_item_to_ventilate += 1
+        if self._det is None:
+            return self._items_to_ventilate[i]
+        pos = self._det_positions[i]
+        item = dict(self._items_to_ventilate[self._det_order[pos]])
+        item['pst_det'] = {'seq': self._det_seq,
+                           'epoch': self._det_epoch,
+                           'pos': pos}
+        self._det_seq += 1
+        return item
+
     def _advance_epoch(self):
         """At the end of an item list, roll to the next epoch (reshuffling)
-        or mark completion. Returns False when all iterations are done."""
-        if self._current_item_to_ventilate >= len(self._items_to_ventilate):
+        or mark completion. Returns False when all iterations are done.
+        A ``while`` (not ``if``): a deterministic shard whose stride got
+        no positions in the resume epoch (cursor near the epoch's end)
+        rolls straight through to the next epoch."""
+        while self._current_item_to_ventilate >= self._epoch_items():
             if self._iterations_remaining is not None:
                 self._iterations_remaining -= 1
                 if self._iterations_remaining <= 0:
                     self._completed_flag.set()
                     return False
             self._current_item_to_ventilate = 0
-            if self._randomize_item_order:
+            if self._det is not None:
+                # Advance the stride phase by the positions ALL hosts fed
+                # in the finished epoch, keeping the global round-robin
+                # continuous across the roll (an epoch length that is not
+                # a multiple of shard_count would otherwise desync hosts).
+                n = len(self._items_to_ventilate)
+                shard_count = self._det.get('shard_count') or 1
+                phase = (self._det_phase
+                         + n - self._det_epoch_base) % shard_count
+                self._det_epoch_setup(self._det_epoch + 1, 0, phase)
+            elif self._randomize_item_order:
                 self._rng.shuffle(self._items_to_ventilate)
             self._on_epoch_order()
         return True
@@ -153,7 +260,12 @@ class ConcurrentVentilator(Ventilator):
         and only ever read by lineage probes, so it is computed lazily on
         first probe rather than stalling every epoch roll for pipelines
         that never arm lineage."""
-        self.epochs_started += 1
+        if self._det is not None:
+            # Deterministic epochs are absolute (resume fast-forwards past
+            # prior sessions' epochs without replaying them).
+            self.epochs_started = self._det_epoch
+        else:
+            self.epochs_started += 1
         self._epoch_order_digest = None
 
     def lineage_state(self):
@@ -164,13 +276,21 @@ class ConcurrentVentilator(Ventilator):
         epoch = self.epochs_started
         memo = self._epoch_order_digest
         if memo is None or memo[0] != epoch:
-            digest = hashlib.md5()
-            for index, item in enumerate(self._items_to_ventilate):
-                identity = (item.get('piece_index', index),
-                            item.get('shuffle_row_drop_partition')) \
-                    if isinstance(item, dict) else index
-                digest.update(repr(identity).encode())
-            memo = (epoch, digest.hexdigest()[:12])
+            if self._det is not None:
+                # The fed order is the epoch permutation, not the list
+                # order — digest what actually feeds, so two hosts of one
+                # deterministic job (and a resumed session) agree.
+                value = determinism.order_digest(self._items_to_ventilate,
+                                                 self._det_order)
+            else:
+                digest = hashlib.md5()
+                for index, item in enumerate(self._items_to_ventilate):
+                    identity = (item.get('piece_index', index),
+                                item.get('shuffle_row_drop_partition')) \
+                        if isinstance(item, dict) else index
+                    digest.update(repr(identity).encode())
+                value = digest.hexdigest()[:12]
+            memo = (epoch, value)
             self._epoch_order_digest = memo
         return {'epoch': epoch,
                 'order_digest': memo[1],
@@ -205,8 +325,7 @@ class ConcurrentVentilator(Ventilator):
                 break
             if not self._advance_epoch():
                 break
-            item = self._items_to_ventilate[self._current_item_to_ventilate]
-            self._current_item_to_ventilate += 1
+            item = self._next_item()
             self._in_flight += 1   # single-threaded: no lock needed
             self._observe(item)
             self._ventilate_fn(**item)
@@ -234,8 +353,7 @@ class ConcurrentVentilator(Ventilator):
             if below_cap and not backpressure:
                 if heartbeat is not None:
                     heartbeat.beat('ventilating')
-                item = self._items_to_ventilate[self._current_item_to_ventilate]
-                self._current_item_to_ventilate += 1
+                item = self._next_item()
                 with self._in_flight_lock:
                     self._in_flight += 1
                 self._observe(item)
@@ -291,6 +409,14 @@ class ConcurrentVentilator(Ventilator):
         self._started = False
         self._iterations_remaining = self._iterations
         self._current_item_to_ventilate = 0
+        if self._det is not None:
+            # A reset is a fresh round: the resume cursor was consumed by
+            # the first start. Re-applying it here would replay only the
+            # prior session's tail (and nothing at all for a cursor
+            # normalized past the final epoch) instead of `iterations`
+            # full epochs, unlike a default-mode reset.
+            self._det['start_epoch'] = 1
+            self._det['start_pos'] = 0
         with self._in_flight_lock:
             self._in_flight = 0
         self._completed_flag.clear()

@@ -169,6 +169,11 @@ def pytest_configure(config):
         'lineage: batch-provenance/replay tests (tests/test_lineage.py); '
         'the conftest guard sweeps leaked pst-lineage-* ledger temp dirs '
         'after them.')
+    config.addinivalue_line(
+        'markers',
+        'determinism: deterministic-mode tests (tests/test_determinism.py) '
+        'proving bit-identical streams across restarts/reshards; the '
+        'conftest guard fails on leaked pst-det* threads after them.')
 
 
 # ---------------------------------------------------------------------------
@@ -361,6 +366,34 @@ def _lineage_dir_guard(request):
     if leaked_threads:
         pytest.fail('lineage ledger writer thread(s) leaked past close(): '
                     '{}'.format(leaked_threads))
+
+
+# ---------------------------------------------------------------------------
+# Determinism leak guard: the resequencer is deliberately thread-free (it is
+# driven by the consumer), so deterministic-mode tests must leave NO pst-det*
+# thread behind — the guard exists to catch a future threaded implementation
+# (or helper) outliving its reader, mirroring the autotuner/exporter guards.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _determinism_thread_guard(request):
+    if request.node.get_closest_marker('determinism') is None:
+        yield
+        return
+    import threading
+    import time as _time
+
+    yield
+    deadline = _time.monotonic() + 2.0
+    leaked = []
+    while _time.monotonic() < deadline:
+        leaked = [t.name for t in threading.enumerate()
+                  if t.is_alive() and t.name.startswith('pst-det')]
+        if not leaked:
+            return
+        _time.sleep(0.05)
+    pytest.fail('deterministic-mode thread(s) leaked past reader close: '
+                '{}'.format(leaked))
 
 
 @pytest.fixture(autouse=True)

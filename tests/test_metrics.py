@@ -378,7 +378,10 @@ def test_flight_recorder_dump_on_injected_stall(synthetic_dataset, tmp_path,
 
 #: pst_-prefixed string literals that are NOT metric names (native shared-
 #: library build targets).
-_NON_METRIC_PST_LITERALS = {'pst_image', 'pst_parquet', 'pst_shm_ring'}
+# Non-metric pst_* literals the source scanner must ignore: native module
+# names and the deterministic-mode item/chunk tag key (workers/ventilator).
+_NON_METRIC_PST_LITERALS = {'pst_image', 'pst_parquet', 'pst_shm_ring',
+                            'pst_det'}
 
 
 def _source_metric_names():
