@@ -1,0 +1,78 @@
+"""pstlint: project-invariant static analysis + runtime sanitizer.
+
+The pipeline's correctness rests on invariants no general-purpose linter
+knows: lock acquisition order across ~25 lock-owning modules, the
+``pst-*`` thread lifecycle contract, purity of the deterministic-mode
+order path, and the string-keyed registries (env vars, fault sites,
+pytest markers) whose drift reviews kept catching by hand. This package
+machine-checks them:
+
+* :mod:`~petastorm_tpu.analysis.core` — shared AST project model,
+  findings, ``# pstlint: disable=check(reason)`` suppressions.
+* :mod:`~petastorm_tpu.analysis.lock_order` — lock-order graph cycles +
+  blocking calls under a held lock.
+* :mod:`~petastorm_tpu.analysis.threads` — thread naming / daemon-or-
+  joined / leak-guard-registry coverage.
+* :mod:`~petastorm_tpu.analysis.determinism_taint` — nondeterminism
+  reaching ``@deterministic_safe`` code.
+* :mod:`~petastorm_tpu.analysis.registry_sync` — env-var, fault-site and
+  pytest-marker registries synced with source, both directions.
+* :mod:`~petastorm_tpu.analysis.registry` — the canonical leak-guard
+  table shared with ``tests/conftest.py``.
+* :mod:`~petastorm_tpu.analysis.sanitize` — the opt-in
+  (``PETASTORM_TPU_SANITIZE``) runtime layer: arena poison-on-reclaim +
+  borrow-tagged views and the lock-order recorder.
+
+CLI: ``python -m petastorm_tpu.tools.pstlint [paths]`` — exits nonzero on
+findings; ``tests/test_pstlint.py::test_package_tree_is_clean`` is the
+tier-1 gate pinning the shipped tree at zero.
+"""
+
+from petastorm_tpu.analysis.core import (Finding,  # noqa: F401
+                                         apply_suppressions, load_project)
+from petastorm_tpu.analysis.sanitize import (LockOrderRecorder,  # noqa: F401
+                                             LockOrderViolation,
+                                             StaleViewError, guard_view,
+                                             sanitize_active, tracked_lock)
+
+#: check-id prefix -> checker module; the driver runs these in order.
+CHECKS = ('lock-order', 'threads', 'determinism', 'registry')
+
+
+def run_checks(roots, checks=None):
+    """Run the selected checkers over ``roots``.
+
+    Returns ``(findings, lock_edges)``: post-suppression findings sorted
+    by location, plus the static lock graph (for ``--emit-lock-graph``
+    and the runtime recorder). ``checks`` is an iterable of entries from
+    :data:`CHECKS`; None runs everything.
+    """
+    from petastorm_tpu.analysis import (determinism_taint, lock_order,
+                                        registry_sync, threads)
+    selected = set(CHECKS if checks is None else checks)
+    unknown = selected - set(CHECKS)
+    if unknown:
+        raise ValueError('unknown checks: {} (known: {})'.format(
+            sorted(unknown), list(CHECKS)))
+    project = load_project(roots)
+    findings = []
+    lock_edges = {}
+    checks_run = {'suppression'}
+    if 'lock-order' in selected:
+        lock_findings, lock_edges = lock_order.check(project)
+        findings.extend(lock_findings)
+        checks_run.update((lock_order.CHECK_CYCLE,
+                           lock_order.CHECK_BLOCKING))
+    if 'threads' in selected:
+        findings.extend(threads.check(project))
+        checks_run.update((threads.CHECK_NAME, threads.CHECK_REGISTRY,
+                           threads.CHECK_LIFECYCLE))
+    if 'determinism' in selected:
+        findings.extend(determinism_taint.check(project))
+        checks_run.add(determinism_taint.CHECK)
+    if 'registry' in selected:
+        findings.extend(registry_sync.check(project))
+        checks_run.update((registry_sync.CHECK_ENV,
+                           registry_sync.CHECK_FAULT,
+                           registry_sync.CHECK_MARKER))
+    return apply_suppressions(project, findings, checks_run), lock_edges

@@ -615,9 +615,11 @@ class DataServer(object):
         """Serve on a background thread (returns immediately)."""
         if self._thread is not None:
             raise RuntimeError('server already started')
-        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True,
+                                        name='pst-data-service-serve')
         self._thread.start()
-        self._rpc_thread = threading.Thread(target=self._rpc_loop, daemon=True)
+        self._rpc_thread = threading.Thread(target=self._rpc_loop, daemon=True,
+                                            name='pst-data-service-rpc')
         self._rpc_thread.start()
         return self
 

@@ -68,10 +68,24 @@ _M64 = (1 << 64) - 1
 _MISSING = object()
 
 
+def deterministic_safe(fn):
+    """Marker: ``fn`` is on the order-defining path of deterministic mode
+    and must be a pure function of its arguments — no wall-clock reads, no
+    process-global RNG state, no set-iteration order. The marker changes
+    nothing at runtime; the pstlint ``det-taint`` checker
+    (:mod:`petastorm_tpu.analysis.determinism_taint`) enforces the purity
+    claim statically, *transitively through everything the function
+    calls*. Decorate any new function whose output feeds the deterministic
+    stream's order."""
+    fn.__deterministic_safe__ = True
+    return fn
+
+
 # --------------------------------------------------------------------------
 # seed-stable permutation (counter-based PRP: Feistel + cycle-walking)
 # --------------------------------------------------------------------------
 
+@deterministic_safe
 def epoch_key(seed, epoch):
     """64-bit permutation key for ``(seed, epoch)`` — hashed, so nearby
     seeds/epochs produce unrelated permutations."""
@@ -79,6 +93,7 @@ def epoch_key(seed, epoch):
     return int.from_bytes(digest[:8], 'little')
 
 
+@deterministic_safe
 def _mix64(v):
     """splitmix64 finalizer on a Python int (wraps mod 2^64): well-mixed,
     platform-independent — deliberately NOT a numpy Generator, whose
@@ -89,6 +104,7 @@ def _mix64(v):
     return v ^ (v >> 31)
 
 
+@deterministic_safe
 def feistel_permute(index, n, key):
     """Position of ``index`` under the keyed permutation of ``[0, n)``.
 
@@ -114,6 +130,7 @@ def feistel_permute(index, n, key):
             return x
 
 
+@deterministic_safe
 def epoch_order(n, seed, epoch, shuffle=True):
     """The full item order for ``epoch`` as a list of item indices:
     ``order[p]`` is the canonical item fed at global position ``p``.
@@ -126,6 +143,7 @@ def epoch_order(n, seed, epoch, shuffle=True):
     return [feistel_permute(p, n, key) for p in range(n)]
 
 
+@deterministic_safe
 def shard_positions(n, base, cur_shard, shard_count, phase=0):
     """The global positions host ``cur_shard`` of ``shard_count`` feeds for
     one epoch: ``p`` in ``[base, n)`` with ``(p - base + phase) %
@@ -145,6 +163,7 @@ def shard_positions(n, base, cur_shard, shard_count, phase=0):
     return list(range(first, n, shard_count))
 
 
+@deterministic_safe
 def order_digest(items, order):
     """Short digest of an epoch's fed order (by each item's JSON-safe
     identity keys) — the deterministic-mode twin of the ventilator's

@@ -31,6 +31,21 @@ Sites and their effects when they fire:
                      ``should_fire`` (keyed by the chunk cache key) so the
                      effect is the store's own corruption path, not a
                      generic raise; ``inject()`` elsewhere raises IOError.
+``arena-stale-view`` seed a use-after-reclaim bug: the staging engine
+                     (``staging.py``) keeps a borrow-tagged view of an
+                     arena buffer past its retirement and touches it. With
+                     the sanitizer armed (``PETASTORM_TPU_SANITIZE``) the
+                     touch raises ``StaleViewError`` at the exact stale
+                     access; unarmed it reads poisoned-or-recycled memory
+                     silently — the bug class the sanitizer exists to
+                     catch. Consumed via ``should_fire``.
+``lock-order-invert`` seed a lock-order inversion: the dispatch path
+                     acquires a canonical pair of sanitizer-tracked locks
+                     in inverted order
+                     (``analysis.sanitize.maybe_inject_lock_inversion``).
+                     Armed, the lock-order recorder raises
+                     ``LockOrderViolation`` before blocking; unarmed the
+                     inversion is silent. Consumed via ``should_fire``.
 ==================== ======================================================
 
 Params (all optional):
@@ -66,6 +81,24 @@ logger = logging.getLogger(__name__)
 
 ENV_VAR = 'PETASTORM_TPU_FAULTS'
 
+#: Canonical fault-site registry. Every injection point in the package
+#: must name a site declared here (enforced by the pstlint
+#: ``registry-fault`` checker, which also pins each site to a row in the
+#: docstring table above and in ``docs/failure_model.rst``), and
+#: :meth:`FaultSpec.parse` rejects unknown sites so a typo'd spec fails
+#: the test that wrote it instead of silently injecting nothing.
+KNOWN_SITES = (
+    'fs-read-error',
+    'fs-read-delay',
+    'decode-corrupt',
+    'worker-kill',
+    'queue-stall',
+    'device-put-delay',
+    'store-read-corrupt',
+    'arena-stale-view',
+    'lock-order-invert',
+)
+
 #: Sites whose effect is a sleep rather than an error.
 _DELAY_SITES = ('fs-read-delay', 'queue-stall', 'device-put-delay')
 
@@ -91,6 +124,11 @@ class FaultSpec(object):
         if not parts:
             raise ValueError('empty fault spec')
         site, kwargs = parts[0], {}
+        if site not in KNOWN_SITES:
+            raise ValueError(
+                'unknown fault site {!r} (known: {}) — a typo here would '
+                'otherwise inject nothing, silently'.format(
+                    site, ', '.join(KNOWN_SITES)))
         renames = {'p': 'p', 'seed': 'seed', 'max': 'max_fires',
                    'delay': 'delay_s', 'token': 'token'}
         for param in parts[1:]:

@@ -172,7 +172,11 @@ class HeartbeatRegistry(object):
     """
 
     def __init__(self, stall_timeouts=None):
-        self._lock = threading.Lock()
+        # Sanitizer hookup: lock-order-recorded when PETASTORM_TPU_SANITIZE
+        # is armed (name matches pstlint's static graph node).
+        from petastorm_tpu.analysis import sanitize
+        self._lock = sanitize.tracked_lock(
+            'petastorm_tpu.health:HeartbeatRegistry._lock')
         self._beats = {}
         self._probes = {}
         self._recoveries = {}     # classification label -> [fn, ...]

@@ -302,7 +302,11 @@ class LineageTracker(object):
         from petastorm_tpu import metrics
         self.ctx = dict(ctx or {})
         self._state_fn = state_fn
-        self._lock = threading.Lock()
+        # Sanitizer hookup: lock-order-recorded when PETASTORM_TPU_SANITIZE
+        # is armed (name matches pstlint's static graph node).
+        from petastorm_tpu.analysis import sanitize
+        self._lock = sanitize.tracked_lock(
+            'petastorm_tpu.lineage:LineageTracker._lock')
         self._pending = deque()
         self._ring = deque(maxlen=ring_size)
         self._next_batch_id = 0

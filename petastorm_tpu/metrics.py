@@ -264,7 +264,12 @@ class MetricsRegistry(object):
     """Thread-safe name -> instrument map with one-snapshot collection."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # Sanitizer hookup: armed (PETASTORM_TPU_SANITIZE) this becomes a
+        # lock-order-recorded mutex named to match pstlint's static graph
+        # node; unarmed it is a plain threading.Lock.
+        from petastorm_tpu.analysis import sanitize
+        self._lock = sanitize.tracked_lock(
+            'petastorm_tpu.metrics:MetricsRegistry._lock')
         self._instruments = {}
 
     def _get_or_create(self, cls, name, help, labelnames, **kwargs):

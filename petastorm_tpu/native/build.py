@@ -65,7 +65,7 @@ def build_and_load(name, sources, compile_flags=None, link_flags=None):
             # Cross-process lock: N spawned workers hitting a cold cache
             # should compile once, not N times.
             import fcntl
-            with open(out_path + '.lock', 'w') as lock_file:
+            with open(out_path + '.lock', 'w') as lock_file:  # pstlint: disable=lock-order-blocking(one-time lazy build path: serializing every in-process caller behind the flock'd compile IS the contract — N threads hitting a cold cache must produce one .so, then the _LOADED memo makes this branch unreachable)
                 fcntl.flock(lock_file, fcntl.LOCK_EX)
                 if not os.path.exists(out_path):
                     _compile(srcs, out_path, compile_flags, link_flags)

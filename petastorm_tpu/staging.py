@@ -124,7 +124,17 @@ def willneed_arrays(arrays, _mmap=None):
 
 
 class HostArena(object):
-    """One batch's worth of recyclable per-field host buffers."""
+    """One batch's worth of recyclable per-field host buffers.
+
+    ``view_epoch`` is the arena's recycle generation: bumped every time
+    the buffers return to the pool's free list, i.e. every time their
+    bytes stop belonging to the batch a consumer may still be looking at.
+    With the sanitizer armed (``PETASTORM_TPU_SANITIZE``,
+    :mod:`petastorm_tpu.analysis.sanitize`) reclaim additionally poisons
+    the buffers (0xCB fill) and views handed out via :meth:`borrow` carry
+    the epoch as a borrow tag — touching one after reclaim raises
+    ``StaleViewError`` at the stale access instead of silently reading a
+    different batch's bytes."""
 
     def __init__(self, pool, spec):
         # spec: {name: (shape, dtype)}; shape includes the batch dim.
@@ -135,6 +145,31 @@ class HostArena(object):
         self._holds = 0
         self._retired = False
         self._reclaimed = False
+        self.view_epoch = 0
+
+    def borrow(self, array):
+        """Borrow-tag ``array`` (one of this arena's buffers or a view of
+        one) against the current epoch. No-op passthrough unless the
+        sanitizer is armed."""
+        from petastorm_tpu.analysis import sanitize
+        return sanitize.guard_view(array, self)
+
+    def borrowed_buffers(self):
+        """The buffer dict as handed to the batch assembler: borrow-tagged
+        views when the sanitizer is armed, the raw buffers otherwise."""
+        from petastorm_tpu.analysis import sanitize
+        if not sanitize.sanitize_active():
+            return self.buffers
+        return {name: sanitize.guard_view(buf, self)
+                for name, buf in self.buffers.items()}
+
+    def _on_reclaim(self):
+        """The buffers are about to rejoin the free list: any view still
+        out there is now stale. Bump the borrow epoch (always — one int)
+        and poison the bytes (sanitizer only)."""
+        self.view_epoch += 1
+        from petastorm_tpu.analysis import sanitize
+        sanitize.poison(self.buffers.values())
 
     @property
     def nbytes(self):
@@ -282,7 +317,7 @@ class ArenaPool(object):
                 self._m_wait.observe(waited)
             self._pending = arena
             self._tracer.counter('arena_pool_free', len(self._free), 'staging')
-            return arena.buffers
+            return arena.borrowed_buffers()
 
     def claim_pending(self):
         """The arena handed out by the latest ``get_buffers`` call (or
@@ -293,6 +328,7 @@ class ArenaPool(object):
             return arena
 
     def _reclaim(self, arena):
+        arena._on_reclaim()
         with self._cond:
             if len(self._free) < self._depth:
                 self._free.append(arena)
@@ -674,7 +710,21 @@ class StagingEngine(object):
             self._ready_fn(staged)
             with self._stats_lock:
                 self._ready_wait_s += time.perf_counter() - t0
+        # Seeded use-after-reclaim (fault site 'arena-stale-view'): keep a
+        # borrow-tagged view across the retire and touch it after. Armed
+        # (PETASTORM_TPU_SANITIZE) the touch raises StaleViewError at the
+        # stale access; unarmed it silently reads recycled bytes — the
+        # exact bug class the sanitizer exists to catch. (In holds mode a
+        # reclaim defers to consumer GC, so the seeded proof drives the
+        # engine with holds_mode=False; see tests/test_pstlint.py.)
+        stale_probe = None
+        from petastorm_tpu import faults
+        if faults.faults_active() \
+                and faults.get_injector().should_fire('arena-stale-view'):
+            stale_probe = arena.borrow(next(iter(arena.buffers.values())))
         arena.retire()
+        if stale_probe is not None:
+            stale_probe.sum()   # raises StaleViewError when sanitizer armed
         with self._stats_lock:
             self._retired += 1
 
@@ -717,6 +767,13 @@ class StagingEngine(object):
                     return
                 if hb is not None:
                     hb.beat('device_put')
+                # Seeded lock-order inversion (fault site
+                # 'lock-order-invert'): near-zero when inactive; armed,
+                # the sanitizer's recorder raises before blocking and the
+                # violation is delivered to the consumer like any
+                # pipeline error.
+                from petastorm_tpu.analysis import sanitize
+                sanitize.maybe_inject_lock_inversion()
                 t_dispatch = time.perf_counter()
                 with self.meter.track('dispatch'):
                     with self._tracer.span('dispatch', 'device'):

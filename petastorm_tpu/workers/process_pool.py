@@ -471,4 +471,5 @@ def _start_orphan_watchdog(parent_pid):
                 os._exit(1)
             time.sleep(1)
 
-    threading.Thread(target=watch, daemon=True).start()
+    threading.Thread(target=watch, daemon=True,
+                     name='pst-orphan-watch').start()

@@ -30,7 +30,8 @@ class _WorkerTerminationRequested(Exception):
 
 class WorkerThread(threading.Thread):
     def __init__(self, pool, worker, profiling_enabled=False):
-        super().__init__(daemon=True)
+        super().__init__(daemon=True,
+                         name='pst-pool-worker-{}'.format(worker.worker_id))
         self._pool = pool
         self._worker = worker
         self._profiling_enabled = profiling_enabled

@@ -167,7 +167,8 @@ class ConcurrentVentilator(Ventilator):
         self._on_epoch_order()
         if self.inline:
             return
-        self._ventilation_thread = threading.Thread(target=self._ventilate, daemon=True)
+        self._ventilation_thread = threading.Thread(target=self._ventilate, daemon=True,
+                                                    name='pst-ventilator')
         self._ventilation_thread.start()
 
     def _det_start(self):

@@ -174,6 +174,11 @@ def pytest_configure(config):
         'determinism: deterministic-mode tests (tests/test_determinism.py) '
         'proving bit-identical streams across restarts/reshards; the '
         'conftest guard fails on leaked pst-det* threads after them.')
+    config.addinivalue_line(
+        'markers',
+        'pstlint: static-analyzer + runtime-sanitizer tests '
+        '(tests/test_pstlint.py); includes the tier-1 CI gate running the '
+        'full analyzer over petastorm_tpu/ and failing on findings.')
 
 
 # ---------------------------------------------------------------------------
@@ -230,93 +235,57 @@ def _per_test_timeout(request):
 
 
 # ---------------------------------------------------------------------------
-# Autotuner leak guard (extends PR 3's leaked-thread accounting): the control
-# thread must never outlive its reader/loader — a leaked tuner keeps resizing
-# a pool whose owner is gone. Runs on EVERY test (the tuner can be armed by
-# any factory knob or the PETASTORM_TPU_AUTOTUNE env), so a leak fails the
-# offending test in tier-1 rather than poisoning whichever test runs next.
+# Consolidated leak sweep, driven by the canonical registry
+# (petastorm_tpu/analysis/registry.py). One fixture replaces the per-feature
+# guards that accreted over PRs 4-8 (autotuner, metrics exporter, lineage
+# writer, determinism threads, chunk-store/trace/flight temp dirs):
+#
+# * ThreadGuard entries with action='fail' FAIL the test when a matching
+#   pst-* thread survives it (scoped by marker; marker=None runs on every
+#   test). A shared 2s grace lets stop()/close() joins land first.
+# * DirGuard entries snapshot-diff the shared tempdir and delete only what
+#   appeared during the test — the tempdir is host-shared, and deleting a
+#   store/ledger another process holds open would corrupt IT mid-run.
+#
+# The same registry backs pstlint's thread-lifecycle checker, so a new
+# background thread cannot ship without declaring its join path here;
+# tests/test_pstlint.py pins the registry's dir prefixes against the owning
+# modules' constants. Thread waits run BEFORE dir sweeps (a live writer may
+# still hold files inside a dir about to be swept).
 # ---------------------------------------------------------------------------
 
 @pytest.fixture(autouse=True)
-def _autotune_thread_guard():
-    import threading
-    import time as _time
-
-    yield
-    deadline = _time.monotonic() + 2.0
-    leaked = []
-    while _time.monotonic() < deadline:
-        leaked = [t.name for t in threading.enumerate()
-                  if t.is_alive() and t.name.startswith('pst-autotune')]
-        if not leaked:
-            return
-        _time.sleep(0.05)   # stop() joins with a timeout: allow it to land
-    pytest.fail('autotuner thread(s) leaked past reader/loader close: '
-                '{}'.format(leaked))
-
-
-# ---------------------------------------------------------------------------
-# Chunk-store temp-dir guard: stores created without an explicit location
-# (env-armed readers, bench sweeps) land under tempfile.gettempdir() with the
-# pst-chunk-store- prefix; a test that dies mid-write must not leave GBs of
-# decoded chunks on the CI host's NVMe. Scoped to `chunkstore`-marked tests —
-# only they create prefix-named stores, and a global sweep could race another
-# test's live store.
-# ---------------------------------------------------------------------------
-
-# ---------------------------------------------------------------------------
-# Metrics-exporter leak guard (mirrors the autotuner guard): the opt-in HTTP
-# scrape endpoint (petastorm_tpu.metrics.MetricsExporter) runs on a daemon
-# thread named pst-metrics-exporter; a test that starts one must stop() it,
-# or the leaked listener would hold a port (and a registry reference) for
-# the rest of the session. Runs on every test — cheap when nothing leaked.
-# ---------------------------------------------------------------------------
-
-@pytest.fixture(autouse=True)
-def _metrics_exporter_thread_guard():
-    import threading
-    import time as _time
-
-    yield
-    deadline = _time.monotonic() + 2.0
-    leaked = []
-    while _time.monotonic() < deadline:
-        leaked = [t.name for t in threading.enumerate()
-                  if t.is_alive() and t.name.startswith('pst-metrics-exporter')]
-        if not leaked:
-            return
-        _time.sleep(0.05)   # stop() joins with a timeout: allow it to land
-    pytest.fail('metrics exporter thread(s) leaked past stop(): '
-                '{}'.format(leaked))
-
-
-# ---------------------------------------------------------------------------
-# Observability temp-dir guard: trace sidecar dirs and flight-recorder dumps
-# created during an observability-marked test must not accumulate on the CI
-# host. Snapshot-diff (same rationale as the chunk-store guard): only dirs
-# that appeared during this test are this test's leaks.
-# ---------------------------------------------------------------------------
-
-@pytest.fixture(autouse=True)
-def _observability_dir_guard(request):
-    if request.node.get_closest_marker('observability') is None:
-        yield
-        return
+def _registry_leak_sweep(request):
     import glob
     import shutil
     import tempfile
+    import threading
+    import time as _time
 
-    from petastorm_tpu.flight_recorder import DUMP_DIR_PREFIX
-    # What an env-armed run can actually leak into the shared tempdir:
-    # flight-recorder dump dirs (pst-flight-*), trace dirs following the
-    # documented /tmp/pst-trace convention, and bare sidecar files from a
-    # PETASTORM_TPU_TRACE_DIR pointed at the tempdir itself.
+    from petastorm_tpu.analysis.registry import DIR_GUARDS, THREAD_GUARDS
+
+    def applies(guard):
+        return guard.marker is None or \
+            request.node.get_closest_marker(guard.marker) is not None
+
+    thread_guards = [g for g in THREAD_GUARDS
+                     if g.action == 'fail' and applies(g)]
     tmp = tempfile.gettempdir()
-    patterns = [os.path.join(tmp, 'pst-trace*'),
-                os.path.join(tmp, 'trace-*.jsonl'),
-                os.path.join(tmp, DUMP_DIR_PREFIX + '*')]
+    patterns = [os.path.join(tmp, pat)
+                for g in DIR_GUARDS if applies(g) for pat in g.patterns]
     before = {p for pat in patterns for p in glob.glob(pat)}
     yield
+    leaked_threads = []
+    if thread_guards:
+        deadline = _time.monotonic() + 2.0
+        while _time.monotonic() < deadline:
+            leaked_threads = sorted(
+                t.name for t in threading.enumerate()
+                if t.is_alive()
+                and any(t.name.startswith(g.prefix) for g in thread_guards))
+            if not leaked_threads:
+                break
+            _time.sleep(0.05)   # stop() joins with a timeout: let it land
     for pat in patterns:
         for leaked in set(glob.glob(pat)) - before:
             if os.path.isdir(leaked):
@@ -326,95 +295,16 @@ def _observability_dir_guard(request):
                     os.unlink(leaked)
                 except OSError:
                     pass
-
-
-# ---------------------------------------------------------------------------
-# Lineage ledger guard (mirrors the trace/flight guards): ledgers created
-# without an explicit directory (lineage=True with no env var, bench
-# children) land under tempfile.gettempdir() with the pst-lineage- prefix;
-# a dying test must not leave them accumulating on the CI host. Also fails
-# the test when the ledger write-behind thread (pst-lineage-writer) leaks
-# past the loader's close — a leaked writer holds the ledger file open.
-# ---------------------------------------------------------------------------
-
-@pytest.fixture(autouse=True)
-def _lineage_dir_guard(request):
-    if request.node.get_closest_marker('lineage') is None:
-        yield
-        return
-    import glob
-    import shutil
-    import tempfile
-    import threading
-    import time as _time
-
-    from petastorm_tpu.lineage import TEMP_DIR_PREFIX
-    pattern = os.path.join(tempfile.gettempdir(), TEMP_DIR_PREFIX + '*')
-    before = set(glob.glob(pattern))
-    yield
-    deadline = _time.monotonic() + 2.0
-    leaked_threads = []
-    while _time.monotonic() < deadline:
-        leaked_threads = [t.name for t in threading.enumerate()
-                          if t.is_alive()
-                          and t.name.startswith('pst-lineage-writer')]
-        if not leaked_threads:
-            break
-        _time.sleep(0.05)   # close() joins with a timeout: allow it to land
-    for leaked in set(glob.glob(pattern)) - before:
-        shutil.rmtree(leaked, ignore_errors=True)
     if leaked_threads:
-        pytest.fail('lineage ledger writer thread(s) leaked past close(): '
-                    '{}'.format(leaked_threads))
-
-
-# ---------------------------------------------------------------------------
-# Determinism leak guard: the resequencer is deliberately thread-free (it is
-# driven by the consumer), so deterministic-mode tests must leave NO pst-det*
-# thread behind — the guard exists to catch a future threaded implementation
-# (or helper) outliving its reader, mirroring the autotuner/exporter guards.
-# ---------------------------------------------------------------------------
-
-@pytest.fixture(autouse=True)
-def _determinism_thread_guard(request):
-    if request.node.get_closest_marker('determinism') is None:
-        yield
-        return
-    import threading
-    import time as _time
-
-    yield
-    deadline = _time.monotonic() + 2.0
-    leaked = []
-    while _time.monotonic() < deadline:
-        leaked = [t.name for t in threading.enumerate()
-                  if t.is_alive() and t.name.startswith('pst-det')]
-        if not leaked:
-            return
-        _time.sleep(0.05)
-    pytest.fail('deterministic-mode thread(s) leaked past reader close: '
-                '{}'.format(leaked))
-
-
-@pytest.fixture(autouse=True)
-def _chunk_store_dir_guard(request):
-    if request.node.get_closest_marker('chunkstore') is None:
-        yield
-        return
-    import glob
-    import shutil
-    import tempfile
-
-    from petastorm_tpu.chunk_store import TEMP_DIR_PREFIX
-    pattern = os.path.join(tempfile.gettempdir(), TEMP_DIR_PREFIX + '*')
-    # Snapshot-diff, not a blanket sweep: the tempdir is host-shared, and
-    # deleting a store another process (xdist shard, live bench sweep)
-    # holds open would corrupt IT mid-run. Only dirs that appeared during
-    # this test are this test's leaks.
-    before = set(glob.glob(pattern))
-    yield
-    for leaked in set(glob.glob(pattern)) - before:
-        shutil.rmtree(leaked, ignore_errors=True)
+        owners = {g.prefix: g.owner for g in thread_guards}
+        pytest.fail(
+            'registered pst-* thread(s) leaked past the test: {} — see the '
+            'owning module(s) {} and the join-path rationale in '
+            'petastorm_tpu/analysis/registry.py'.format(
+                leaked_threads,
+                sorted({owner for prefix, owner in owners.items()
+                        if any(name.startswith(prefix)
+                               for name in leaked_threads)})))
 
 
 TimeseriesSchema = Unischema('TimeseriesSchema', [

@@ -1,0 +1,929 @@
+"""pstlint tests (ISSUE 10 tentpole): the static-analysis suite, the CLI,
+the leak-guard registry, and the runtime sanitizer — including the two
+seeded-bug proofs (use-after-reclaim arena view, lock-order inversion)
+and the tier-1 CI gate that runs the full analyzer over ``petastorm_tpu/``
+and fails on any finding.
+"""
+
+import json
+import os
+import queue
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import faults
+from petastorm_tpu.analysis import (core, determinism_taint, lock_order,
+                                    registry, run_checks, threads)
+from petastorm_tpu.analysis.sanitize import (LockOrderRecorder,
+                                             LockOrderViolation,
+                                             StaleViewError, guard_view,
+                                             sanitize_active, tracked_lock)
+from petastorm_tpu.staging import ArenaPool, StagingEngine
+
+pytestmark = pytest.mark.pstlint
+
+_END = object()
+
+PACKAGE_ROOT = os.path.dirname(
+    os.path.abspath(__import__('petastorm_tpu').__file__))
+REPO_ROOT = os.path.dirname(PACKAGE_ROOT)
+
+
+def _write_pkg(tmp_path, files):
+    """Materialize a mini package under tmp_path/pkg; returns its root."""
+    root = tmp_path / 'pkg'
+    root.mkdir(exist_ok=True)
+    (root / '__init__.py').write_text('')
+    for name, body in files.items():
+        (root / name).write_text(textwrap.dedent(body))
+    return str(root)
+
+
+def _project(tmp_path, files):
+    return core.load_project(_write_pkg(tmp_path, files))
+
+
+def _checks(findings, check):
+    return [f for f in findings if f.check == check]
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def test_suppression_silences_with_reason(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        import threading
+
+        class A(object):
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    open('/tmp/x')  # pstlint: disable=lock-order-blocking(one-time init; contended path never reaches this)
+    '''})
+    findings, _ = lock_order.check(project)
+    findings = core.apply_suppressions(
+        project, findings, {'lock-order-blocking', 'suppression'})
+    assert findings == []
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        import threading
+
+        class A(object):
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    open('/tmp/x')  # pstlint: disable=lock-order-blocking
+    '''})
+    findings, _ = lock_order.check(project)
+    findings = core.apply_suppressions(
+        project, findings, {'lock-order-blocking', 'suppression'})
+    checks = sorted(f.check for f in findings)
+    # The reason-less suppression silences nothing AND is itself reported.
+    assert checks == ['lock-order-blocking', 'suppression']
+
+
+def test_unused_suppression_is_a_finding(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        def clean():
+            return 1  # pstlint: disable=lock-order-blocking(stale claim)
+    '''})
+    findings, _ = lock_order.check(project)
+    findings = core.apply_suppressions(
+        project, findings, {'lock-order-blocking', 'suppression'})
+    assert [f.check for f in findings] == ['suppression']
+    assert 'unused' in findings[0].message
+
+
+def test_docstring_mention_is_not_a_suppression(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        def documented():
+            """Silence with # pstlint: disable=lock-order-blocking(reason)."""
+            return 1
+    '''})
+    findings, _ = lock_order.check(project)
+    findings = core.apply_suppressions(
+        project, findings, {'lock-order-blocking', 'suppression'})
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order checker
+# ---------------------------------------------------------------------------
+
+def test_lock_cycle_detected(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        import threading
+
+        class C(object):
+            def __init__(self):
+                self._alpha_lock = threading.Lock()
+                self._beta_lock = threading.Lock()
+
+            def forward(self):
+                with self._alpha_lock:
+                    with self._beta_lock:
+                        pass
+
+            def backward(self):
+                with self._beta_lock:
+                    with self._alpha_lock:
+                        pass
+    '''})
+    findings, edges = lock_order.check(project)
+    cycles = _checks(findings, 'lock-order-cycle')
+    assert len(cycles) == 1
+    assert '_alpha_lock' in cycles[0].message
+    assert '_beta_lock' in cycles[0].message
+    assert ('pkg.m:C._alpha_lock', 'pkg.m:C._beta_lock') in edges
+    assert ('pkg.m:C._beta_lock', 'pkg.m:C._alpha_lock') in edges
+
+
+def test_lock_cycle_across_modules_via_calls(tmp_path):
+    """The deadlock shape reviews catch by hand: module A holds its lock
+    and calls into B (which takes B's lock); module B holds its lock and
+    calls back into A."""
+    project = _project(tmp_path, {
+        'a.py': '''
+            import threading
+            from pkg import b
+
+            class A(object):
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._peer = b.B(self)
+
+                def poke(self):
+                    with self._lock:
+                        self._peer.ping()
+
+                def pinged(self):
+                    with self._lock:
+                        pass
+        ''',
+        'b.py': '''
+            import threading
+
+            class B(object):
+                def __init__(self, owner):
+                    self._lock = threading.Lock()
+
+                def ping(self):
+                    with self._lock:
+                        pass
+
+                def poke_back(self, a_obj):
+                    with self._lock:
+                        call_owner(a_obj)
+
+            def call_owner(a_obj):
+                a_obj.pinged()
+        '''})
+    findings, edges = lock_order.check(project)
+    # Forward edge resolves through the attr-type map...
+    assert ('pkg.a:A._lock', 'pkg.b:B._lock') in edges
+    # ...but the reverse path goes through an unresolvable parameter
+    # (a_obj) — an under-approximation the checker must not invent.
+    cycles = _checks(findings, 'lock-order-cycle')
+    assert cycles == []
+
+
+def test_blocking_calls_under_lock_flagged(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        import queue
+        import threading
+        import time
+
+        class C(object):
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._inbox = queue.Queue()
+                self._cond = threading.Condition()
+
+            def bad_put(self):
+                with self._lock:
+                    self._inbox.put(1)
+
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(0.1)
+
+            def bad_join(self, t):
+                with self._lock:
+                    t.join()
+
+            def ok_nowait(self):
+                with self._lock:
+                    self._inbox.put_nowait(1)
+
+            def ok_cond_wait(self):
+                with self._cond:
+                    self._cond.wait(timeout=1)
+
+            def bad_wait_under_outer(self):
+                with self._lock:
+                    with self._cond:
+                        self._cond.wait()
+    '''})
+    findings, _ = lock_order.check(project)
+    blocking = _checks(findings, 'lock-order-blocking')
+    kinds = sorted(f.message.split(' while')[0] for f in blocking)
+    assert any('queue.put' in k for k in kinds)
+    assert any('time.sleep' in k for k in kinds)
+    assert any('join()' in k for k in kinds)
+    # cond.wait under an OUTER lock is flagged; alone it is exempt.
+    assert any('outer lock' in f.message for f in blocking)
+    lines = {f.line for f in blocking}
+    ok_lines = [i for i, text in enumerate(
+        (tmp_path / 'pkg' / 'm.py').read_text().splitlines(), 1)
+        if 'ok_nowait' in text or 'ok_cond_wait' in text]
+    assert not any(line in lines for line in
+                   range(min(ok_lines), max(ok_lines) + 3))
+
+
+def test_acquire_release_pairs_tracked(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        import queue
+        import threading
+
+        class C(object):
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def explicit(self):
+                self._lock.acquire()
+                try:
+                    self._q.put(1)
+                finally:
+                    self._lock.release()
+
+            def after_release(self):
+                self._lock.acquire()
+                self._lock.release()
+                self._q.put(1)
+    '''})
+    findings, _ = lock_order.check(project)
+    blocking = _checks(findings, 'lock-order-blocking')
+    assert len(blocking) == 1   # only the put inside acquire/release
+
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle checker
+# ---------------------------------------------------------------------------
+
+def test_unnamed_thread_flagged(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        import threading
+
+        def spawn():
+            threading.Thread(target=print, daemon=True).start()
+    '''})
+    assert _checks(threads.check(project), 'thread-name')
+
+
+def test_non_pst_name_flagged(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        import threading
+
+        def spawn():
+            threading.Thread(target=print, daemon=True,
+                             name='my-worker').start()
+    '''})
+    findings = _checks(threads.check(project), 'thread-name')
+    assert findings and 'pst-' in findings[0].message
+
+
+def test_unregistered_prefix_flagged(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        import threading
+
+        def spawn():
+            threading.Thread(target=print, daemon=True,
+                             name='pst-never-registered').start()
+    '''})
+    findings = _checks(threads.check(project), 'thread-registry')
+    assert findings and 'registry' in findings[0].message
+
+
+def test_registered_prefix_and_param_default_resolve(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        import threading
+
+        class T(object):
+            def __init__(self, name='pst-autotune-x'):
+                self._t = threading.Thread(target=print, daemon=True,
+                                           name=name)
+    '''})
+    assert threads.check(project) == []
+
+
+def test_non_daemon_unjoined_flagged_and_joined_ok(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        import threading
+
+        class Bad(object):
+            def spawn(self):
+                self._t = threading.Thread(target=print,
+                                           name='pst-autotune-b')
+                self._t.start()
+
+        class Good(object):
+            def spawn(self):
+                self._t = threading.Thread(target=print,
+                                           name='pst-autotune-g')
+                self._t.start()
+
+            def stop(self):
+                self._t.join()
+    '''})
+    findings = _checks(threads.check(project), 'thread-lifecycle')
+    assert len(findings) == 1
+
+
+def test_thread_subclass_super_init_checked(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        import threading
+
+        class W(threading.Thread):
+            def __init__(self):
+                super().__init__(daemon=True)
+    '''})
+    findings = _checks(threads.check(project), 'thread-name')
+    assert findings and 'subclass' in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# determinism-taint checker
+# ---------------------------------------------------------------------------
+
+def test_direct_taint_in_marked_function(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        import time
+        from petastorm_tpu.determinism import deterministic_safe
+
+        @deterministic_safe
+        def order(n):
+            return [time.time() for _ in range(n)]
+    '''})
+    findings = determinism_taint.check(project)
+    assert findings and 'time.time' in findings[0].message
+
+
+def test_transitive_taint_reported_with_chain(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        import random
+        from petastorm_tpu.determinism import deterministic_safe
+
+        def helper():
+            return inner()
+
+        def inner():
+            return random.random()
+
+        @deterministic_safe
+        def order(n):
+            return helper()
+    '''})
+    findings = determinism_taint.check(project)
+    assert findings
+    assert 'call chain' in findings[0].message
+    assert 'random.random' in findings[0].message
+
+
+def test_set_iteration_flagged_sorted_ok(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        from petastorm_tpu.determinism import deterministic_safe
+
+        @deterministic_safe
+        def bad(items):
+            return [x for x in set(items)]
+
+        @deterministic_safe
+        def good(items):
+            return [x for x in sorted(set(items))]
+    '''})
+    findings = determinism_taint.check(project)
+    assert len(findings) == 1
+    assert 'PYTHONHASHSEED' in findings[0].message
+
+
+def test_pure_marked_function_clean(tmp_path):
+    project = _project(tmp_path, {'m.py': '''
+        import hashlib
+        from petastorm_tpu.determinism import deterministic_safe
+
+        @deterministic_safe
+        def key(seed, epoch):
+            digest = hashlib.md5('{}:{}'.format(seed, epoch).encode())
+            return digest.hexdigest()
+    '''})
+    assert determinism_taint.check(project) == []
+
+
+def test_real_feistel_path_is_marked():
+    from petastorm_tpu import determinism
+    for fn in (determinism.epoch_key, determinism.feistel_permute,
+               determinism.epoch_order, determinism.shard_positions,
+               determinism.order_digest):
+        assert getattr(fn, '__deterministic_safe__', False), fn.__name__
+
+
+# ---------------------------------------------------------------------------
+# registry-sync checker
+# ---------------------------------------------------------------------------
+
+def _mini_repo(tmp_path, env_reads=('PETASTORM_TPU_DEMO',),
+               env_docs=('PETASTORM_TPU_DEMO',), marker_used='slow',
+               marker_registered='slow'):
+    repo = tmp_path / 'repo'
+    pkg = repo / 'pkg'
+    pkg.mkdir(parents=True)
+    (pkg / '__init__.py').write_text('')
+    body = 'import os\n' + ''.join(
+        "V_{i} = os.environ.get('{v}')\n".format(i=i, v=v)
+        for i, v in enumerate(env_reads))
+    (pkg / 'mod.py').write_text(body)
+    docs = repo / 'docs'
+    docs.mkdir()
+    rows = ''.join('``{}``  x\n'.format(v) for v in env_docs)
+    (docs / 'tpu_guide.rst').write_text(
+        'Guide\n=====\n\n.. begin-env-table\n\n' + rows +
+        '\n.. end-env-table\n')
+    (docs / 'failure_model.rst').write_text('Faults\n======\n')
+    tests = repo / 'tests'
+    tests.mkdir()
+    (tests / 'test_x.py').write_text(
+        'import pytest\n\n@pytest.mark.{}\ndef test_a():\n    pass\n'.format(
+            marker_used))
+    (repo / 'pytest.ini').write_text(
+        '[pytest]\nmarkers =\n    {}: something\n'.format(marker_registered))
+    return str(pkg)
+
+
+def test_registry_env_docstring_mention_is_not_a_read_site(tmp_path):
+    """A docstring mentioning a variable must not count as a reading
+    site — otherwise a dead docs-table row survives the two-way check."""
+    from petastorm_tpu.analysis import registry_sync
+    pkg = _mini_repo(tmp_path, env_reads=('PETASTORM_TPU_DEMO',),
+                     env_docs=('PETASTORM_TPU_DEMO',
+                               'PETASTORM_TPU_GHOST'))
+    with open(os.path.join(pkg, 'ghost.py'), 'w') as f:
+        f.write('"""Mentions PETASTORM_TPU_GHOST but never reads it."""\n\n'
+                'def noop():\n'
+                '    """Also mentions PETASTORM_TPU_GHOST."""\n')
+    project = core.load_project(pkg)
+    findings = _checks(registry_sync.check(project), 'registry-env')
+    assert any('PETASTORM_TPU_GHOST' in f.message
+               and 'no reading source site' in f.message for f in findings)
+
+
+def test_registry_env_two_way(tmp_path):
+    from petastorm_tpu.analysis import registry_sync
+    # In sync: clean.
+    project = core.load_project(_mini_repo(tmp_path))
+    assert _checks(registry_sync.check(project), 'registry-env') == []
+    # Source reads a var the docs omit.
+    project = core.load_project(_mini_repo(
+        tmp_path / 'a', env_reads=('PETASTORM_TPU_DEMO',
+                                   'PETASTORM_TPU_SECRET')))
+    findings = _checks(registry_sync.check(project), 'registry-env')
+    assert findings and 'PETASTORM_TPU_SECRET' in findings[0].message
+    # Docs claim a var nothing reads.
+    project = core.load_project(_mini_repo(
+        tmp_path / 'b', env_docs=('PETASTORM_TPU_DEMO',
+                                  'PETASTORM_TPU_GONE')))
+    findings = _checks(registry_sync.check(project), 'registry-env')
+    assert findings and 'PETASTORM_TPU_GONE' in findings[0].message
+
+
+def test_registry_marker_two_way(tmp_path):
+    from petastorm_tpu.analysis import registry_sync
+    project = core.load_project(_mini_repo(tmp_path, marker_used='mystery'))
+    findings = _checks(registry_sync.check(project), 'registry-marker')
+    assert findings and 'mystery' in findings[0].message
+    project = core.load_project(_mini_repo(
+        tmp_path / 'c', marker_registered='dead'))
+    findings = _checks(registry_sync.check(project), 'registry-marker')
+    assert any('dead' in f.message for f in findings)
+
+
+def test_undeclared_fault_site_flagged(tmp_path):
+    from petastorm_tpu.analysis import registry_sync
+    pkg = _mini_repo(tmp_path)
+    with open(os.path.join(pkg, 'faults.py'), 'w') as f:
+        f.write("KNOWN_SITES = ('real-site',)\n"
+                "def maybe_inject(site, key=None):\n    pass\n")
+    with open(os.path.join(pkg, 'user.py'), 'w') as f:
+        f.write("from pkg.faults import maybe_inject\n"
+                "def go():\n    maybe_inject('typo-site')\n")
+    project = core.load_project(pkg)
+    findings = _checks(registry_sync.check(project), 'registry-fault')
+    assert any('typo-site' in f.message for f in findings)
+
+
+def test_unknown_fault_site_rejected_at_parse():
+    with pytest.raises(ValueError, match='unknown fault site'):
+        faults.FaultSpec.parse('definitely-not-a-site:p=0.5')
+    # Known sites still parse.
+    spec = faults.FaultSpec.parse('arena-stale-view:max=1')
+    assert spec.site == 'arena-stale-view'
+    assert spec.max_fires == 1
+
+
+# ---------------------------------------------------------------------------
+# the leak-guard registry itself
+# ---------------------------------------------------------------------------
+
+def test_registry_dir_prefixes_match_module_constants():
+    """The registry stores literals (it must stay import-light); pin them
+    against the owning modules' constants so they cannot drift."""
+    from petastorm_tpu.chunk_store import TEMP_DIR_PREFIX as chunk_prefix
+    from petastorm_tpu.flight_recorder import DUMP_DIR_PREFIX as dump_prefix
+    from petastorm_tpu.lineage import TEMP_DIR_PREFIX as lineage_prefix
+    patterns = {p for g in registry.DIR_GUARDS for p in g.patterns}
+    assert chunk_prefix + '*' in patterns
+    assert lineage_prefix + '*' in patterns
+    assert dump_prefix + '*' in patterns
+
+
+def test_registry_thread_prefixes_cover_live_thread_names():
+    """Every thread name the package actually constructs resolves to a
+    registered prefix (the static checker enforces this on source; this
+    pins a few live names against it)."""
+    prefixes = registry.thread_prefixes()
+    for name in ('pst-autotune', 'pst-metrics-exporter',
+                 'pst-lineage-writer', 'pst-chunk-store-writer',
+                 'pst-ventilator', 'pst-staging-assemble',
+                 'pst-data-service-serve', 'pst-pool-worker-3',
+                 'pst-orphan-watch'):
+        assert any(name.startswith(p) for p in prefixes), name
+    for guard in registry.THREAD_GUARDS:
+        assert guard.prefix.startswith('pst-')
+        assert guard.action in ('fail', 'note')
+        assert guard.rationale
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args, **kwargs):
+    return subprocess.run(
+        [sys.executable, '-m', 'petastorm_tpu.tools.pstlint'] + list(args),
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=300,
+        **kwargs)
+
+
+def test_cli_clean_tree_exits_zero():
+    result = _run_cli('petastorm_tpu/')
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert 'clean' in result.stdout
+
+
+def test_cli_findings_exit_nonzero_and_render(tmp_path):
+    pkg = _write_pkg(tmp_path, {'m.py': '''
+        import threading
+
+        def spawn():
+            threading.Thread(target=print, daemon=True).start()
+    '''})
+    result = _run_cli(pkg, '--check', 'threads')
+    assert result.returncode == 1
+    assert '[thread-name]' in result.stdout
+    result_json = _run_cli(pkg, '--check', 'threads', '--format', 'json')
+    assert result_json.returncode == 1
+    payload = json.loads(result_json.stdout)
+    assert payload and payload[0]['check'] == 'thread-name'
+
+
+def test_cli_list_checks_and_bad_path():
+    assert 'lock-order' in _run_cli('--list-checks').stdout
+    assert _run_cli('/nonexistent/path').returncode == 2
+    assert _run_cli('petastorm_tpu/', '--check', 'bogus').returncode == 2
+
+
+def test_cli_emit_lock_graph(tmp_path):
+    out = str(tmp_path / 'graph.json')
+    result = _run_cli('petastorm_tpu/', '--check', 'lock-order',
+                      '--emit-lock-graph', out)
+    assert result.returncode == 0, result.stdout + result.stderr
+    edges = json.load(open(out))
+    assert all(len(edge) == 2 for edge in edges)
+
+
+def test_cli_emit_lock_graph_implies_lock_order_check(tmp_path):
+    """A --check subset must not silently write an empty edge file (it
+    would seed the runtime recorder with an empty contract)."""
+    pkg = _write_pkg(tmp_path, {'m.py': '''
+        import threading
+
+        class C(object):
+            def __init__(self):
+                self._outer_lock = threading.Lock()
+                self._inner_lock = threading.Lock()
+
+            def nested(self):
+                with self._outer_lock:
+                    with self._inner_lock:
+                        pass
+    '''})
+    out = str(tmp_path / 'graph.json')
+    result = _run_cli(pkg, '--check', 'threads', '--emit-lock-graph', out)
+    assert result.returncode == 0, result.stdout + result.stderr
+    edges = json.load(open(out))
+    assert ['pkg.m:C._outer_lock', 'pkg.m:C._inner_lock'] in edges
+
+
+# ---------------------------------------------------------------------------
+# THE tier-1 gate: the shipped tree is clean
+# ---------------------------------------------------------------------------
+
+def test_package_tree_is_clean():
+    """The CI gate: the full analyzer over ``petastorm_tpu/`` reports
+    nothing — every violation is fixed or carries a reasoned suppression,
+    and no suppression is unexplained or stale. A finding here names the
+    exact file:line to fix; see docs/troubleshoot.rst "Reading a pstlint
+    finding"."""
+    findings, _ = run_checks([PACKAGE_ROOT])
+    rendered = '\n'.join(f.render(relative_to=REPO_ROOT) for f in findings)
+    assert not findings, 'pstlint findings on the shipped tree:\n' + rendered
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer: guarded views + poison
+# ---------------------------------------------------------------------------
+
+def test_guard_view_unarmed_is_passthrough(monkeypatch):
+    monkeypatch.delenv('PETASTORM_TPU_SANITIZE', raising=False)
+    buf = np.zeros(4)
+
+    class Src(object):
+        view_epoch = 0
+
+    assert guard_view(buf, Src()) is buf
+    assert not sanitize_active()
+
+
+def test_guarded_view_raises_at_touch_after_reclaim(monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_SANITIZE', '1')
+
+    class Src(object):
+        view_epoch = 0
+
+    src = Src()
+    buf = np.arange(12, dtype=np.float32).reshape(4, 3)
+    view = guard_view(buf, src)
+    # Live: all touch paths work, including the collate fill idioms.
+    np.copyto(view[:2], np.ones((2, 3), np.float32))
+    view[2] = 5
+    assert view.sum() > 0
+    src.view_epoch += 1
+    for touch in (lambda: view.sum(), lambda: view[0],
+                  lambda: view + 1, lambda: np.copyto(view, 0.0)):
+        with pytest.raises(StaleViewError):
+            touch()
+
+
+def test_arena_reclaim_poisons_and_stales_views(monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_SANITIZE', '1')
+    pool = ArenaPool(depth=1)
+    spec = {'x': ((2, 3), np.dtype(np.float32))}
+    bufs = pool.get_buffers(spec)
+    arena = pool.claim_pending()
+    view = bufs['x']
+    np.copyto(view, np.ones((2, 3), np.float32))
+    raw = arena.buffers['x']
+    arena.retire()
+    with pytest.raises(StaleViewError):
+        view.sum()
+    # Poison is visible in the raw buffer: no stale read can masquerade
+    # as valid batch data.
+    assert (raw.view(np.uint8) == 0xCB).all()
+
+
+def _run_engine(pool, spec, n_batches=4):
+    """Drive a StagingEngine (holds_mode=False: retire reclaims
+    immediately) and return everything delivered before the end
+    sentinel."""
+    def host_iter():
+        for i in range(n_batches):
+            bufs = pool.get_buffers(spec)
+            np.copyto(bufs['x'], np.full((2, 3), i, np.float32))
+            yield {'x': bufs['x']}
+
+    out = queue.Queue()
+    stop = threading.Event()
+    engine = StagingEngine(iter(host_iter()), lambda b: b, out, stop, _END,
+                           pool=pool, inflight=1, holds_mode=False).start()
+    delivered = []
+    try:
+        while True:
+            item = out.get(timeout=30)
+            if item is _END or isinstance(item, Exception):
+                delivered.append(item)
+                break
+            delivered.append(item)
+    finally:
+        engine.stop()
+    return delivered
+
+
+def test_seeded_use_after_reclaim_raises_when_armed(monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_SANITIZE', '1')
+    monkeypatch.setenv('PETASTORM_TPU_FAULTS', 'arena-stale-view:max=1')
+    spec = {'x': ((2, 3), np.dtype(np.float32))}
+    delivered = _run_engine(ArenaPool(depth=2), spec)
+    assert isinstance(delivered[-1], StaleViewError), delivered[-1]
+
+
+def test_seeded_use_after_reclaim_silent_when_unarmed(monkeypatch):
+    """The control arm of the seeded-bug proof: without the sanitizer the
+    injected stale touch reads recycled bytes and the stream completes —
+    exactly the silent-corruption mode the sanitizer turns into a loud
+    error."""
+    monkeypatch.delenv('PETASTORM_TPU_SANITIZE', raising=False)
+    monkeypatch.setenv('PETASTORM_TPU_FAULTS', 'arena-stale-view:max=1')
+    spec = {'x': ((2, 3), np.dtype(np.float32))}
+    delivered = _run_engine(ArenaPool(depth=2), spec)
+    assert delivered[-1] is _END
+    assert len(delivered) == 5   # 4 batches + sentinel
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer: lock-order recorder
+# ---------------------------------------------------------------------------
+
+def test_recorder_flags_inversion_and_matches_static_graph(tmp_path):
+    """End-to-end contract: the static analyzer's edge set seeds the
+    runtime recorder; traffic agreeing with the graph passes, an
+    inversion raises before blocking."""
+    project = _project(tmp_path, {'m.py': '''
+        import threading
+
+        class C(object):
+            def __init__(self):
+                self._outer_lock = threading.Lock()
+                self._inner_lock = threading.Lock()
+
+            def nested(self):
+                with self._outer_lock:
+                    with self._inner_lock:
+                        pass
+    '''})
+    edges = lock_order.static_edges(project)
+    assert ('pkg.m:C._outer_lock', 'pkg.m:C._inner_lock') in edges
+    recorder = LockOrderRecorder(static_edges=edges)
+    # Conforming order: fine, repeatedly.
+    for _ in range(2):
+        recorder.on_acquire('pkg.m:C._outer_lock')
+        recorder.on_acquire('pkg.m:C._inner_lock')
+        recorder.on_release('pkg.m:C._inner_lock')
+        recorder.on_release('pkg.m:C._outer_lock')
+    assert recorder.violations() == []
+    # Inverted order: flagged by the thread that would have deadlocked.
+    recorder.on_acquire('pkg.m:C._inner_lock')
+    with pytest.raises(LockOrderViolation):
+        recorder.on_acquire('pkg.m:C._outer_lock')
+    assert recorder.violations()
+
+
+def test_tracked_lock_records_edges_when_armed(monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_SANITIZE', '1')
+    recorder = LockOrderRecorder()
+    a = tracked_lock('t-lock-a', recorder=recorder)
+    b = tracked_lock('t-lock-b', recorder=recorder)
+    with a:
+        with b:
+            pass
+    assert ('t-lock-a', 't-lock-b') in recorder.edges()
+    b.acquire()
+    with pytest.raises(LockOrderViolation):
+        a.acquire()
+    b.release()
+    assert not b.locked()
+
+
+def test_recorder_transitive_and_deep_stack_inversions():
+    """An inversion must be caught against ANY held lock, through
+    transitively recorded edges — not just the direct (new, top) pair."""
+    recorder = LockOrderRecorder(mode='record')
+    # Record adjacent edges a->b and b->c on one conforming pass.
+    for name in ('a', 'b', 'c'):
+        recorder.on_acquire(name)
+    for name in ('c', 'b', 'a'):
+        recorder.on_release(name)
+    assert recorder.violations() == []
+    # Transitive inversion: acquiring a while holding c (a->b->c known).
+    recorder.on_acquire('c')
+    recorder.on_acquire('a')
+    assert recorder.violations(), 'transitive inversion missed'
+    recorder.on_release('a')
+    recorder.on_release('c')
+    # Non-top-of-stack inversion: d->a recorded, then a thread holding
+    # [a, unrelated] acquires d — 'a' is not the stack top but the
+    # deadlock is real.
+    recorder2 = LockOrderRecorder(mode='record',
+                                  static_edges=[('d', 'a')])
+    recorder2.on_acquire('a')
+    recorder2.on_acquire('unrelated')
+    recorder2.on_acquire('d')
+    assert recorder2.violations(), 'non-top-of-stack inversion missed'
+
+
+def test_tracked_lock_trylock_never_raises(monkeypatch):
+    """blocking=False cannot deadlock (it gives up), so the recorder must
+    not flag it — mirroring the static checker's exemption for
+    `if lock.acquire(blocking=False):` guards."""
+    monkeypatch.setenv('PETASTORM_TPU_SANITIZE', '1')
+    recorder = LockOrderRecorder()
+    a = tracked_lock('try-a', recorder=recorder)
+    b = tracked_lock('try-b', recorder=recorder)
+    with a:
+        with b:
+            pass
+    b.acquire()
+    assert a.acquire(blocking=False)   # inverted order, but a trylock
+    a.release()
+    b.release()
+    assert recorder.violations() == []
+    # The blocking inversion still raises.
+    b.acquire()
+    with pytest.raises(LockOrderViolation):
+        a.acquire()
+    b.release()
+
+
+def test_canary_pair_tracks_armed_state_flips(monkeypatch):
+    """Flipping PETASTORM_TPU_SANITIZE between pipelines in one process
+    must flip the seeded inversion's loud/silent behavior with it."""
+    from petastorm_tpu.analysis import sanitize as sanitize_mod
+    monkeypatch.setattr(sanitize_mod, '_inversion_pair', None)
+    monkeypatch.setattr(sanitize_mod, '_recorder', None)
+    monkeypatch.setenv('PETASTORM_TPU_FAULTS', 'lock-order-invert')
+    # Unarmed first: silent, and the pair is cached unarmed.
+    monkeypatch.delenv('PETASTORM_TPU_SANITIZE', raising=False)
+    sanitize_mod.maybe_inject_lock_inversion()
+    # Now armed: the cached plain-lock pair must be replaced, not reused.
+    monkeypatch.setenv('PETASTORM_TPU_SANITIZE', '1')
+    with pytest.raises(LockOrderViolation):
+        sanitize_mod.maybe_inject_lock_inversion()
+    # And flipping back disarms again.
+    monkeypatch.delenv('PETASTORM_TPU_SANITIZE', raising=False)
+    sanitize_mod.maybe_inject_lock_inversion()
+
+
+def test_tracked_lock_unarmed_is_plain_lock(monkeypatch):
+    monkeypatch.delenv('PETASTORM_TPU_SANITIZE', raising=False)
+    lock = tracked_lock('whatever')
+    assert isinstance(lock, type(threading.Lock()))
+
+
+def test_tracked_lock_disarming_mid_process_silences(monkeypatch):
+    """Arming is construction-time (like TRACE_DIR/LINEAGE_DIR), but
+    DISARMING follows the env per acquire: a TrackedLock built armed must
+    not keep raising after the sanitizer is switched off."""
+    monkeypatch.setenv('PETASTORM_TPU_SANITIZE', '1')
+    recorder = LockOrderRecorder()
+    a = tracked_lock('disarm-a', recorder=recorder)
+    b = tracked_lock('disarm-b', recorder=recorder)
+    with a:
+        with b:
+            pass
+    monkeypatch.delenv('PETASTORM_TPU_SANITIZE', raising=False)
+    with b:       # inverted order, but disarmed: must stay silent
+        with a:
+            pass
+    assert recorder.violations() == []
+
+
+def test_seeded_lock_inversion_raises_when_armed(monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_SANITIZE', '1')
+    monkeypatch.setenv('PETASTORM_TPU_FAULTS', 'lock-order-invert:max=1')
+    from petastorm_tpu.analysis import sanitize as sanitize_mod
+    monkeypatch.setattr(sanitize_mod, '_inversion_pair', None)
+    monkeypatch.setattr(sanitize_mod, '_recorder', None)
+    spec = {'x': ((2, 3), np.dtype(np.float32))}
+    delivered = _run_engine(ArenaPool(depth=2), spec)
+    assert isinstance(delivered[-1], LockOrderViolation), delivered[-1]
+
+
+def test_seeded_lock_inversion_silent_when_unarmed(monkeypatch):
+    monkeypatch.delenv('PETASTORM_TPU_SANITIZE', raising=False)
+    monkeypatch.setenv('PETASTORM_TPU_FAULTS', 'lock-order-invert:max=1')
+    from petastorm_tpu.analysis import sanitize as sanitize_mod
+    monkeypatch.setattr(sanitize_mod, '_inversion_pair', None)
+    spec = {'x': ((2, 3), np.dtype(np.float32))}
+    delivered = _run_engine(ArenaPool(depth=2), spec)
+    assert delivered[-1] is _END
