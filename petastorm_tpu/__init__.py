@@ -13,7 +13,7 @@ __version__ = '0.1.0'
 from petastorm_tpu.autotune import AutotuneConfig  # noqa: F401
 from petastorm_tpu.chunk_store import DecodedChunkStore  # noqa: F401
 from petastorm_tpu.determinism import (DeterministicCursor,  # noqa: F401
-                                       merge_cursors)
+                                       det_tag_cursor, merge_cursors)
 from petastorm_tpu.converter import make_converter  # noqa: F401
 from petastorm_tpu.data_service import (DataServer, RemoteReader,  # noqa: F401
                                         checkpoint_shared_stream,

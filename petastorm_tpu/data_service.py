@@ -100,16 +100,51 @@ logger = logging.getLogger(__name__)
 
 _CTRL_END = b'PST_END'
 _CTRL_ERR = b'PST_ERR'
+#: Lease heartbeat on the control PUB socket: ``PST_HB`` + packed
+#: (server_id, lease_s, state code) + the server's rpc endpoint (utf-8).
+#: A consumer that has seen one heartbeat and then none for ``lease_s``
+#: treats the lease as EXPIRED — the fleet's dead-server signal, replacing
+#: per-tick rpc liveness probes (a dead server cannot renew; a merely slow
+#: one still heartbeats from its control thread).
+_CTRL_HB = b'PST_HB'
+_HB_STRUCT = struct.Struct('<16sdB')    # (server_id, lease_s, state code)
+_STATE_CODES = {'serving': 0, 'draining': 1, 'drained': 2,
+                'awaiting-cursor': 3}
+_STATE_NAMES = {v: k for k, v in _STATE_CODES.items()}
 _SERVER_ID_LEN = 16
 _COUNT_STRUCT = struct.Struct('<Q')
 _META_STRUCT = struct.Struct('<16sQ')   # (server_id, chunk seq)
 _MAC_LEN = 16
+
+#: Server lease duration (seconds): heartbeats go out every third of it,
+#: consumers declare a server dead one full lease after its last
+#: heartbeat. Override per server via ``DataServer(lease_s=)``.
+ENV_LEASE = 'PETASTORM_TPU_LEASE_S'
+DEFAULT_LEASE_S = 10.0
+#: Sole-consumer reconnect window (seconds): after a server's lease
+#: expires, how long the consumer keeps polling for a replacement (a
+#: restarted or cursor-resumed server) before raising. 0 disables
+#: reconnect-with-resume (lease expiry then raises immediately).
+ENV_RECONNECT = 'PETASTORM_TPU_RECONNECT_S'
+DEFAULT_RECONNECT_S = 60.0
+
+
+def _env_float(var, default):
+    raw = os.environ.get(var, '').strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning('ignoring non-numeric %s=%r', var, raw)
+        return default
 #: After a liveness probe finds an endpoint unreachable (whole rpc retry
 #: budget unanswered), further probes report it dead from memory for this
 #: long instead of re-paying the budget — a watchdog sweeping every tick
 #: must stay bounded even on sole-consumer streams where no failover
 #: permanently retires the endpoint.
 _PROBE_DEAD_BACKOFF_S = 30.0
+_MISSING = object()
 
 
 class RpcUnanswered(Exception):
@@ -152,6 +187,17 @@ def _load_frames(frames):
     head = head.buffer if hasattr(head, 'buffer') else head
     bufs = [f.buffer if hasattr(f, 'buffer') else f for f in frames[1:]]
     return pickle.loads(head, buffers=bufs)
+
+
+def _check_batched(reader):
+    if not getattr(reader, 'batched_output', False):
+        # RemoteReader presents the stream as batched chunks; a per-row
+        # reader would ship one tiny pickle per ROW and the trainer-side
+        # JaxLoader would mis-treat scalars as columns.
+        raise ValueError(
+            'DataServer requires a batched reader (make_tensor_reader / '
+            'make_batch_reader); got a per-row reader. Per-row decode '
+            'belongs on the trainer for row-granular pipelines.')
 
 
 class DataServer(object):
@@ -215,18 +261,19 @@ class DataServer(object):
                  sndhwm=4, auth_key=None, snapshot_path=None,
                  snapshot_every=16, snapshot_resume=None,
                  replay_ring_chunks=None, bind_retry_policy=None,
-                 lineage=True):
+                 lineage=True, lease_s=None, max_consumers=None,
+                 reader_builder=None):
         import zmq
 
-        if not getattr(reader, 'batched_output', False):
-            # RemoteReader presents the stream as batched chunks; a per-row
-            # reader would ship one tiny pickle per ROW and the trainer-side
-            # JaxLoader would mis-treat scalars as columns.
-            raise ValueError(
-                'DataServer requires a batched reader (make_tensor_reader / '
-                'make_batch_reader); got a per-row reader. Per-row decode '
-                'belongs on the trainer for row-granular pipelines.')
+        if (reader is None) == (reader_builder is None):
+            raise ValueError('pass exactly one of reader / reader_builder '
+                             '(reader_builder defers the reader build until '
+                             'the first consumer attaches with its resume '
+                             'cursor — see serve_dataset(await_cursor=True))')
+        if reader is not None:
+            _check_batched(reader)
         self._reader = reader
+        self._reader_builder = reader_builder
         # The provenance sidecar adds a reserved '__pst_lineage__' key to
         # every wire payload; consumers older than it crash unpacking the
         # chunk (underscore namedtuple field), so a mixed-version fleet
@@ -342,14 +389,80 @@ class DataServer(object):
             self._ring.extend(self._replay)
         else:
             self._server_id = uuid.uuid4().bytes
+        # -- fleet control plane: lease, drain, admission, flow control --
+        self._lease_s = float(lease_s if lease_s is not None
+                              else _env_float(ENV_LEASE, DEFAULT_LEASE_S))
+        self._max_consumers = (None if max_consumers is None
+                               else int(max_consumers))
+        self._m_rejected = metrics_mod.counter(
+            'pst_consumers_rejected_total',
+            'Consumer attach requests a data-service server refused',
+            labelnames=('reason',))
+        # Admission ledger: consumer_id -> last renew time. Entries expire
+        # after 3 leases without a renew (the client control thread
+        # re-attaches every lease), so a crashed consumer frees its
+        # admission slot without a detach.
+        self._admission_lock = threading.Lock()
+        self._consumers = {}
+        # Aggregate credit pool (credit-based flow control): None until a
+        # consumer attaches with a credit grant; afterwards the serve loop
+        # sends only while credit remains, so total outstanding chunks are
+        # bounded by what consumers granted instead of N * sndhwm. An
+        # attach WITHOUT credits while armed disarms the gate permanently
+        # (a credit-blind consumer would otherwise starve behind it).
+        self._credit = None
+        self._credit_disabled = False
+        # Drain state machine: serving -> draining (stop admitting, finish
+        # the in-flight chunk, emit the final cursor) -> drained.
+        self._draining = threading.Event()
+        self._drained = threading.Event()
+        self._final_cursor = None
+        # End-of-stream marker handed to the control thread, which owns
+        # the PUB socket once start() ran (heartbeats and END broadcasts
+        # must not race the serve thread on one zmq socket).
+        self._end_marker = None
+        self._ctrl_thread = None
+        # Deferred build (reader_builder): set by the first attach rpc.
+        self._cursor_evt = threading.Event()
+        self._resume_cursor = None
+        self._cursor_applied = False
 
     def serve_forever(self):
         """Blocking serve loop: pull batches off the reader, push to
         whichever trainer asks first; broadcast END when the reader ends
         (or an error marker if it failed — trainers re-raise, they must
-        never mistake a half-served dataset for a clean epoch)."""
+        never mistake a half-served dataset for a clean epoch). A
+        ``drain()`` (rpc or SIGTERM via ``serve_cli``) exits the loop at
+        the next chunk boundary: admission already refuses new consumers,
+        the in-flight chunk completes, the final stream cursor is
+        captured, and a clean END (exact served count) goes out — a
+        graceful drain loses zero chunks."""
+        from petastorm_tpu import faults
         err_body = None
         try:
+            if self._reader is None:
+                # Deferred build (reader_builder / await_cursor): the
+                # first consumer attach carries its resume cursor (or
+                # None) — the control-plane handoff that makes a
+                # replacement server continue a dead peer's deterministic
+                # stream bit-identically.
+                while not self._cursor_evt.wait(0.05):
+                    if self._stop.is_set():
+                        return
+                    if self._draining.is_set():
+                        break
+                if not self._cursor_evt.is_set():
+                    raise RuntimeError('server drained before any consumer '
+                                       'attached a resume cursor')
+                self._reader = self._reader_builder(self._resume_cursor)
+                _check_batched(self._reader)
+                self._cursor_applied = self._resume_cursor is not None
+                if self._stop.is_set():
+                    # stop() raced the build and saw reader=None: it could
+                    # not stop the pool itself, so tear it down here.
+                    self._reader.stop()
+                    self._reader.join()
+                    return
             # iter() inside the guard: an __iter__ failure must take the
             # same error-broadcast path as a mid-stream one — an escaped
             # exception here would kill the thread with no END/ERR and a
@@ -367,16 +480,27 @@ class DataServer(object):
                     break
             self._replay = []
             while not self._stop.is_set():
+                if self._draining.is_set():
+                    # Chunk boundary: the in-flight chunk completed (or
+                    # never started); stop reading, declare a clean end.
+                    break
                 if self._pause.is_set():
                     # Chunk boundary: _served_chunks is final and the
                     # reader's state_dict covers exactly the sent chunks.
                     self._paused_gen = self._pause_gen
                     time.sleep(0.005)
                     continue
+                # Fleet drills: die at a chunk boundary (preempted decode
+                # host) / serve slowly (sick-but-alive host).
+                faults.maybe_inject('server-kill')
+                self._wait_for_credit()
+                if self._stop.is_set() or self._draining.is_set():
+                    continue
                 try:
                     sample = next(rows)
                 except StopIteration:
                     break
+                faults.maybe_inject('server-slow')
                 payload = {name: getattr(sample, name)
                            for name in sample._fields}
                 # Batch provenance across the wire (petastorm_tpu.lineage):
@@ -429,22 +553,52 @@ class DataServer(object):
                         self._write_snapshot()
                     except Exception:   # noqa: BLE001 - end still broadcast
                         logger.exception('final server snapshot failed')
+                # The final stream cursor: what a drained server hands the
+                # orchestrator (drain rpc reply / stats) so its stream can
+                # be continued elsewhere exactly where it stopped.
+                state_fn = getattr(self._reader, 'state_dict', None)
+                if state_fn is not None:
+                    try:
+                        self._final_cursor = state_fn()
+                    except Exception:   # noqa: BLE001 - cursor is advisory
+                        logger.exception('final cursor capture failed')
             else:
                 marker = _CTRL_ERR + self._server_id + err_body
             if self._auth_key is not None:
                 marker += _mac(self._auth_key, marker)
-            # Broadcast until stopped: PUB drops messages for slow-JOINING
-            # subscribers, so a client that dials in after the data ended
-            # still learns the stream is over.
             logger.info('data server done: %d chunks served', self._served_chunks)
+            if self._draining.is_set() and err_body is None:
+                self._drained.set()
+            # Hand the marker to the control thread (it owns the PUB
+            # socket once start() ran: heartbeats and END broadcasts must
+            # not race on one zmq socket) and declare the stream done.
+            self._end_marker = marker
             self._serving_done.set()
-            while not self._stop.is_set():
-                self._ctrl_sock.send(marker)
-                # A checkpoint can still be requested after the stream
-                # ended (e.g. end-of-epoch state); keep honoring pause.
-                if self._pause.is_set():
-                    self._paused_gen = self._pause_gen
-                time.sleep(0.05)
+            if self._ctrl_thread is None:
+                # Direct serve_forever() call (no start(), so no control
+                # thread): broadcast inline until stopped. PUB drops
+                # messages for slow-JOINING subscribers, so a client that
+                # dials in after the data ended still learns the stream
+                # is over.
+                while not self._stop.is_set():
+                    self._ctrl_sock.send(marker)
+                    # A checkpoint can still be requested after the stream
+                    # ended (e.g. end-of-epoch state); keep honoring pause.
+                    if self._pause.is_set():
+                        self._paused_gen = self._pause_gen
+                    time.sleep(0.05)
+
+    def _wait_for_credit(self):
+        """Credit-based flow control: park (off the reader) until granted
+        credit remains. Bounds total outstanding chunks by what the
+        attached consumers granted — the PUSH fan-out's N*sndhwm memory
+        ceiling becomes an explicit, consumer-controlled budget."""
+        while not self._stop.is_set() and not self._draining.is_set():
+            with self._admission_lock:
+                if (self._credit is None or self._credit_disabled
+                        or self._credit > 0):
+                    return
+            time.sleep(0.02)
 
     def _send_chunk(self, seq, frames, count):
         """HWM-respecting send of ``[meta, header, buf...]``; returns False
@@ -468,6 +622,10 @@ class DataServer(object):
                 if count:
                     self._served_chunks += 1
                     self._m_served.inc()
+                    with self._admission_lock:
+                        if self._credit is not None \
+                                and not self._credit_disabled:
+                            self._credit -= 1
                 return True
             except self._zmq.Again:
                 # All consumers at HWM (or none connected yet): wake the
@@ -493,8 +651,112 @@ class DataServer(object):
         os.replace(tmp, self._snapshot_path)
         self._last_snapshot = (self._served_chunks, time.monotonic())
 
+    @property
+    def state(self):
+        """Drain state machine position: ``'awaiting-cursor'`` (deferred
+        build, no consumer yet), ``'serving'``, ``'draining'``, or
+        ``'drained'``."""
+        if self._drained.is_set():
+            return 'drained'
+        if self._draining.is_set():
+            return 'draining'
+        if self._reader is None:
+            return 'awaiting-cursor'
+        return 'serving'
+
+    def drain(self, timeout_s=None):
+        """Graceful drain: stop admitting consumers, finish the in-flight
+        chunk, capture the final stream cursor, broadcast a clean END
+        (exact served count — consumers verify zero chunks were lost),
+        and let the serve loop exit. Returns True once fully drained
+        (``timeout_s=None`` waits indefinitely; a server parked in an
+        HWM send retry with no consumer drains only when one returns or
+        ``stop()`` cuts it short). Draining a server that already ENDed
+        cleanly reports drained — idempotent for orchestrators."""
+        self._draining.set()
+        done = self._serving_done.wait(timeout_s)
+        if done and (self._end_marker or b'').startswith(_CTRL_END):
+            self._drained.set()
+        return done and self._drained.is_set()
+
+    @property
+    def final_cursor(self):
+        """The serving reader's last ``state_dict()`` captured at clean
+        end / drain — the handoff a replacement server resumes from."""
+        return self._final_cursor
+
+    def _release_consumer_locked(self, cid):
+        """Drop a consumer from the admission ledger (caller holds
+        _admission_lock) and refund its initial credit grant — a crashed
+        consumer must not permanently shrink the flow-control window
+        (the refund is approximate: chunks it had in flight are not
+        attributable under PUSH fair-queuing, so the bound loosens by at
+        most its unflushed grants rather than tightening forever)."""
+        entry = self._consumers.pop(cid, None)
+        if entry is None:
+            return
+        credits = entry.get('credits') or 0
+        if self._credit is not None and not self._credit_disabled:
+            self._credit += credits
+            if not any(e.get('credits')
+                       for e in self._consumers.values()):
+                # No credit-granting consumer remains: disarm so a stale
+                # deficit can't wedge the serve loop; the next credit
+                # attach re-bases the pool from scratch.
+                self._credit = None
+
+    def _prune_consumers_locked(self, now):
+        expiry = 3 * self._lease_s
+        for cid in [c for c, e in self._consumers.items()
+                    if now - e['renewed'] > expiry]:
+            self._release_consumer_locked(cid)
+            logger.warning('data server %s: consumer %s admission lease '
+                           'expired (no renew in %.0fs)',
+                           self.data_endpoint, cid, expiry)
+
+    def _control_loop(self):
+        """Owns the control PUB socket (after start()): lease heartbeats
+        every ``lease_s / 3``, END/ERR broadcast once the stream is done
+        (repeating, for slow joiners), admission-ledger pruning, and
+        post-end checkpoint-pause acknowledgement."""
+        hb_interval = max(self._lease_s / 3.0, 0.05)
+        hb_tail = self._rpc_endpoint_bytes()
+        next_hb = 0.0
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now >= next_hb:
+                state = _STATE_CODES.get(self.state, 0)
+                msg = (_CTRL_HB
+                       + _HB_STRUCT.pack(self._server_id, self._lease_s,
+                                         state)
+                       + hb_tail)
+                if self._auth_key is not None:
+                    msg += _mac(self._auth_key, msg)
+                self._ctrl_sock.send(msg)
+                with self._admission_lock:
+                    self._prune_consumers_locked(now)
+                next_hb = now + hb_interval
+            marker = self._end_marker
+            if marker is not None:
+                self._ctrl_sock.send(marker)
+                # A checkpoint can still be requested after the stream
+                # ended (end-of-epoch state); the serve thread is gone,
+                # so acknowledge the pause boundary here — trivially true
+                # between chunks that will never come.
+                if self._pause.is_set():
+                    self._paused_gen = self._pause_gen
+            self._stop.wait(0.05 if marker is not None
+                            else min(hb_interval, 0.25))
+
+    def _rpc_endpoint_bytes(self):
+        try:
+            return self.rpc_endpoint.encode('utf-8')
+        except Exception:   # noqa: BLE001 - heartbeat must still go out
+            return b''
+
     def _rpc_loop(self):
         """Answer checkpoint/stats requests (REP socket, one at a time)."""
+        from petastorm_tpu import faults
         zmq = self._zmq
         while not self._stop.is_set():
             if not self._rpc_sock.poll(100):
@@ -503,6 +765,28 @@ class DataServer(object):
                 raw = self._rpc_sock.recv()
             except zmq.ZMQError:
                 return
+            if faults.get_injector().should_fire('rpc-blackhole'):
+                # Partitioned control plane: swallow the request. REP
+                # requires send-before-next-recv, so reset the socket's
+                # state machine by re-binding it (only this thread touches
+                # the rpc socket while running).
+                logger.warning('fault injection: rpc-blackhole dropping '
+                               'request without reply')
+                endpoint = self._rpc_sock.getsockopt(
+                    zmq.LAST_ENDPOINT).decode()
+                self._rpc_sock.close(linger=0)
+                self._rpc_sock = self._context.socket(zmq.REP)
+                # close(linger=0) releases the port asynchronously on the
+                # io thread: retry the rebind briefly.
+                for attempt in range(200):
+                    try:
+                        self._rpc_sock.bind(endpoint)
+                        break
+                    except zmq.ZMQError:
+                        if attempt == 199:
+                            raise
+                        time.sleep(0.02)
+                continue
             if self._auth_key is not None:
                 # Authenticate BEFORE unpickling: an unauthenticated
                 # request gets an explicit (non-pickle-derived) refusal.
@@ -536,6 +820,93 @@ class DataServer(object):
 
     def _handle_rpc(self, request):
         cmd = request.get('cmd')
+        if cmd == 'attach':
+            # Admission control (the control-plane half of the consumer
+            # handshake): a server past its capacity knob or draining
+            # refuses with a TYPED reason instead of silently feeding or
+            # starving the consumer. Re-attach of a known consumer is a
+            # lease renew. The first attach may carry a deterministic
+            # resume cursor — a reader_builder server builds its reader
+            # from it (reconnect-with-resume handoff).
+            consumer = request.get('consumer') or 'anonymous'
+            now = time.monotonic()
+            with self._admission_lock:
+                self._prune_consumers_locked(now)
+                state = self.state
+                known = consumer in self._consumers
+                if state in ('draining', 'drained') and not known:
+                    self._m_rejected.labels('draining').inc()
+                    return {'server_id': self._server_id, 'refused': state,
+                            'state': state, 'sent': self._served_chunks}
+                if (self._max_consumers is not None and not known
+                        and len(self._consumers) >= self._max_consumers):
+                    self._m_rejected.labels('overloaded').inc()
+                    return {'server_id': self._server_id,
+                            'refused': 'overloaded',
+                            'max_consumers': self._max_consumers,
+                            'state': state}
+                credits = int(request.get('credits') or 0)
+                if known:
+                    entry = self._consumers[consumer]
+                    entry['renewed'] = now
+                else:
+                    self._consumers[consumer] = {'renewed': now,
+                                                 'credits': credits}
+                    if credits and not self._credit_disabled:
+                        self._credit = (self._credit or 0) + credits
+                # The aggregate gate is sound only while EVERY admitted
+                # consumer grants credits: a credit-blind consumer's pulls
+                # consume credit nobody grants back, so a mixed ledger —
+                # in either attach order — disarms the gate rather than
+                # wedge the fleet.
+                if (self._credit is not None and not self._credit_disabled
+                        and any(not e.get('credits')
+                                for e in self._consumers.values())):
+                    self._credit_disabled = True
+                    logger.warning('credit-blind consumer present beside '
+                                   'flow-controlled ones; credit gate '
+                                   'disarmed')
+            resume = None
+            cursor = request.get('resume_cursor')
+            if cursor is not None and self._reader_builder is not None \
+                    and not self._cursor_evt.is_set():
+                self._resume_cursor = cursor
+                resume = 'cursor'
+            if self._reader_builder is not None:
+                self._cursor_evt.set()
+            return {'server_id': self._server_id, 'state': self.state,
+                    'lease_s': self._lease_s, 'sent': self._served_chunks,
+                    'resume': resume}
+        if cmd == 'detach':
+            with self._admission_lock:
+                self._release_consumer_locked(request.get('consumer'))
+            return {'ok': True}
+        if cmd == 'credit':
+            with self._admission_lock:
+                if self._credit is not None and not self._credit_disabled:
+                    self._credit += int(request.get('n', 0))
+                avail = self._credit
+            return {'ok': True, 'credit': avail}
+        if cmd == 'drain':
+            # Graceful drain over rpc: park admission, let the serve loop
+            # finish its in-flight chunk and END cleanly, reply with the
+            # final cursor so the orchestrator can hand the stream to a
+            # replacement.
+            timeout_s = float(request.get('timeout_s', 30.0))
+            drained = self.drain(timeout_s)
+            return {'server_id': self._server_id, 'state': self.state,
+                    'drained': bool(drained),
+                    'sent': self._served_chunks,
+                    'cursor': self._final_cursor if drained else None}
+        if cmd in ('pause_state', 'schema', 'lineage_ctx') \
+                and self._reader is None:
+            # Deferred-build server with no consumer attached yet: these
+            # commands need a reader. A typed error reply (instead of
+            # {'schema': None} or a pickled AttributeError) lets callers
+            # distinguish "not ready yet — attach/retry" from "broken".
+            return {'error': 'server is awaiting a resume cursor (no '
+                             'reader built yet) — attach first',
+                    'retry': True, 'state': self.state}
         if cmd == 'pause_state':
             # Park the serve loop at a chunk boundary, then snapshot: the
             # reader's consumption state then matches _served_chunks
@@ -571,9 +942,18 @@ class DataServer(object):
             # snapshot_lag/age let an orchestrator confirm crash-recovery
             # readiness (a stale snapshot means a wide replay window).
             snap_sent, snap_at = self._last_snapshot
+            with self._admission_lock:
+                n_consumers = len(self._consumers)
+                credit = self._credit if not self._credit_disabled else None
             return {'server_id': self._server_id,
                     'sent': self._served_chunks,
                     'done': self._serving_done.is_set(),
+                    'state': self.state,
+                    'lease_s': self._lease_s,
+                    'consumers': n_consumers,
+                    'max_consumers': self._max_consumers,
+                    'credit': credit,
+                    'final_cursor': self._final_cursor,
                     'snapshot_lag_chunks': (
                         self._served_chunks - snap_sent
                         if snap_sent is not None else None),
@@ -617,6 +997,13 @@ class DataServer(object):
             raise RuntimeError('server already started')
         self._thread = threading.Thread(target=self.serve_forever, daemon=True,
                                         name='pst-data-service-serve')
+        # Control thread first: it owns the PUB socket (lease heartbeats,
+        # END broadcast), and consumers should see a lease from process
+        # start — before the possibly-slow first decode.
+        self._ctrl_thread = threading.Thread(target=self._control_loop,
+                                             daemon=True,
+                                             name='pst-data-service-lease')
+        self._ctrl_thread.start()
         self._thread.start()
         self._rpc_thread = threading.Thread(target=self._rpc_loop, daemon=True,
                                             name='pst-data-service-rpc')
@@ -636,12 +1023,14 @@ class DataServer(object):
         self._stop.set()
         # Stop the reader FIRST: it unblocks a serve thread parked inside
         # the reader's __next__. zmq sockets are not thread-safe, so they
-        # may only be closed once the serve/rpc threads have provably
-        # exited.
-        self._reader.stop()
-        self._reader.join()
+        # may only be closed once the serve/rpc/control threads have
+        # provably exited. (reader may be None: a deferred-build server
+        # drained/stopped before any consumer attached.)
+        if self._reader is not None:
+            self._reader.stop()
+            self._reader.join()
         threads_done = True
-        for thread in (self._thread, self._rpc_thread):
+        for thread in (self._thread, self._rpc_thread, self._ctrl_thread):
             if thread is not None:
                 thread.join(timeout=10)
                 threads_done = threads_done and not thread.is_alive()
@@ -676,7 +1065,8 @@ def load_server_snapshot(path):
 def serve_dataset(dataset_url, bind, reader_factory=None, start=True,
                   sndhwm=4, auth_key=None, snapshot_path=None,
                   snapshot_every=16, snapshot_resume=None,
-                  replay_ring_chunks=None, lineage=True, **reader_kwargs):
+                  replay_ring_chunks=None, lineage=True, lease_s=None,
+                  max_consumers=None, await_cursor=False, **reader_kwargs):
     """Convenience: build a tensor reader over ``dataset_url`` and serve it.
 
     Returns the started :class:`DataServer` (context-manage it). Extra
@@ -693,6 +1083,16 @@ def serve_dataset(dataset_url, bind, reader_factory=None, start=True,
     Recovery's seq-based dedupe requires the reader to re-produce chunks
     deterministically after resume: pass ``workers_count=1`` when arming
     ``snapshot_path`` (see :class:`DataServer`).
+
+    Fleet fault tolerance: ``lease_s`` tunes the server's control-plane
+    lease heartbeat (``PETASTORM_TPU_LEASE_S`` default), ``max_consumers``
+    arms admission control (extra consumers get a typed refusal), and
+    ``await_cursor=True`` defers the reader build until the first consumer
+    attaches — a REPLACEMENT server for a dead deterministic peer then
+    builds its reader from the consumer's shipped
+    :class:`~petastorm_tpu.determinism.DeterministicCursor` frontier and
+    continues the stream bit-identically (the consumer's reader config
+    kwargs here must match the dead server's).
     """
     from petastorm_tpu.reader import make_tensor_reader
 
@@ -702,16 +1102,31 @@ def serve_dataset(dataset_url, bind, reader_factory=None, start=True,
         if 'resume_state' in reader_kwargs:
             raise ValueError('pass either snapshot_resume or resume_state, '
                              'not both — the snapshot embeds the reader state')
+        if await_cursor:
+            raise ValueError('pass either snapshot_resume or await_cursor: '
+                             'the snapshot already fixes the resume point')
         reader_kwargs['resume_state'] = snapshot_resume['reader_state']
     factory = reader_factory or make_tensor_reader
+    server_kwargs = dict(sndhwm=sndhwm, auth_key=auth_key,
+                         snapshot_path=snapshot_path,
+                         snapshot_every=snapshot_every,
+                         snapshot_resume=snapshot_resume,
+                         replay_ring_chunks=replay_ring_chunks,
+                         lineage=lineage, lease_s=lease_s,
+                         max_consumers=max_consumers)
+    if await_cursor:
+        def _builder(resume_state=None):
+            kwargs = dict(reader_kwargs)
+            if resume_state is not None:
+                kwargs['resume_state'] = resume_state
+            return factory(dataset_url, **kwargs)
+
+        server = DataServer(None, bind, reader_builder=_builder,
+                            **server_kwargs)
+        return server.start() if start else server
     reader = factory(dataset_url, **reader_kwargs)
     try:
-        server = DataServer(reader, bind, sndhwm=sndhwm, auth_key=auth_key,
-                            snapshot_path=snapshot_path,
-                            snapshot_every=snapshot_every,
-                            snapshot_resume=snapshot_resume,
-                            replay_ring_chunks=replay_ring_chunks,
-                            lineage=lineage)
+        server = DataServer(reader, bind, **server_kwargs)
     except Exception:
         # e.g. bind: address already in use — don't leak the started pool.
         reader.stop()
@@ -811,7 +1226,8 @@ class RemoteReader(object):
     def __init__(self, endpoints, control_endpoints=None, rpc_endpoints=None,
                  rcvhwm=4, poll_timeout_s=0.1, shared_stream=False,
                  end_grace_s=5.0, resume_state=None, auth_key=None,
-                 rpc_retry_policy=None):
+                 rpc_retry_policy=None, admission=True, flow_control=None,
+                 reconnect_s=None, consumer_id=None):
         import zmq
 
         if isinstance(endpoints, str):
@@ -898,6 +1314,53 @@ class RemoteReader(object):
             for cols in resume_state['pending']:
                 self._pending.append(dict(cols))
         self.last_row_consumed = False
+        # -- fleet control plane (leases, admission, reconnect) ----------
+        from petastorm_tpu import metrics as metrics_mod
+        import uuid as uuid_mod
+        self._data_endpoints = list(endpoints)
+        self._consumer_id = consumer_id or uuid_mod.uuid4().hex[:12]
+        self._flow_control = int(flow_control) if flow_control else None
+        self._reconnect_s = (float(reconnect_s) if reconnect_s is not None
+                             else _env_float(ENV_RECONNECT,
+                                             DEFAULT_RECONNECT_S))
+        self._m_lease_exp = metrics_mod.counter(
+            'pst_server_lease_expiries_total',
+            'Data-service server leases that expired client-side')
+        self._m_reconnects = metrics_mod.counter(
+            'pst_reconnects_total',
+            'Consumer re-attaches after a server lease expiry, by outcome',
+            labelnames=('outcome',))
+        self._m_hedged = metrics_mod.counter(
+            'pst_hedged_rpcs_total',
+            'Metadata rpcs where a hedge to another server was issued')
+        # All of the following move under _acct_lock (written by the pump
+        # thread's control drain, the client control thread, and probes):
+        self._lease = {}            # sid -> {deadline, lease_s, state, rpc}
+        self._lease_expired = set()  # sids whose expiry was already counted
+        self._sid_rpc = {}          # sid -> rpc endpoint (from heartbeats)
+        self._det_frontier = {}     # sid -> (epoch, pos) of last recv chunk
+        self._credit_owed = {}      # sid -> received chunks not yet granted
+        self._admission_refused = {}  # rpc endpoint -> refusal reason
+        self._draining_eps = set()  # rpc endpoints heartbeating 'draining'
+        self._reconnect_deadline = {}  # rpc ep -> give-up time (sole mode)
+        self._reconnect_announce = set()  # rpc eps owed a reconnect metric
+        self._breakers = {}         # rpc endpoint -> retry.CircuitBreaker
+        self._breaker_threshold = 3     # whole-budget misses before open
+        self._breaker_reset_s = 15.0    # open -> half-open cooldown
+        self._attach_state = {ep: {'status': 'new', 'next_try': 0.0,
+                                   'last_renew': 0.0, 'lease_s': None}
+                              for ep in self._rpc_endpoints}
+        self._last_ctrl_drain = 0.0
+        self._ctl_thread = None
+        if admission:
+            # Client control thread: attach/renew admission leases, ship
+            # the deterministic resume cursor to replacement servers, and
+            # replenish flow-control credits — all on fresh REQ sockets,
+            # never the pump thread's data/control sockets.
+            self._ctl_thread = threading.Thread(
+                target=self._client_control_loop, daemon=True,
+                name='pst-data-service-client')
+            self._ctl_thread.start()
 
     def __iter__(self):
         return self
@@ -914,7 +1377,9 @@ class RemoteReader(object):
                         self._bad_auth_frames += 1
                         continue
                     msg = msg[:-_MAC_LEN]
-                if msg.startswith(_CTRL_ERR):
+                if msg.startswith(_CTRL_HB):
+                    self._note_heartbeat(msg[len(_CTRL_HB):])
+                elif msg.startswith(_CTRL_ERR):
                     body = msg[len(_CTRL_ERR):]
                     sid = body[:_SERVER_ID_LEN]
                     self._server_errors[sid] = body[_SERVER_ID_LEN:].decode(
@@ -930,6 +1395,222 @@ class RemoteReader(object):
                             count_bytes)[0]
         except zmq.Again:
             pass
+
+    def _note_heartbeat(self, body):
+        """A server lease heartbeat arrived on the control socket: renew
+        its lease, learn the sid -> rpc endpoint mapping, and clear any
+        reconnect wait on that endpoint (a fresh lease IS the replacement
+        being alive)."""
+        if len(body) < _HB_STRUCT.size:
+            return
+        sid, lease_s, state_code = _HB_STRUCT.unpack_from(body)
+        rpc_ep = body[_HB_STRUCT.size:].decode('utf-8', 'replace') or None
+        state = _STATE_NAMES.get(state_code, 'serving')
+        now = time.monotonic()
+        with self._acct_lock:
+            self._lease[sid] = {'deadline': now + max(float(lease_s), 0.5),
+                                'lease_s': float(lease_s), 'state': state,
+                                'rpc': rpc_ep}
+            self._lease_expired.discard(sid)
+            if rpc_ep:
+                self._sid_rpc[sid] = rpc_ep
+                self._endpoint_sids[rpc_ep] = sid
+                self._reconnect_deadline.pop(rpc_ep, None)
+                if state in ('draining', 'drained'):
+                    self._draining_eps.add(rpc_ep)
+                else:
+                    self._draining_eps.discard(rpc_ep)
+
+    def _check_leases(self):
+        """Lease expiry is the fleet's dead-server signal: a shared-stream
+        consumer fails the expired server over immediately (no rpc probe
+        round-trips), a sole consumer opens its reconnect window — and
+        raises once a replacement misses it too."""
+        now = time.monotonic()
+        expired = []
+        with self._acct_lock:
+            for sid, info in self._lease.items():
+                if (sid in self._ended_server_ids
+                        or sid in self._lease_expired):
+                    continue
+                if now > info['deadline']:
+                    self._lease_expired.add(sid)
+                    expired.append((sid, dict(info)))
+            overdue = sorted(ep for ep, t in self._reconnect_deadline.items()
+                             if now > t)
+        for sid, info in expired:
+            self._m_lease_exp.inc()
+            ep = info.get('rpc')
+            logger.warning(
+                'data-service server %s (lease %.1fs, rpc %s) missed its '
+                'lease — declaring it dead', sid.hex(), info['lease_s'], ep)
+            if self._shared_stream:
+                if ep is not None:
+                    self._mark_failed([ep])
+            elif ep is not None:
+                if self._reconnect_s > 0:
+                    with self._acct_lock:
+                        self._reconnect_deadline.setdefault(
+                            ep, now + self._reconnect_s)
+                        self._reconnect_announce.add(ep)
+                else:
+                    self._stopped = True
+                    with self._sock_lock:
+                        self._close_sockets()
+                    raise RuntimeError(
+                        'data-service server {} lease expired and '
+                        'reconnect is disabled (reconnect_s=0) — restart '
+                        'the server or arm {}'.format(ep, ENV_RECONNECT))
+        if overdue:
+            self._m_reconnects.labels('failed').inc()
+            self._stopped = True
+            with self._sock_lock:
+                self._close_sockets()
+            raise RuntimeError(
+                'data-service server(s) {} lease-expired and no '
+                'replacement appeared within the {}s reconnect window '
+                '(see docs/troubleshoot.rst, "consumer stuck after server '
+                'restart")'.format(overdue, self._reconnect_s))
+
+    def _enforce_admission(self):
+        """Admission refusals recorded by the control thread surface here,
+        on the consuming thread: every server refusing = a typed
+        ``ServerOverloaded`` (``reason`` = overloaded/draining); a subset
+        refusing = this consumer DISCONNECTS those servers' data sockets
+        (fair-queued PUSH would otherwise keep handing it chunks meant
+        for the admitted consumers — e.g. an exact drain's tail) and, on
+        a shared stream, treats them as failed over."""
+        with self._acct_lock:
+            refused = dict(self._admission_refused)
+        if not refused:
+            return
+        if len(refused) >= self._n_servers:
+            from petastorm_tpu.errors import ServerOverloaded
+            self._stopped = True
+            with self._sock_lock:
+                self._close_sockets()
+            reason = ('overloaded' if 'overloaded' in refused.values()
+                      else sorted(refused.values())[0])
+            raise ServerOverloaded(
+                'every data-service server refused this consumer '
+                '(admission control): {} — scale the decode tier, retire '
+                'a consumer, or wait out the drain'.format(refused),
+                endpoint=sorted(refused)[0], reason=reason)
+        self._exclude_refused(sorted(refused))
+        if self._shared_stream:
+            self._mark_failed(sorted(refused))
+
+    def _exclude_refused(self, endpoints):
+        """Stop PULLing from servers that refused this consumer: without
+        the disconnect, zmq keeps fair-queuing chunks to the refused
+        socket and they are stolen from the admitted consumers. (A
+        bounded window of chunks received before the refusal landed may
+        already be lost to the stream — strict exclusivity needs
+        ``flow_control`` or a quiesced fleet during drains.)"""
+        to_drop = []
+        with self._acct_lock:
+            for endpoint in endpoints:
+                st = self._attach_state.get(endpoint)
+                if st is None or st['status'] == 'excluded':
+                    continue
+                st['status'] = 'excluded'
+                try:
+                    idx = self._rpc_endpoints.index(endpoint)
+                except ValueError:
+                    continue
+                if idx < len(self._data_endpoints):
+                    to_drop.append(self._data_endpoints[idx])
+        if to_drop:
+            with self._sock_lock:
+                if not self._closed:
+                    for data_endpoint in to_drop:
+                        try:
+                            self._data_sock.disconnect(data_endpoint)
+                        except self._zmq.ZMQError:
+                            pass    # already gone / never connected
+
+    def _note_det(self, sid, cols):
+        """Record the deterministic frontier of a RECEIVED chunk (caller
+        holds _acct_lock): the position a replacement server must resume
+        from is one past the last chunk this consumer received."""
+        info = cols.get('__pst_lineage__')
+        det = info.get('det') if isinstance(info, dict) else None
+        if not isinstance(det, dict) or det.get('pos') is None:
+            return
+        frontier = (int(det.get('epoch', 1)), int(det['pos']))
+        if frontier > self._det_frontier.get(sid, (0, -1)):
+            self._det_frontier[sid] = frontier
+
+    def det_cursor(self, endpoint=None):
+        """The deterministic resume cursor of this consumer's stream from
+        ``endpoint`` (rpc endpoint; default: across all servers — the
+        sole-server case). ``None`` when no deterministic chunk tags have
+        been seen (non-deterministic server, or nothing received yet).
+
+        This is the frontier shipped to a replacement server
+        (``attach`` rpc / ``serve_dataset(await_cursor=True)``): a server
+        resuming from it re-serves exactly the chunks this consumer has
+        NOT received, so the reconnected stream is bit-identical to an
+        uninterrupted one (chaos-proven in ``tests/test_fleet_ft.py``)."""
+        from petastorm_tpu import determinism
+        with self._acct_lock:
+            if endpoint is None:
+                frontiers = list(self._det_frontier.values())
+            else:
+                frontiers = [f for sid, f in self._det_frontier.items()
+                             if self._sid_rpc.get(sid) == endpoint]
+                if not frontiers and len(self._rpc_endpoints) == 1:
+                    # Sole server whose sid -> endpoint mapping was never
+                    # learned (no heartbeat support): every frontier is it.
+                    frontiers = list(self._det_frontier.values())
+        if not frontiers:
+            return None
+        epoch, pos = max(frontiers)
+        return determinism.det_tag_cursor({'epoch': epoch, 'pos': pos})
+
+    def reconnect(self, endpoint=None, cursor=_MISSING):
+        """Synchronously re-attach to a restarted/replacement server on
+        ``endpoint`` (rpc endpoint; default: the sole server), shipping
+        the deterministic frontier (:meth:`det_cursor`) unless ``cursor``
+        overrides it (pass ``None`` to ship nothing). Clears the
+        endpoint's failed/expired control state so accounting spans the
+        crash; returns the attach reply (``None`` if the server did not
+        answer). The background control thread does the same
+        automatically — this method exists for orchestrators that want
+        the handoff to happen *now* and to see the reply."""
+        if endpoint is None:
+            if len(self._rpc_endpoints) != 1:
+                raise ValueError('several servers: name the rpc endpoint '
+                                 'to reconnect')
+            endpoint = self._rpc_endpoints[0]
+        if cursor is _MISSING:
+            cursor = self.det_cursor(endpoint)
+        with self._acct_lock:
+            self._failed_endpoints.discard(endpoint)
+            self._admission_refused.pop(endpoint, None)
+            self._reconnect_announce.add(endpoint)
+            st = self._attach_state.setdefault(
+                endpoint, {'status': 'new', 'next_try': 0.0,
+                           'last_renew': 0.0, 'lease_s': None})
+            was_excluded = st['status'] == 'excluded'
+            st['status'] = 'new'
+            st['next_try'] = 0.0
+            self._breakers.pop(endpoint, None)
+            data_endpoint = None
+            if was_excluded:
+                try:
+                    idx = self._rpc_endpoints.index(endpoint)
+                    data_endpoint = self._data_endpoints[idx]
+                except (ValueError, IndexError):
+                    pass
+        self._probe_dead_until.pop(endpoint, None)
+        if data_endpoint is not None:
+            # A refusal-excluded endpoint disconnected its data socket;
+            # an explicit reconnect re-dials it.
+            with self._sock_lock:
+                if not self._closed:
+                    self._data_sock.connect(data_endpoint)
+        return self._do_attach(endpoint, cursor=cursor)
 
     def _close_sockets(self):
         if not self._closed:
@@ -989,6 +1670,11 @@ class RemoteReader(object):
             self._dup_chunks += 1
             return False
         self._chunks += 1
+        if self._flow_control:
+            # Credit-based flow control: every received chunk owes the
+            # serving fleet a credit grant back (flushed in batches by
+            # the control thread).
+            self._credit_owed[sid] = self._credit_owed.get(sid, 0) + 1
         return True
 
     def _drain_one_into_pending(self):
@@ -1001,6 +1687,7 @@ class RemoteReader(object):
         sid, seq, cols = received
         with self._acct_lock:
             if self._track(sid, seq):
+                self._note_det(sid, cols)
                 self._pending.append(cols)
         return True
 
@@ -1062,14 +1749,15 @@ class RemoteReader(object):
         Falls back to a minimal non-replayable context when no server
         answers the ``lineage_ctx`` rpc."""
         ctx = None
-        for endpoint in self._rpc_endpoints:
-            try:
-                reply = self._one_shot_rpc(endpoint, {'cmd': 'lineage_ctx'})
-            except Exception:  # noqa: BLE001 - any server may do
-                reply = None
-            if reply is not None and reply.get('ctx'):
-                ctx = dict(reply['ctx'])
-                break
+        try:
+            # Any server can answer: hedge instead of walking endpoints
+            # serially (a slow first server used to cost its whole
+            # timeout before the next was even asked).
+            reply = self._hedged_rpc({'cmd': 'lineage_ctx'})
+        except Exception:  # noqa: BLE001 - context is best-effort
+            reply = None
+        if reply is not None and reply.get('ctx'):
+            ctx = dict(reply['ctx'])
         if ctx is None:
             ctx = {'mode': None}
         ctx['remote'] = True
@@ -1108,11 +1796,34 @@ class RemoteReader(object):
             with self._sock_lock:
                 self._close_sockets()
             raise StopIteration
+        # Admission refusals end the stream BEFORE the backlog fast path:
+        # a refused consumer must not consume chunks it stole from the
+        # admitted ones.
+        self._enforce_admission()
         with self._acct_lock:
             if self._pending:
                 return self._deliver(self._pending.popleft())
         end_deadline = None
         while True:
+            # A busy stream (or a paused consumer) must not starve the
+            # control plane: END broadcasts and lease heartbeats ride the
+            # control socket, and an endless data torrent used to defer
+            # their processing to the first empty poll. Drain control at
+            # most every 50ms — and ALWAYS before judging leases, so a
+            # consumer pause longer than lease_s (a compile, an eval)
+            # processes the queued renewals instead of spuriously
+            # declaring the whole fleet dead.
+            now = time.monotonic()
+            if now - self._last_ctrl_drain > 0.05:
+                self._last_ctrl_drain = now
+                with self._sock_lock:
+                    if not (self._stopped or self._closed):
+                        self._drain_control()
+            # Control-plane upkeep runs outside the socket lock: lease
+            # expiry may raise (or fail servers over), admission refusals
+            # raise typed errors.
+            self._check_leases()
+            self._enforce_admission()
             with self._sock_lock:
                 if self._stopped or self._closed:
                     self._close_sockets()
@@ -1122,6 +1833,7 @@ class RemoteReader(object):
                     sid, seq, cols = received
                     with self._acct_lock:
                         if self._track(sid, seq):
+                            self._note_det(sid, cols)
                             return self._deliver(cols)
                     continue    # duplicate (server ring replay): drop
                 # No data pending: check for END/ERR broadcasts, re-poll.
@@ -1325,18 +2037,256 @@ class RemoteReader(object):
         finally:
             sock.close(linger=0)
 
+    def _breaker(self, endpoint):
+        """Per-endpoint circuit breaker over the rpc plane: a blackholed
+        server (swallows requests, answers nothing) costs the whole retry
+        budget exactly ``failure_threshold`` times, then calls
+        short-circuit to None until the half-open probe succeeds."""
+        from petastorm_tpu.retry import CircuitBreaker
+        with self._acct_lock:
+            breaker = self._breakers.get(endpoint)
+            if breaker is None:
+                breaker = self._breakers[endpoint] = CircuitBreaker(
+                    failure_threshold=self._breaker_threshold,
+                    reset_timeout_s=self._breaker_reset_s)
+            return breaker
+
     def _one_shot_rpc(self, endpoint, request, timeout_ms=10000):
-        """One logical rpc under the retry policy: a dropped REP gets a
-        fresh-socket retry (small jittered budget) instead of immediately
-        branding the server dead. ``None`` only once the WHOLE budget is
-        unanswered — callers may then treat the server as unreachable
-        rather than slow."""
+        """One logical rpc under the retry policy and the endpoint's
+        circuit breaker: a dropped REP gets a fresh-socket retry (small
+        jittered budget) instead of immediately branding the server dead;
+        a server that misses whole budgets repeatedly opens the circuit
+        and further calls return ``None`` instantly instead of hanging
+        the caller on a blackholed endpoint. ``None`` = unreachable."""
+        breaker = self._breaker(endpoint)
+        if not breaker.allow():
+            return None
         try:
-            return self._rpc_retry_policy.call(
+            reply = self._rpc_retry_policy.call(
                 self._rpc_attempt, endpoint, request, timeout_ms,
                 retry_call_name='data-service-rpc')
         except RpcUnanswered:
+            breaker.record_failure()
             return None
+        except Exception:
+            breaker.record_failure()
+            raise
+        breaker.record_success()
+        return reply
+
+    def _hedged_rpc(self, request, timeout_ms=10000, hedge_after_ms=300):
+        """Server-agnostic metadata rpc (schema, lineage context) with
+        hedging: ask the first reachable server, and when it stays silent
+        past ``hedge_after_ms`` also ask the next — first valid reply
+        wins. A slow-but-alive server (``server-slow`` fault) then costs
+        one hedge delay, not its full slowness; open-circuit endpoints
+        are skipped. ``None`` when nobody answered in time."""
+        zmq = self._zmq
+        from petastorm_tpu.retry import CircuitBreaker
+        candidates = [ep for ep in self._rpc_endpoints
+                      if self._breaker(ep).state != CircuitBreaker.OPEN]
+        if not candidates:
+            candidates = list(self._rpc_endpoints)  # all open: probe anyway
+        payload = self._rpc_dumps(request)
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        poller = zmq.Poller()
+        socks = {}
+        pending = list(candidates)
+        hedges = 0
+        error_reply = None
+        try:
+            while True:
+                now = time.monotonic()
+                if now >= deadline:
+                    break
+                if pending:
+                    endpoint = pending.pop(0)
+                    sock = self._context.socket(zmq.REQ)
+                    sock.setsockopt(zmq.LINGER, 0)
+                    sock.connect(endpoint)
+                    sock.send(payload)
+                    poller.register(sock, zmq.POLLIN)
+                    socks[sock] = endpoint
+                    hedges += 1
+                    if hedges > 1:
+                        self._m_hedged.inc()
+                elif not socks:
+                    break   # everyone answered an error / garbled reply
+                wait_ms = (deadline - now) * 1000.0
+                if pending:
+                    wait_ms = min(wait_ms, hedge_after_ms)
+                for sock, _ in poller.poll(max(int(wait_ms), 1)):
+                    try:
+                        reply = self._rpc_loads(sock.recv())
+                    except Exception:  # noqa: BLE001 - bad reply: next hedge
+                        self._breaker(socks[sock]).record_failure()
+                        poller.unregister(sock)
+                        sock.close(linger=0)
+                        del socks[sock]
+                        continue
+                    self._breaker(socks[sock]).record_success()
+                    if isinstance(reply, dict) and 'error' in reply:
+                        # A refusal (e.g. a legacy server's unknown-rpc
+                        # reply) is breaker-success — the server is alive —
+                        # but NOT a win: keep waiting on the other hedges
+                        # for a real answer, and only surface the first
+                        # refusal if nobody produces one.
+                        error_reply = error_reply or reply
+                        poller.unregister(sock)
+                        sock.close(linger=0)
+                        del socks[sock]
+                        continue
+                    return reply
+            for endpoint in socks.values():
+                # Everyone we asked sat on the request for the whole
+                # timeout: that is breaker-visible failure evidence.
+                self._breaker(endpoint).record_failure()
+            return error_reply
+        finally:
+            for sock in socks:
+                sock.close(linger=0)
+
+    # -- client control plane (attach / renew / credits) -----------------
+
+    def _client_control_loop(self):
+        """Background control-plane pump: attach to every server (admission
+        handshake, shipping the deterministic resume cursor where one is
+        known — the reconnect-with-resume handoff), renew the admission
+        lease each server lease period, and flush flow-control credit
+        grants. Uses only fresh REQ sockets — never the pump thread's."""
+        while not (self._stopped or self._closed):
+            now = time.monotonic()
+            for endpoint in self._rpc_endpoints:
+                with self._acct_lock:
+                    st = self._attach_state.get(endpoint)
+                    if st is None:
+                        continue
+                    status = st['status']
+                    if status in ('legacy', 'excluded'):
+                        continue
+                    if status == 'attached':
+                        renew_every = st['lease_s'] or DEFAULT_LEASE_S
+                        due = now - st['last_renew'] >= renew_every
+                    else:
+                        due = now >= st['next_try']
+                if due:
+                    self._do_attach(endpoint)
+                if self._stopped or self._closed:
+                    break
+            self._flush_credits()
+            time.sleep(0.25)
+        # Best-effort detach: free the admission slot promptly instead of
+        # letting it age out of the server's ledger.
+        if self._stopped:
+            for endpoint, st in list(self._attach_state.items()):
+                if st['status'] == 'attached':
+                    try:
+                        self._rpc_attempt(endpoint,
+                                          {'cmd': 'detach',
+                                           'consumer': self._consumer_id},
+                                          timeout_ms=300)
+                    except Exception:  # noqa: BLE001 - it ages out anyway
+                        pass
+
+    def _do_attach(self, endpoint, cursor=_MISSING):
+        """One attach/renew round-trip to ``endpoint``; returns the reply
+        (None when unreachable) and updates the attach ledger."""
+        if cursor is _MISSING:
+            cursor = self.det_cursor(endpoint)
+        request = {'cmd': 'attach', 'consumer': self._consumer_id}
+        if self._flow_control:
+            request['credits'] = self._flow_control
+        if cursor is not None:
+            request['resume_cursor'] = cursor
+        try:
+            reply = self._one_shot_rpc(endpoint, request, timeout_ms=2000)
+        except Exception:  # noqa: BLE001 - control plane is best-effort
+            reply = None
+        now = time.monotonic()
+        outcome = None
+        with self._acct_lock:
+            st = self._attach_state.setdefault(
+                endpoint, {'status': 'new', 'next_try': 0.0,
+                           'last_renew': 0.0, 'lease_s': None})
+            if reply is None:
+                st['status'] = 'unreachable'
+                st['next_try'] = now + 1.0
+            elif reply.get('refused'):
+                reason = reply['refused']
+                if st['status'] != 'excluded':
+                    st['status'] = 'refused-{}'.format(reason)
+                # Recorded for _enforce_admission on the consuming thread:
+                # overload raises / excludes; a draining refusal also
+                # excludes (a never-admitted consumer must not steal the
+                # drain's tail from the admitted ones).
+                self._admission_refused[endpoint] = reason
+                if reason != 'overloaded':
+                    self._draining_eps.add(endpoint)
+                st['next_try'] = now + 5.0
+            elif 'error' in reply:
+                # Pre-lease server: no attach rpc. Nothing to renew, ever.
+                st['status'] = 'legacy'
+            else:
+                st['status'] = 'attached'
+                st['last_renew'] = now
+                st['lease_s'] = reply.get('lease_s')
+                self._admission_refused.pop(endpoint, None)
+                sid = reply.get('server_id')
+                if sid is not None:
+                    self._endpoint_sids[endpoint] = sid
+                    self._sid_rpc[sid] = endpoint
+                was_announced = endpoint in self._reconnect_announce
+                self._reconnect_announce.discard(endpoint)
+                if reply.get('resume') == 'cursor':
+                    # A server accepted our shipped cursor: that IS a
+                    # cursor-handoff reconnect, whether or not the lease
+                    # expiry registered first (a fast replacement can
+                    # beat the expiry check).
+                    outcome = 'resumed'
+                elif was_announced:
+                    outcome = 'redelivered'
+        if outcome is not None:
+            # Reconnect accounting: 'resumed' = the replacement built its
+            # stream from our cursor (bit-identical continuation);
+            # 'redelivered' = snapshot-ring / from-scratch replay with
+            # seq/det dedupe (at-least-once made exactly-once).
+            self._m_reconnects.labels(outcome).inc()
+            logger.info('reconnected to data-service server %s (%s)',
+                        endpoint, outcome)
+        return reply
+
+    def _flush_credits(self):
+        """Grant the servers back the credits of chunks received since the
+        last flush (batched at half the initial window)."""
+        if not self._flow_control:
+            return
+        threshold = max(1, self._flow_control // 2)
+        with self._acct_lock:
+            grants = {sid: n for sid, n in self._credit_owed.items()
+                      if n >= threshold}
+            endpoints = {sid: self._sid_rpc.get(sid) for sid in grants}
+            for sid in grants:
+                self._credit_owed[sid] = 0
+        for sid, n in grants.items():
+            endpoint = endpoints[sid]
+            if endpoint is None:
+                continue    # no mapping: server predates the control plane
+            delivered = False
+            try:
+                delivered = self._one_shot_rpc(
+                    endpoint, {'cmd': 'credit', 'n': n},
+                    timeout_ms=1500) is not None
+            except Exception:  # noqa: BLE001 - restored below
+                logger.debug('credit grant to %s failed', endpoint,
+                             exc_info=True)
+            if not delivered:
+                # Put the grant back for the next flush: a dropped credit
+                # rpc must not permanently shrink the server's window
+                # into a both-sides-healthy wedge. (A reply lost AFTER
+                # the server applied it re-grants later — the bound
+                # loosens by one batch rather than tightening forever.)
+                with self._acct_lock:
+                    self._credit_owed[sid] = self._credit_owed.get(sid, 0) + n
 
     # -- health supervision (petastorm_tpu.health) -----------------------
 
@@ -1376,9 +2326,36 @@ class RemoteReader(object):
         now = time.monotonic()
         with self._acct_lock:
             already_failed = set(self._failed_endpoints)
+            # Lease-informed liveness: a server with a fresh lease is
+            # alive (no rpc round-trip), one whose lease expired is dead
+            # — the heartbeat replaces the per-tick rpc probe wherever a
+            # server ever heartbeat. The latest incarnation per endpoint
+            # wins (a restarted server renews under a new sid).
+            lease_by_ep = {}
+            for sid, info in self._lease.items():
+                ep = info.get('rpc')
+                if ep is None:
+                    continue
+                prev = lease_by_ep.get(ep)
+                if prev is None or info['deadline'] > prev['deadline']:
+                    lease_by_ep[ep] = dict(info, sid=sid)
         for endpoint in self._rpc_endpoints:
             if endpoint in already_failed:
                 continue
+            lease = lease_by_ep.get(endpoint)
+            if lease is not None and now <= lease['deadline']:
+                # Fresh lease: alive with zero rpc round-trips.
+                alive[endpoint] = {'server_id': lease['sid'],
+                                   'state': lease['state'],
+                                   'lease': 'fresh'}
+                with self._acct_lock:
+                    self._endpoint_sids[endpoint] = lease['sid']
+                continue
+            # Expired (or absent) lease: fall back to the rpc probe.
+            # Lease deadlines are stamped when the CONSUMER thread drains
+            # the control socket, so a probe sweeping from the watchdog
+            # thread while the consumer is paused would otherwise brand a
+            # healthy, answering server dead off a stale client-side view.
             if self._probe_dead_until.get(endpoint, 0) > now:
                 dead.append(endpoint)   # recently probed dead: don't re-pay
                 continue
@@ -1417,8 +2394,15 @@ class RemoteReader(object):
         servers, unreachable = {}, []
         by_process = {}
         for endpoint in self._rpc_endpoints:
-            reply = self._one_shot_rpc(endpoint, {'cmd': 'metrics'},
-                                       timeout_ms=timeout_ms)
+            try:
+                reply = self._one_shot_rpc(endpoint, {'cmd': 'metrics'},
+                                           timeout_ms=timeout_ms)
+            except Exception:  # noqa: BLE001 - a dying server mid-scrape
+                # (connection refused, auth failure, garbled reply) must
+                # land in `unreachable`, not abort the whole aggregation.
+                logger.debug('fleet_metrics: %s failed mid-scrape',
+                             endpoint, exc_info=True)
+                reply = None
             if reply is None or 'error' in reply \
                     or not isinstance(reply.get('metrics'), dict):
                 unreachable.append(endpoint)
@@ -1441,10 +2425,19 @@ class RemoteReader(object):
         endpoints are excluded to keep each sweep bounded."""
         diag = self.diagnostics
         _alive, dead = self.probe_servers()
+        with self._acct_lock:
+            draining = sorted(self._draining_eps)
+            refused = dict(self._admission_refused)
         return {'server_last_chunk_age_s': diag['server_last_chunk_age_s'],
                 'servers_ended': diag['servers_ended'],
                 'failed_over': diag['failed_over_servers'],
-                'dead_endpoints': dead}
+                'dead_endpoints': dead,
+                # Drain/admission states feed the watchdog's
+                # server-draining / server-overloaded classifications: a
+                # quiet receive loop with a draining (or refusing) server
+                # is an operator event, not a mystery stall.
+                'draining_endpoints': draining,
+                'refused_endpoints': refused}
 
     def failover_dead_servers(self, timeout_ms=500):
         """Shared-stream soft recovery: mark rpc-dead servers as ended so
@@ -1504,7 +2497,18 @@ class RemoteReader(object):
         like a local Reader (they build their namedtuple/tf types from it)."""
         if self._schema is None:
             endpoint = self._rpc_endpoints[0]
-            reply = self._one_shot_rpc(endpoint, {'cmd': 'schema'})
+            # Hedged: the schema is server-agnostic metadata, so a slow
+            # first server costs one hedge delay, not its full slowness.
+            # A typed not-ready refusal (an awaiting-cursor replacement
+            # whose reader is still building) is retried, not fatal.
+            deadline = time.monotonic() + 30.0
+            while True:
+                reply = self._hedged_rpc({'cmd': 'schema'})
+                if (isinstance(reply, dict) and reply.get('retry')
+                        and time.monotonic() < deadline):
+                    time.sleep(0.25)
+                    continue
+                break
             if reply is None:
                 raise RuntimeError(
                     'server {} did not answer the schema request — is it '
@@ -1535,16 +2539,33 @@ class RemoteReader(object):
                     for sid, t in self._last_recv.items()
                     if sid not in self._ended_server_ids}
             failed_over = sorted(self._failed_endpoints)
+            leases = {sid.hex(): {'remaining_s': round(info['deadline']
+                                                       - now, 3),
+                                  'state': info['state'],
+                                  'expired': sid in self._lease_expired}
+                      for sid, info in self._lease.items()
+                      if sid not in self._ended_server_ids}
+            attach = {ep: st['status']
+                      for ep, st in self._attach_state.items()}
+            circuit = {ep: b.state for ep, b in self._breakers.items()}
+            reconnect_pending = sorted(self._reconnect_deadline)
         return {'remote_chunks': self._chunks,
                 'servers': self._n_servers,
                 'servers_ended': len(self._ended_server_ids),
                 'pending_chunks': len(self._pending),
                 'duplicate_chunks': self._dup_chunks,
                 'bad_auth_frames': self._bad_auth_frames,
-                # Servers a watchdog liveness probe declared dead and
-                # failed over (shared-stream mode only; see
-                # failover_dead_servers).
+                # Servers a lease expiry / watchdog liveness probe
+                # declared dead and failed over (shared-stream mode only;
+                # see failover_dead_servers).
                 'failed_over_servers': failed_over,
+                # Control-plane view: per-server lease freshness, this
+                # consumer's admission status per endpoint, rpc circuit-
+                # breaker states, endpoints awaiting a replacement.
+                'leases': leases,
+                'attach': attach,
+                'circuit_breakers': circuit,
+                'reconnect_pending': reconnect_pending,
                 # Seconds since each server's last chunk: a server gone
                 # silent (SIGKILL, network partition) shows a growing age
                 # here long before the end-of-epoch accounting notices.
@@ -1569,6 +2590,8 @@ class RemoteReader(object):
         # could not.
         with self._sock_lock:
             self._close_sockets()
+        if self._ctl_thread is not None and self._ctl_thread.is_alive():
+            self._ctl_thread.join(timeout=5)
 
     def __enter__(self):
         return self

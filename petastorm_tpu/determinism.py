@@ -492,6 +492,35 @@ class DeterministicCursor(object):
                     'rows_into': int(rows)}
 
 
+def det_tag_cursor(det, rows_into=0):
+    """Resume cursor for the stream position AFTER the chunk tagged ``det``.
+
+    ``det`` is a per-chunk deterministic-mode tag ``{'seq', 'epoch',
+    'pos'}`` (``Reader.last_chunk_det`` / ``RemoteReader.last_chunk_det``
+    — it rides the data-service wire). The returned dict is a valid
+    ``resume_state`` for any deterministic reader with the same config:
+    the stream it produces continues exactly where the tagged chunk left
+    off. This is the cursor a data-service consumer ships to a
+    replacement server when its original died mid-epoch
+    (``RemoteReader.det_cursor`` / the ``attach`` rpc) — reconnect-with-
+    resume is then bit-identical to an uninterrupted stream.
+
+    ``rows_into`` > 0 records a partially consumed tagged chunk (the
+    resumed stream re-delivers only its tail)."""
+    if not isinstance(det, dict) or det.get('pos') is None:
+        raise ValueError('det_tag_cursor needs a deterministic chunk tag '
+                         'with epoch/pos, got {!r}'.format(det))
+    rows_into = int(rows_into)
+    if rows_into > 0:
+        # Mid-chunk cursor: resume re-delivers the open chunk's tail.
+        return {'version': STATE_VERSION, 'mode': MODE,
+                'epoch': int(det.get('epoch', 1)), 'pos': int(det['pos']),
+                'rows_into': rows_into}
+    return {'version': STATE_VERSION, 'mode': MODE,
+            'epoch': int(det.get('epoch', 1)), 'pos': int(det['pos']) + 1,
+            'rows_into': 0}
+
+
 def merge_cursors(states):
     """The global stream cursor of a sharded job: the *least-advanced*
     per-host cursor.

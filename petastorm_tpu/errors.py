@@ -99,6 +99,21 @@ class PipelineStallError(PetastormTpuError):
         self.diagnosis = diagnosis or {}
 
 
+class ServerOverloaded(PetastormTpuError):
+    """Every data-service server refused this consumer's attach — at its
+    ``max_consumers`` admission capacity, or draining/drained
+    (``data_service.DataServer``). Typed so orchestrators can distinguish
+    "scale the decode tier / retry elsewhere / wait out the drain" from a
+    genuine failure. ``endpoint`` names a refusing rpc endpoint;
+    ``reason`` is the server's refusal label (``overloaded`` /
+    ``draining`` / ``drained``)."""
+
+    def __init__(self, message, endpoint=None, reason=None):
+        super(ServerOverloaded, self).__init__(message)
+        self.endpoint = endpoint
+        self.reason = reason
+
+
 class CorruptChunkError(PetastormTpuError):
     """A persisted decoded chunk (``chunk_store.DecodedChunkStore`` entry
     or ``LocalDiskCache`` raw-layout blob) failed magic/structure/CRC32

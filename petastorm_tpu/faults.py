@@ -46,6 +46,20 @@ Sites and their effects when they fire:
                      Armed, the lock-order recorder raises
                      ``LockOrderViolation`` before blocking; unarmed the
                      inversion is silent. Consumed via ``should_fire``.
+``server-kill``      ``SIGKILL`` the current data-service server process at
+                     a chunk boundary of its serve loop — the fleet's
+                     "preempted decode host" drill (``data_service.py``;
+                     pair with ``token=`` to kill one server of a fleet).
+``server-slow``      sleep ``delay`` seconds before each chunk send in the
+                     data-service serve loop (a slow-but-alive server: the
+                     case hedged rpcs and lease freshness must distinguish
+                     from a dead one).
+``rpc-blackhole``    make the data-service rpc thread swallow the received
+                     request without replying (the REP socket is re-bound
+                     to reset its state machine) — a partitioned control
+                     plane: the client's whole rpc retry budget goes
+                     unanswered, which is what trips its circuit breaker.
+                     Consumed via ``should_fire``.
 ==================== ======================================================
 
 Params (all optional):
@@ -97,10 +111,14 @@ KNOWN_SITES = (
     'store-read-corrupt',
     'arena-stale-view',
     'lock-order-invert',
+    'server-kill',
+    'server-slow',
+    'rpc-blackhole',
 )
 
 #: Sites whose effect is a sleep rather than an error.
-_DELAY_SITES = ('fs-read-delay', 'queue-stall', 'device-put-delay')
+_DELAY_SITES = ('fs-read-delay', 'queue-stall', 'device-put-delay',
+                'server-slow')
 
 _DEFAULT_DELAY_S = 0.05
 
@@ -238,9 +256,9 @@ class FaultInjector(object):
                            site, key, spec.delay_s)
             time.sleep(spec.delay_s)
             return
-        if site == 'worker-kill':
-            logger.warning('fault injection: worker-kill SIGKILLing pid %d',
-                           os.getpid())
+        if site in ('worker-kill', 'server-kill'):
+            logger.warning('fault injection: %s SIGKILLing pid %d',
+                           site, os.getpid())
             import signal
             os.kill(os.getpid(), signal.SIGKILL)
             return  # pragma: no cover - unreachable

@@ -75,6 +75,8 @@ DISPATCH_HUNG = 'dispatch-hung'
 CONSUMER_NOT_DRAINING = 'consumer-not-draining'
 ARENA_POOL_WEDGED = 'arena-pool-wedged'
 REMOTE_SERVER_DEAD = 'remote-server-dead'
+SERVER_DRAINING = 'server-draining'
+SERVER_OVERLOADED = 'server-overloaded'
 RESEQUENCER_STALLED = 'resequencer-stalled'
 #: Pseudo-classification: every stale stage is parked in a *waiting* state
 #: (on upstream or the consumer) and no culpable stage has crossed its own
@@ -85,8 +87,10 @@ PIPELINE_WAITING = 'pipeline-waiting'
 #: Classifications that never escalate to a hard error: a consumer that
 #: stopped draining is the *trainer's* choice (long compile, eval loop,
 #: checkpoint write) — killing the pipeline under it would turn normal
-#: training-loop pauses into failures. The diagnosis is still recorded.
-SOFT_ONLY = frozenset({CONSUMER_NOT_DRAINING})
+#: training-loop pauses into failures; a draining data-service server is
+#: an *operator's* choice mid-rollout and ends in a clean END broadcast
+#: (or a failover) on its own. The diagnosis is still recorded.
+SOFT_ONLY = frozenset({CONSUMER_NOT_DRAINING, SERVER_DRAINING})
 
 #: States in which a stage is parked waiting on its *upstream* (or on the
 #: consumer) rather than doing its own work: a stale heartbeat in one of
@@ -377,8 +381,23 @@ def classify_stall(beats, probes):
         dead = remote.get('dead_endpoints') or []
         if dead:
             return (REMOTE_SERVER_DEAD, 'remote-recv',
-                    'data-service server(s) unreachable over rpc: {}'
-                    .format(sorted(dead)))
+                    'data-service server(s) dead (lease expired or '
+                    'unreachable over rpc): {}'.format(sorted(dead)))
+        draining = remote.get('draining_endpoints') or []
+        if draining:
+            # An operator event, not a fault: the server announced the
+            # drain in its lease heartbeats and will END (or a failover
+            # will cover it) on its own. Soft-only.
+            return (SERVER_DRAINING, 'remote-recv',
+                    'data-service server(s) draining (graceful shutdown '
+                    'announced in lease heartbeats): {}'.format(
+                        sorted(draining)))
+        refused = remote.get('refused_endpoints') or {}
+        if refused:
+            return (SERVER_OVERLOADED, 'remote-recv',
+                    'data-service server(s) refused this consumer '
+                    '(admission control at capacity): {}'.format(
+                        sorted(refused)))
         return (READER_STARVED, 'remote-recv',
                 'no chunks from any data-service server for {}s but all '
                 'rpc probes answer — decode tier is slow, not dead'

@@ -161,3 +161,111 @@ class RetryPolicy(object):
             kwargs['retry_call_name'] = name or getattr(fn, '__name__', 'call')
             return self.call(fn, *args, **kwargs)
         return wrapped
+
+
+class CircuitOpenError(Exception):
+    """The circuit is open: the protected endpoint failed its whole retry
+    budget ``failure_threshold`` consecutive times recently, so calls are
+    refused instantly instead of re-paying the budget against a blackholed
+    peer. Carries nothing — the caller already has the endpoint."""
+
+
+class CircuitBreaker(object):
+    """Client-side circuit breaker layered on :class:`RetryPolicy`.
+
+    The retry policy absorbs *transient* failures (a dropped reply, a
+    slow reply); the breaker handles *persistent* ones (a blackholed or
+    partitioned endpoint that swallows every request). Without it, every
+    probe of a dead endpoint pays the whole retry budget — a watchdog
+    sweeping each tick, or a consumer hedging metadata rpcs, stalls on
+    the corpse instead of routing around it.
+
+    States (the standard three):
+
+    * ``closed`` — calls flow; ``failure_threshold`` CONSECUTIVE recorded
+      failures open the circuit (a single success resets the count).
+    * ``open`` — :meth:`allow` is False and :meth:`call` raises
+      :class:`CircuitOpenError` without touching the endpoint, until
+      ``reset_timeout_s`` has passed.
+    * ``half-open`` — after the cooldown ONE probe call is admitted; its
+      success closes the circuit, its failure re-opens it (and restarts
+      the cooldown).
+
+    Thread-safe: state transitions happen under a lock; the protected
+    call itself runs outside it. One breaker guards one endpoint — keep
+    a dict keyed by endpoint for a fleet.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = 'closed', 'open', 'half-open'
+
+    def __init__(self, failure_threshold=3, reset_timeout_s=30.0,
+                 clock=time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError('failure_threshold must be >= 1, got {}'.format(
+                failure_threshold))
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._state = self.CLOSED
+        self._opened_at = None
+        self._probe_out = False     # a half-open probe is in flight
+        self.opens = 0              # episodes, for diagnostics
+
+    @property
+    def state(self):
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self):
+        if (self._state == self.OPEN
+                and self._clock() - self._opened_at >= self.reset_timeout_s):
+            self._state = self.HALF_OPEN
+            self._probe_out = False
+        return self._state
+
+    def allow(self):
+        """True when a call may proceed now. In half-open state only ONE
+        caller gets True until its outcome is recorded — concurrent
+        probes would hammer a barely-recovered endpoint."""
+        with self._lock:
+            state = self._state_locked()
+            if state == self.CLOSED:
+                return True
+            if state == self.HALF_OPEN and not self._probe_out:
+                self._probe_out = True
+                return True
+            return False
+
+    def record_success(self):
+        with self._lock:
+            self._failures = 0
+            self._state = self.CLOSED
+            self._probe_out = False
+
+    def record_failure(self):
+        with self._lock:
+            state = self._state_locked()
+            self._failures += 1
+            if state == self.HALF_OPEN or \
+                    self._failures >= self.failure_threshold:
+                if self._state != self.OPEN:
+                    self.opens += 1
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._probe_out = False
+
+    def call(self, fn, *args, **kwargs):
+        """Run ``fn`` through the breaker: :class:`CircuitOpenError` when
+        open; success/failure of the call recorded. Any exception counts
+        as a failure and propagates."""
+        if not self.allow():
+            raise CircuitOpenError()
+        try:
+            result = fn(*args, **kwargs)
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result

@@ -35,6 +35,13 @@ def main(argv=None):
                         choices=['null', 'memory', 'disk'])
     parser.add_argument('--shuffle-row-groups', action='store_true')
     parser.add_argument('--seed', type=int, default=None)
+    parser.add_argument('--deterministic', action='store_true',
+                        help='deterministic stream mode: chunk order is a '
+                             'pure function of (dataset, seed, epoch, '
+                             'position), chunks carry stream-cursor tags, '
+                             'and a sole consumer can reconnect to a '
+                             'replacement server bit-identically '
+                             '(--await-cursor on the replacement)')
     parser.add_argument('--sndhwm', type=int, default=4,
                         help='per-consumer chunk buffer (backpressure)')
     parser.add_argument('--batch-reader', action='store_true',
@@ -69,6 +76,23 @@ def main(argv=None):
                              'the wire; required while any trainer predates '
                              'the lineage sidecar (old consumers crash '
                              'unpacking the reserved payload key)')
+    parser.add_argument('--max-consumers', type=int, default=None,
+                        metavar='N',
+                        help='admission-control capacity: consumers past N '
+                             'get a typed ServerOverloaded refusal at '
+                             'attach instead of degrading everyone')
+    parser.add_argument('--lease-s', type=float, default=None,
+                        help='control-plane lease duration (heartbeats go '
+                             'out at a third of it; consumers declare the '
+                             'server dead one lease after the last one). '
+                             'Default: PETASTORM_TPU_LEASE_S or 10')
+    parser.add_argument('--await-cursor', action='store_true',
+                        help='defer the reader build until the first '
+                             'consumer attaches: a REPLACEMENT server for '
+                             'a dead deterministic peer then resumes from '
+                             'the consumer\'s shipped cursor and continues '
+                             'the stream bit-identically (reader flags '
+                             'here must match the dead server\'s)')
     args = parser.parse_args(argv)
 
     from petastorm_tpu.data_service import serve_dataset
@@ -96,6 +120,8 @@ def main(argv=None):
         'cache_type': args.cache_type,
         'shuffle_row_groups': args.shuffle_row_groups,
     }
+    if args.deterministic:
+        reader_kwargs['deterministic'] = True
     if args.seed is not None:
         reader_kwargs['seed'] = args.seed
     if args.fields:
@@ -106,10 +132,20 @@ def main(argv=None):
 
     # Handlers first: a supervisor's SIGTERM during a slow dataset open
     # must request clean teardown, not take the default kill and orphan
-    # pool workers.
+    # pool workers. The FIRST signal requests a graceful drain (finish
+    # the in-flight chunk, broadcast an exact END, report `drained`); a
+    # SECOND one forces immediate teardown.
+    drain_requested = threading.Event()
     stop = threading.Event()
+
+    def _on_signal(*_):
+        if drain_requested.is_set():
+            stop.set()
+        else:
+            drain_requested.set()
+
     for sig in (signal.SIGINT, signal.SIGTERM):
-        signal.signal(sig, lambda *_: stop.set())
+        signal.signal(sig, _on_signal)
 
     exporter = None
     if args.metrics_port is not None:
@@ -125,14 +161,19 @@ def main(argv=None):
                                snapshot_path=args.snapshot_path,
                                snapshot_every=args.snapshot_every,
                                snapshot_resume=args.resume,
-                               lineage=not args.no_lineage, **reader_kwargs)
+                               lineage=not args.no_lineage,
+                               lease_s=args.lease_s,
+                               max_consumers=args.max_consumers,
+                               await_cursor=args.await_cursor,
+                               **reader_kwargs)
     except BaseException:
         if exporter is not None:
             exporter.stop()
         raise
     status = {'data_endpoint': server.data_endpoint,
               'control_endpoint': server.control_endpoint,
-              'rpc_endpoint': server.rpc_endpoint}
+              'rpc_endpoint': server.rpc_endpoint,
+              'state': server.state}
     if exporter is not None:
         status['metrics_endpoint'] = exporter.address
     print(json.dumps(status), flush=True)
@@ -141,11 +182,25 @@ def main(argv=None):
     # still sit in the zmq send queue and the END broadcast keeps repeating
     # for slow joiners, so hold the sockets open for a drain grace before
     # stop() (which closes with linger=0, discarding anything queued).
+    drained = False
     while not stop.is_set():
+        if drain_requested.is_set():
+            # Graceful drain (first SIGTERM/SIGINT): stop admitting,
+            # finish the in-flight chunk, END with the exact served count
+            # — zero chunks lost, and the final stream cursor lands in
+            # the server's stats for a replacement to pick up. Non-
+            # blocking: the wait() below observes completion, and a
+            # second signal still forces teardown promptly.
+            server.drain(timeout_s=0)
         if server.wait(0.5):
+            drained = server.state == 'drained'
             stop.wait(args.drain_grace)
             break
+    final = {'state': 'drained' if (drained or server.state == 'drained')
+             else ('stopped' if stop.is_set() else 'served'),
+             'served_chunks': server.served_chunks}
     server.stop()
+    print(json.dumps(final), flush=True)
     if exporter is not None:
         exporter.stop()
     return 0

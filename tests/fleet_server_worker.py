@@ -1,0 +1,54 @@
+"""Subprocess entry point for the fleet fault-tolerance tests
+(``tests/test_fleet_ft.py``).
+
+Serves a DETERMINISTIC tensor-reader stream on an explicit (or wildcard)
+endpoint with a short lease, prints one JSON line with its endpoints,
+then idles until killed (SIGKILL = the preempted-host drill) or
+SIGTERM'd. ``--await-cursor`` starts the replacement flavor: the reader
+build is deferred until the first consumer attach ships its
+deterministic cursor frontier — the reconnect-with-resume handoff.
+Fault-injection env (``PETASTORM_TPU_FAULTS``) is inherited from the
+parent, so a blackholed-rpc server is just this worker with the env set.
+"""
+
+import json
+import signal
+import sys
+import time
+
+
+def main():
+    dataset_url, bind = sys.argv[1:3]
+    flags = sys.argv[3:]
+    await_cursor = '--await-cursor' in flags
+
+    from petastorm_tpu.data_service import serve_dataset
+
+    server = serve_dataset(
+        dataset_url, bind,
+        await_cursor=await_cursor, lease_s=2.0, sndhwm=1,
+        num_epochs=1, seed=7, workers_count=2, shuffle_row_groups=True,
+        reader_pool_type='thread', deterministic=True)
+    print(json.dumps({'data_endpoint': server.data_endpoint,
+                      'rpc_endpoint': server.rpc_endpoint,
+                      'state': server.state,
+                      'awaiting': await_cursor}), flush=True)
+
+    drain = []
+    signal.signal(signal.SIGTERM, lambda *_: drain.append(True))
+    try:
+        while True:     # serve threads run until we are killed/drained
+            if drain:
+                server.drain(timeout_s=30)
+                break
+            if server.wait(0.25):
+                time.sleep(1.0)     # let the END broadcast reach consumers
+                break
+    finally:
+        server.stop()
+    print(json.dumps({'state': server.state,
+                      'served_chunks': server.served_chunks}), flush=True)
+
+
+if __name__ == '__main__':
+    main()

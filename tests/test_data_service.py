@@ -394,31 +394,148 @@ def test_stats_reports_snapshot_freshness(service_dataset, tmp_path):
 
 
 def test_diagnostics_per_server_ages(service_dataset):
+    """Per-server chunk ages: both live servers report one; a cleanly
+    ENDed server drops out (its age is not a liveness signal).
+
+    Poll-until, not wall-clock: the endless server keeps chunks flowing,
+    so each condition is awaited by consuming (the busy-stream control
+    drain processes the finite server's END even while data floods —
+    the flake this test used to have under box load)."""
+    import time as _time
+
+    def consume_until(remote, predicate, why, budget_s=60):
+        # Progress-based deadline: an endless stream always yields, so a
+        # generous budget only ever fires on a genuine hang.
+        deadline = _time.monotonic() + budget_s
+        while not predicate():
+            assert _time.monotonic() < deadline, why
+            next(remote)
+
     with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
                        num_epochs=None, seed=0) as s1, \
             serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
                           num_epochs=1, seed=0) as s2:
         with RemoteReader([s1.data_endpoint, s2.data_endpoint],
                           shared_stream=True, end_grace_s=1.0) as remote:
-            seen_sids = set()
-            while len(seen_sids) < 2:
-                next(remote)
-                seen_sids = set(remote.diagnostics
-                                ['server_last_chunk_age_s'])
+            consume_until(
+                remote,
+                lambda: len(remote.diagnostics
+                            ['server_last_chunk_age_s']) >= 2,
+                'never saw chunks from both servers')
             mid = remote.diagnostics['server_last_chunk_age_s']
             assert len(mid) == 2, 'both live servers must report an age'
             assert all(isinstance(a, float) and a >= 0
                        for a in mid.values())
-            # Drain until the finite server ENDs: a cleanly-ended server
-            # must drop out of the ages (its age is not a liveness
-            # signal) while the endless one keeps reporting.
-            import time as _time
-            deadline = _time.monotonic() + 30
-            while len(remote.diagnostics['server_last_chunk_age_s']) == 2:
-                next(remote)
-                assert _time.monotonic() < deadline, 'finite server never ended'
+            consume_until(
+                remote,
+                lambda: len(remote.diagnostics
+                            ['server_last_chunk_age_s']) == 1,
+                'finite server never ended (END starved by busy stream?)')
             final = remote.diagnostics['server_last_chunk_age_s']
     assert len(final) == 1, 'ended server must be excluded from ages'
+
+
+def test_fleet_metrics_dead_server_lands_in_unreachable(service_dataset):
+    """A server dying mid-scrape (here: an endpoint nothing listens on —
+    the same evidence an rpc-level crash leaves) lands in `unreachable`
+    instead of aborting the whole aggregation; the live server's
+    snapshot still folds into the aggregate."""
+    import socket as pysocket
+
+    probe = pysocket.socket()
+    probe.bind(('127.0.0.1', 0))
+    dead_rpc = 'tcp://127.0.0.1:{}'.format(probe.getsockname()[1])
+    probe.close()
+
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0) as server:
+        with RemoteReader(server.data_endpoint, shared_stream=True,
+                          end_grace_s=1.0) as remote:
+            _drain_ids(remote)
+            # Graft a dead endpoint into the scrape set alongside the
+            # live one (short budget so the test stays fast).
+            remote._rpc_endpoints.append(dead_rpc)
+            fleet = remote.fleet_metrics(timeout_ms=300)
+    assert fleet['unreachable'] == [dead_rpc]
+    live = remote._rpc_endpoints[0]
+    assert live in fleet['servers']
+    served = fleet['aggregate']['pst_data_service_chunks_served_total']
+    assert sum(s['value'] for s in served['samples']) >= server.served_chunks
+
+
+def test_serve_cli_sigterm_graceful_drain(service_dataset):
+    """Satellite: SIGTERM to petastorm-tpu-serve = graceful drain — the
+    consumer's stream ends CLEANLY with exact accounting (zero loss),
+    the final status line reports `drained`, and the process exits 0."""
+    import json
+    import os
+    import signal as signal_mod
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'petastorm_tpu.tools.serve_cli',
+         service_dataset, '--bind', 'tcp://127.0.0.1:*', '--workers', '2',
+         '--epochs', '0', '--sndhwm', '1', '--drain-grace', '1'],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        endpoints = json.loads(proc.stdout.readline())
+        with RemoteReader(endpoints['data_endpoint'], rcvhwm=1) as remote:
+            ids = []
+            chunk = next(remote)
+            ids.extend(int(i) for i in np.asarray(chunk.sid))
+            os.kill(proc.pid, signal_mod.SIGTERM)
+            # The endless stream now ENDs cleanly at the drain boundary:
+            # exact sole-consumer accounting, no error raise.
+            ids.extend(_drain_ids(remote))
+        final = json.loads(proc.stdout.readline())
+        assert final['state'] == 'drained'
+        assert final['served_chunks'] == remote.diagnostics['remote_chunks']
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_serve_cli_max_consumers_refuses_extra(service_dataset):
+    """Satellite: --max-consumers wires admission control through the
+    shell entry point — with capacity 0 every consumer's attach is
+    refused and iteration raises the typed ServerOverloaded."""
+    import json
+    import subprocess
+    import sys
+    import time as _time
+
+    from petastorm_tpu.errors import ServerOverloaded
+
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'petastorm_tpu.tools.serve_cli',
+         service_dataset, '--bind', 'tcp://127.0.0.1:*', '--workers', '2',
+         '--epochs', '0', '--max-consumers', '0', '--drain-grace', '0'],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        endpoints = json.loads(proc.stdout.readline())
+        with RemoteReader(endpoints['data_endpoint']) as remote:
+            with pytest.raises(ServerOverloaded):
+                deadline = _time.monotonic() + 30
+                while _time.monotonic() < deadline:
+                    next(remote)
+                raise AssertionError('refusal never surfaced')
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_det_cursor_none_without_deterministic_tags(service_dataset):
+    """det_cursor() is None on a non-deterministic stream — reconnect
+    then falls back to snapshot-ring redelivery, never a wrong cursor."""
+    with serve_dataset(service_dataset, 'tcp://127.0.0.1:*',
+                       num_epochs=1, seed=0) as server:
+        with RemoteReader(server.data_endpoint) as remote:
+            next(remote)
+            assert remote.det_cursor() is None
+            _drain_ids(remote)
 
 
 def test_pytorch_loader_over_service(service_dataset):

@@ -1,0 +1,478 @@
+"""Fleet-grade fault tolerance for the data-service plane
+(``petastorm_tpu/data_service.py``): leases + graceful drain,
+reconnect-with-resume via DeterministicCursor handoff, admission control,
+credit flow control, circuit breaker, hedged rpcs — chaos-proven against
+the ``server-kill`` / ``rpc-blackhole`` / ``server-slow`` fault sites.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.data_service import RemoteReader, serve_dataset
+
+pytestmark = pytest.mark.chaos
+
+ROWS = 512
+ROWS_PER_GROUP = 16         # 32 deterministic chunks of ~64KB per epoch
+#: Chunks must be big enough that TCP buffering cannot swallow the whole
+#: stream (a "mid-epoch" kill/drain must provably be mid-epoch), and the
+#: serve/consume HWMs are 1 so only a few chunks are ever in flight.
+
+#: The one deterministic reader config every tier of these tests shares —
+#: the reconnect-with-resume contract requires the replacement server to
+#: rebuild the SAME stream, so there is exactly one copy of the config
+#: (mirrored by tests/fleet_server_worker.py for the subprocess tier).
+DET_KW = dict(num_epochs=1, seed=7, workers_count=2,
+              shuffle_row_groups=True, reader_pool_type='thread',
+              deterministic=True)
+
+
+@pytest.fixture(scope='module')
+def fleet_dataset(tmp_path_factory):
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('Fleet', [
+        UnischemaField('vec', np.float32, (1024,), NdarrayCodec(), False),
+        UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    rng = np.random.default_rng(11)
+    url = 'file://' + str(tmp_path_factory.mktemp('fleet') / 'store')
+    write_dataset(url, schema,
+                  ({'vec': rng.standard_normal(1024).astype(np.float32),
+                    'id': i} for i in range(ROWS)),
+                  rows_per_row_group=ROWS_PER_GROUP)
+    return url
+
+
+def _chunk_ids(reader):
+    return [np.asarray(chunk.id).tolist() for chunk in reader]
+
+
+def _reference_chunk_ids(url):
+    from petastorm_tpu import make_tensor_reader
+    with make_tensor_reader(url, **DET_KW) as reader:
+        return [chunk.id.tolist() for chunk in reader]
+
+
+def _spawn_worker(url, bind, await_cursor=False, faults=None):
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env['PYTHONPATH'] = repo_root + os.pathsep + env.get('PYTHONPATH', '')
+    env.pop('PETASTORM_TPU_FAULTS', None)
+    if faults:
+        env['PETASTORM_TPU_FAULTS'] = faults
+    worker = os.path.join(os.path.dirname(__file__),
+                          'fleet_server_worker.py')
+    cmd = [sys.executable, worker, url, bind]
+    if await_cursor:
+        cmd.append('--await-cursor')
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True, env=env)
+    line = proc.stdout.readline()
+    assert line, 'fleet server worker died before announcing endpoints'
+    return proc, json.loads(line)
+
+
+# ---------------------------------------------------------------------------
+# leases + graceful drain (in-process)
+# ---------------------------------------------------------------------------
+
+def test_lease_heartbeats_surface_in_diagnostics(fleet_dataset):
+    # Endless stream: the lease plane is observed mid-serve (a finite
+    # 2MB stream can be fully TCP-buffered and ENDed in one tick, and
+    # an ENDed server's lease is deliberately hidden from diagnostics).
+    kwargs = dict(DET_KW, num_epochs=None)
+    with serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*', lease_s=0.5,
+                       **kwargs) as server:
+        with RemoteReader(server.data_endpoint) as remote:
+            next(remote)
+            deadline = time.monotonic() + 10
+            while not remote.diagnostics['leases']:
+                assert time.monotonic() < deadline, 'no heartbeat arrived'
+                next(remote)
+                time.sleep(0.05)    # don't outrun the 0.17s heartbeat
+            leases = remote.diagnostics['leases']
+            (info,) = leases.values()
+            assert info['state'] == 'serving' and not info['expired']
+            # The stats rpc exposes the server-side control-plane view;
+            # poll-until: the background attach may still be in flight.
+            deadline = time.monotonic() + 15
+            while True:
+                stats = remote._one_shot_rpc(remote._rpc_endpoints[0],
+                                             {'cmd': 'stats'})
+                if stats.get('consumers', 0) >= 1:
+                    break
+                assert time.monotonic() < deadline, 'attach never landed'
+                time.sleep(0.1)
+            assert stats['state'] == 'serving'
+            assert stats['lease_s'] == 0.5
+            # Endless stream: the client just walks away (supported).
+
+
+def test_drain_rpc_loses_zero_chunks(fleet_dataset):
+    """Graceful drain mid-epoch: the in-flight chunk completes, the END
+    advertises the exact served count, the sole consumer's accounting
+    verifies served == delivered, and the drain reply carries the final
+    stream cursor."""
+    with serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*', sndhwm=1,
+                       **DET_KW) as server:
+        with RemoteReader(server.data_endpoint, rcvhwm=1) as remote:
+            got = [np.asarray(next(remote).id).tolist() for _ in range(3)]
+            reply = remote._one_shot_rpc(remote._rpc_endpoints[0],
+                                         {'cmd': 'drain'})
+            assert reply['drained'] and reply['state'] == 'drained'
+            assert reply['cursor'] is not None
+            assert reply['cursor']['mode'] == 'deterministic'
+            # The stream ends CLEANLY (exact end accounting, no error),
+            # delivering every chunk the server counted served.
+            got += _chunk_ids(remote)
+        assert server.state == 'drained'
+        assert len(got) == server.served_chunks, (
+            'graceful drain lost chunks: served {} != delivered {}'.format(
+                server.served_chunks, len(got)))
+        # The drain cursor equals the consumer's own frontier: either side
+        # can hand the stream to a replacement.
+        assert reply['cursor']['pos'] == remote.det_cursor()['pos']
+
+
+def test_drain_then_reconnect_stream_identical(fleet_dataset):
+    """Drain-then-reconnect: consume part of the stream, drain the server
+    (zero loss), bring up an ``await_cursor`` replacement, re-attach with
+    the consumer's cursor — the concatenated stream equals an
+    uninterrupted run's chunk-for-chunk."""
+    reference = _reference_chunk_ids(fleet_dataset)
+
+    with serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*', sndhwm=1,
+                       **DET_KW) as server:
+        with RemoteReader(server.data_endpoint, rcvhwm=1) as remote:
+            head = [np.asarray(next(remote).id).tolist() for _ in range(3)]
+            assert server.drain(timeout_s=30)
+            head += _chunk_ids(remote)      # clean end, zero loss
+            cursor = remote.det_cursor()
+    assert cursor is not None and cursor['pos'] == len(head)
+
+    with serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*',
+                       await_cursor=True, **DET_KW) as replacement:
+        assert replacement.state == 'awaiting-cursor'
+        # admission=False: no background attach racing the explicit
+        # cursor handoff (a fresh consumer has no frontier of its own).
+        remote2 = RemoteReader(replacement.data_endpoint, admission=False)
+        with remote2:
+            reply = remote2.reconnect(cursor=cursor)
+            assert reply is not None and reply['resume'] == 'cursor'
+            tail = _chunk_ids(remote2)
+    assert head + tail == reference, (
+        'drain-then-reconnect diverged from the uninterrupted stream')
+
+
+# ---------------------------------------------------------------------------
+# admission control + credit flow control (in-process)
+# ---------------------------------------------------------------------------
+
+def test_admission_rejection_raises_typed_error(fleet_dataset):
+    from petastorm_tpu.errors import ServerOverloaded
+    from petastorm_tpu import metrics as metrics_mod
+
+    rejected = metrics_mod.counter(
+        'pst_consumers_rejected_total', '', labelnames=('reason',))
+    before = rejected.labels('overloaded').value
+    with serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*', max_consumers=1,
+                       **DET_KW) as server:
+        # shared_stream: the refused consumer may have stolen a few
+        # fair-queued chunks before its refusal landed — the admitted
+        # consumer must not gate on exact sole-consumer accounting.
+        with RemoteReader(server.data_endpoint, shared_stream=True,
+                          end_grace_s=1.0) as first:
+            # Poll-until: the first consumer's background attach must own
+            # the one admission slot before the second consumer tries.
+            deadline = time.monotonic() + 20
+            while first.diagnostics['attach'].get(
+                    first._rpc_endpoints[0]) != 'attached':
+                assert time.monotonic() < deadline, 'attach never landed'
+                time.sleep(0.05)
+            second = RemoteReader(server.data_endpoint)
+            with pytest.raises(ServerOverloaded) as exc_info:
+                # The refusal lands via the control thread; iteration
+                # surfaces it as the typed error instead of consuming.
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    next(second)
+                raise AssertionError('refusal never surfaced')
+            assert exc_info.value.reason == 'overloaded'
+            second.join()
+            # The admitted consumer is unaffected.
+            assert _chunk_ids(first)
+    assert rejected.labels('overloaded').value > before
+
+
+def test_credit_flow_control_completes_stream(fleet_dataset):
+    """flow_control=N: the consumer grants N initial credits at attach and
+    replenishes as chunks arrive; the server's gated stream still
+    completes exactly. (The gate itself is observable in stats.)"""
+    with serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*',
+                       **DET_KW) as server:
+        with RemoteReader(server.data_endpoint, flow_control=4) as remote:
+            ids = _chunk_ids(remote)
+            stats = remote._one_shot_rpc(remote._rpc_endpoints[0],
+                                         {'cmd': 'stats'})
+    assert sorted(i for chunk in ids for i in chunk) == list(range(ROWS))
+    # Credit mode armed server-side (not disarmed by a credit-blind peer).
+    assert stats['credit'] is not None
+
+
+def test_latecomer_on_draining_server_gets_typed_refusal(fleet_dataset):
+    """A consumer that joins DURING a graceful drain is refused (it was
+    never admitted; the drain's tail belongs to the admitted consumers)
+    and surfaces the typed error with reason 'draining'."""
+    from petastorm_tpu.errors import ServerOverloaded
+
+    kwargs = dict(DET_KW, num_epochs=None)
+    with serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*', sndhwm=1,
+                       **kwargs) as server:
+        with RemoteReader(server.data_endpoint, rcvhwm=1,
+                          shared_stream=True, end_grace_s=1.0) as admitted:
+            next(admitted)
+            server.drain(timeout_s=0)   # non-blocking: mark draining
+            latecomer = RemoteReader(server.data_endpoint)
+            with pytest.raises(ServerOverloaded) as exc_info:
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    next(latecomer)
+                raise AssertionError('refusal never surfaced')
+            assert exc_info.value.reason in ('draining', 'drained')
+            latecomer.join()
+
+
+def test_subset_refusal_excludes_data_socket():
+    """Unit: a refusal on ONE of several endpoints excludes it (attach
+    status + data-socket disconnect) instead of raising — the survivors
+    keep feeding."""
+    remote = RemoteReader(['tcp://127.0.0.1:18901', 'tcp://127.0.0.1:18904'],
+                          admission=False)
+    try:
+        with remote._acct_lock:
+            remote._admission_refused[remote._rpc_endpoints[1]] = 'draining'
+        remote._enforce_admission()    # must NOT raise: one survivor left
+        assert remote.diagnostics['attach'][
+            remote._rpc_endpoints[1]] == 'excluded'
+        # An explicit reconnect un-excludes (re-dials data + re-attaches).
+        remote.reconnect(remote._rpc_endpoints[1], cursor=None)
+        assert remote.diagnostics['attach'][
+            remote._rpc_endpoints[1]] != 'excluded'
+    finally:
+        remote.stop()
+        remote.join()
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGKILL -> reconnect-with-resume, digest-identical
+# ---------------------------------------------------------------------------
+
+def test_sigkill_reconnect_cursor_handoff_digest_identical(
+        fleet_dataset, tmp_path):
+    """THE acceptance drill: two deterministic servers; SIGKILL one
+    mid-epoch; its sole consumer's control thread re-attaches to the
+    ``--await-cursor`` replacement on the same endpoint, shipping its
+    DeterministicCursor frontier; the replacement rebuilds the stream
+    from the cursor and ``replay --diff-ledgers`` proves the consumer's
+    batch stream is bit-identical to an uninterrupted run's. The second
+    server keeps serving its own consumer throughout."""
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu import metrics as metrics_mod
+    from petastorm_tpu.jax_loader import JaxLoader
+    from petastorm_tpu.tools.replay import main as replay_main
+
+    reconnects = metrics_mod.counter(
+        'pst_reconnects_total', '', labelnames=('outcome',))
+    resumed_before = reconnects.labels('resumed').value
+
+    # Reference: uninterrupted ledger over the SAME deterministic config
+    # (local reader — remote sole-consumer streams must match it).
+    def ledger_digests(ledger_dir, reader, stop_after=None, resume=None):
+        digests = []
+        with JaxLoader(reader, ROWS_PER_GROUP, last_batch='drop',
+                       prefetch=2, lineage=str(ledger_dir)) as loader:
+            for _ in loader:
+                record = loader.last_batch_provenance
+                assert record is not None
+                digests.append(record['digest'])
+                if stop_after and len(digests) >= stop_after:
+                    break
+        return digests
+
+    full_dir, faulted_dir = tmp_path / 'full', tmp_path / 'faulted'
+    full = ledger_digests(full_dir,
+                          make_tensor_reader(fleet_dataset, **DET_KW))
+    assert len(full) == ROWS // ROWS_PER_GROUP
+
+    procs = []
+    try:
+        proc_a, info_a = _spawn_worker(fleet_dataset, 'tcp://127.0.0.1:*')
+        procs.append(proc_a)
+        # The second deterministic server of the fleet, in-process.
+        with serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*',
+                           **DET_KW) as server_b:
+            remote_a = RemoteReader(info_a['data_endpoint'], rcvhwm=1,
+                                    end_grace_s=10.0)
+            remote_b = RemoteReader(server_b.data_endpoint)
+            faulted = []
+            with remote_a, remote_b:
+                with JaxLoader(remote_a, ROWS_PER_GROUP, last_batch='drop',
+                               prefetch=2,
+                               lineage=str(faulted_dir)) as loader:
+                    it = iter(loader)
+                    for _ in range(5):
+                        next(it)
+                        record = loader.last_batch_provenance
+                        faulted.append(record['digest'])
+                    # Provably mid-epoch (rcvhwm=1 bounds in-flight):
+                    # preempt the decode host.
+                    proc_a.kill()
+                    proc_a.wait()
+                    # Replacement on the SAME endpoint, reader build
+                    # deferred until the consumer's cursor arrives.
+                    proc_a2, info_a2 = _spawn_worker(
+                        fleet_dataset, info_a['data_endpoint'],
+                        await_cursor=True)
+                    procs.append(proc_a2)
+                    assert info_a2['awaiting']
+                    # NO manual reconnect: the consumer's control thread
+                    # re-attaches on its own, shipping det_cursor().
+                    for batch in it:
+                        faulted.append(
+                            loader.last_batch_provenance['digest'])
+                # The fleet's second server was untouched throughout.
+                ids_b = _chunk_ids(remote_b)
+        assert sorted(i for c in ids_b for i in c) == list(range(ROWS))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    assert len(faulted) == len(full)
+    assert faulted == full, (
+        'reconnected stream diverged from the uninterrupted run')
+    # And the ledgers agree end-to-end through the CLI gate.
+    assert replay_main(['--diff-ledgers', str(full_dir),
+                        str(faulted_dir)]) == 0
+    assert reconnects.labels('resumed').value > resumed_before
+
+
+# ---------------------------------------------------------------------------
+# chaos: rpc blackhole -> circuit breaker open -> half-open recovery
+# ---------------------------------------------------------------------------
+
+def test_blackhole_trips_circuit_breaker_then_recovers(fleet_dataset):
+    """A blackholed rpc plane (requests swallowed, no replies) costs the
+    whole retry budget exactly `threshold` times; after that the breaker
+    answers instantly from the open state instead of hanging the caller,
+    and the half-open probe closes it once the partition heals. The DATA
+    plane flows throughout — the consumer is never hung (SIGALRM guard
+    is the hang assertion)."""
+    # max=9: three whole budgets (3 attempts each) are swallowed, then
+    # the partition "heals" and the rpc thread answers again.
+    proc, info = _spawn_worker(fleet_dataset, 'tcp://127.0.0.1:*',
+                               faults='rpc-blackhole:max=9')
+    try:
+        remote = RemoteReader(info['data_endpoint'], admission=False,
+                              end_grace_s=10.0)
+        remote._breaker_reset_s = 1.0   # test-speed half-open cooldown
+        endpoint = remote._rpc_endpoints[0]
+        with remote:
+            for _ in range(3):
+                assert remote._one_shot_rpc(
+                    endpoint, {'cmd': 'stats'}, timeout_ms=300) is None
+            assert remote.diagnostics['circuit_breakers'][endpoint] == 'open'
+            t0 = time.monotonic()
+            assert remote._one_shot_rpc(
+                endpoint, {'cmd': 'stats'}, timeout_ms=300) is None
+            assert time.monotonic() - t0 < 0.15, (
+                'open circuit must answer instantly, not re-pay the '
+                'retry budget')
+            time.sleep(1.1)             # open -> half-open
+            reply = remote._one_shot_rpc(endpoint, {'cmd': 'stats'},
+                                         timeout_ms=3000)
+            assert reply is not None and reply['sent'] >= 0, (
+                'half-open probe should reach the healed server')
+            assert remote.diagnostics['circuit_breakers'][endpoint] \
+                == 'closed'
+            # The data plane was never the partition's victim.
+            ids = _chunk_ids(remote)
+        assert sorted(i for c in ids for i in c) == list(range(ROWS))
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_hedged_rpc_survives_one_blackholed_server(fleet_dataset,
+                                                   monkeypatch):
+    """Server-agnostic metadata rpcs hedge: with the first server's rpc
+    swallowed (one blackhole fire), the schema still arrives — from the
+    hedge to the second server — within one hedge delay, and the hedge
+    counter ticks."""
+    from petastorm_tpu import faults
+    from petastorm_tpu import metrics as metrics_mod
+
+    hedged = metrics_mod.counter('pst_hedged_rpcs_total', '')
+    before = hedged.value
+    s1 = serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*', **DET_KW)
+    s2 = serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*', **DET_KW)
+    with s1, s2:
+        with RemoteReader([s1.data_endpoint, s2.data_endpoint],
+                          admission=False, shared_stream=True,
+                          end_grace_s=1.0) as remote:
+            monkeypatch.setenv(faults.ENV_VAR, 'rpc-blackhole:max=1')
+            reply = remote._hedged_rpc({'cmd': 'schema'}, timeout_ms=10000,
+                                       hedge_after_ms=150)
+            monkeypatch.delenv(faults.ENV_VAR)
+            assert reply is not None and reply.get('schema') is not None
+            assert hedged.value > before
+            _chunk_ids(remote)
+
+
+# ---------------------------------------------------------------------------
+# lease expiry accounting (sole consumer, no replacement)
+# ---------------------------------------------------------------------------
+
+def test_lease_expiry_counts_and_reconnect_window_raises(fleet_dataset):
+    """A SIGKILLed server's lease expires client-side (counted), and with
+    a short reconnect window and no replacement the consumer RAISES a
+    pointed error instead of polling forever."""
+    from petastorm_tpu import metrics as metrics_mod
+
+    expiries = metrics_mod.counter('pst_server_lease_expiries_total', '')
+    before = expiries.value
+    proc, info = _spawn_worker(fleet_dataset, 'tcp://127.0.0.1:*')
+    try:
+        with RemoteReader(info['data_endpoint'], rcvhwm=1,
+                          reconnect_s=2.0, admission=False) as remote:
+            # Lease must be known before the kill (heartbeats every
+            # lease_s/3 ~ 0.7s on the worker's 2s lease); consuming is
+            # what pumps the control socket, so consume-until.
+            deadline = time.monotonic() + 15
+            while not remote.diagnostics['leases']:
+                assert time.monotonic() < deadline, 'no heartbeat seen'
+                next(remote)
+                time.sleep(0.05)
+            proc.kill()
+            proc.wait()
+            with pytest.raises(RuntimeError,
+                               match='reconnect window'):
+                _chunk_ids(remote)
+        assert expiries.value > before
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
