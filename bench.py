@@ -463,11 +463,38 @@ def _rss_mb():
             pages = int(f.read().split()[1])
         return round(pages * os.sysconf('SC_PAGE_SIZE') / 1e6, 1)
     except (OSError, ValueError, IndexError):  # pragma: no cover - non-linux
-        import resource
-        maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-        # ru_maxrss is KB on Linux but BYTES on macOS.
-        divisor = 1024.0 * 1024.0 if sys.platform == 'darwin' else 1024.0
-        return round(maxrss / divisor, 1)
+        return _peak_rss_mb()
+
+
+def _peak_rss_mb():
+    """Lifetime PEAK resident-set size in MB (``ru_maxrss``): the number a
+    memory-regression gate wants — the current RSS at sample time misses
+    every transient high-water mark between samples. The Linux-KB vs
+    macOS-bytes quirk lives in one place (membudget)."""
+    from petastorm_tpu import membudget
+    # Decimal MB to match _rss_mb in the same record (binary MB would
+    # read ~4.9% low next to it — peak must never print below current).
+    return round(membudget.peak_rss_bytes() / 1e6, 1)
+
+
+def _mem_governor_summary():
+    """Compact memory-governor block for a stage profile, or None while
+    unarmed: budget + provenance, ladder peaks, per-action degrade counts,
+    breaches. Future BENCH rounds gate host-memory regressions on this
+    next to rss_peak_mb."""
+    from petastorm_tpu import membudget
+    governor = membudget.get_governor()
+    if not governor.armed:
+        return None
+    stats = governor.stats()
+    return {'budget_bytes': stats['budget_bytes'],
+            'budget_source': stats['budget_source'],
+            'state': stats['state'],
+            'peak_state': stats['peak_state'],
+            'peak_frac': stats['peak_frac'],
+            'accounted_bytes': stats['accounted_bytes'],
+            'degrade_actions': stats['degrade_actions'],
+            'breaches': stats['breaches']}
 
 
 def _cache_tier_sweep(url, workers, batch, tiers):
@@ -549,7 +576,8 @@ def _measure_cache_tier(url, workers, batch, warm, measure, kwargs, out, tier):
             record = {
                 'img_per_sec': round(
                     batch * measure / (time.perf_counter() - t0), 2),
-                'rss_mb': _rss_mb()}
+                'rss_mb': _rss_mb(),
+                'rss_peak_mb': _peak_rss_mb()}
             if store is not None:
                 st = store.stats()
                 record['chunk_store'] = {
@@ -713,6 +741,11 @@ def _child_pipeline(url, workers, cache_tiers=None):
     profile['wall_s'] = round(wall_s, 4)
     profile.update(_staging_counters(stats))
     profile.update(_robustness_counters(stats))
+    profile['rss_mb'] = _rss_mb()
+    profile['rss_peak_mb'] = _peak_rss_mb()
+    mem_rec = _mem_governor_summary()
+    if mem_rec is not None:
+        profile['mem'] = mem_rec
     profile['metrics'] = _metrics_snapshot()
     lineage_rec = _lineage_summary(loader, ledger_dir)
     if lineage_rec is not None:
@@ -1203,6 +1236,11 @@ def _child_imagenet(url, workers):
     stage_profile['wall_s'] = round(elapsed, 4)
     stage_profile.update(_staging_counters(stats))
     stage_profile.update(_robustness_counters(stats))
+    stage_profile['rss_mb'] = _rss_mb()
+    stage_profile['rss_peak_mb'] = _peak_rss_mb()
+    mem_rec = _mem_governor_summary()
+    if mem_rec is not None:
+        stage_profile['mem'] = mem_rec
     stage_profile['metrics'] = _metrics_snapshot()
     lineage_rec = _lineage_summary(loader, ledger_dir)
     if lineage_rec is not None:

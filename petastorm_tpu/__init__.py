@@ -20,9 +20,11 @@ from petastorm_tpu.data_service import (DataServer, RemoteReader,  # noqa: F401
                                         load_server_snapshot, serve_dataset,
                                         verify_shared_stream_complete)
 from petastorm_tpu.device_cache import DeviceDatasetCache  # noqa: F401
-from petastorm_tpu.errors import (PipelineStallError,  # noqa: F401
+from petastorm_tpu.errors import (HostMemoryExceededError,  # noqa: F401
+                                  PipelineStallError,
                                   RowGroupQuarantinedError, WorkerLostError)
 from petastorm_tpu.flight_recorder import FlightRecorder  # noqa: F401
+from petastorm_tpu.membudget import MemoryGovernor  # noqa: F401
 from petastorm_tpu.job_checkpoint import JobCheckpointer  # noqa: F401
 from petastorm_tpu.lineage import (LineageTracker,  # noqa: F401
                                    replay_record, verify_record)

@@ -17,6 +17,11 @@ machine-checks them:
   reaching ``@deterministic_safe`` code.
 * :mod:`~petastorm_tpu.analysis.registry_sync` — env-var, fault-site and
   pytest-marker registries synced with source, both directions.
+* :mod:`~petastorm_tpu.analysis.bounded_queues` — every ``queue.Queue``
+  construction carries an explicit ``maxsize`` (or a reasoned
+  suppression): unbounded cross-thread queues are the OOM killer's
+  favorite food, and the memory governor can only account what is
+  bounded.
 * :mod:`~petastorm_tpu.analysis.registry` — the canonical leak-guard
   table shared with ``tests/conftest.py``.
 * :mod:`~petastorm_tpu.analysis.sanitize` — the opt-in
@@ -36,7 +41,8 @@ from petastorm_tpu.analysis.sanitize import (LockOrderRecorder,  # noqa: F401
                                              sanitize_active, tracked_lock)
 
 #: check-id prefix -> checker module; the driver runs these in order.
-CHECKS = ('lock-order', 'threads', 'determinism', 'registry')
+CHECKS = ('lock-order', 'threads', 'determinism', 'registry',
+          'bounded-queues')
 
 
 def run_checks(roots, checks=None):
@@ -47,8 +53,8 @@ def run_checks(roots, checks=None):
     and the runtime recorder). ``checks`` is an iterable of entries from
     :data:`CHECKS`; None runs everything.
     """
-    from petastorm_tpu.analysis import (determinism_taint, lock_order,
-                                        registry_sync, threads)
+    from petastorm_tpu.analysis import (bounded_queues, determinism_taint,
+                                        lock_order, registry_sync, threads)
     selected = set(CHECKS if checks is None else checks)
     unknown = selected - set(CHECKS)
     if unknown:
@@ -75,4 +81,7 @@ def run_checks(roots, checks=None):
         checks_run.update((registry_sync.CHECK_ENV,
                            registry_sync.CHECK_FAULT,
                            registry_sync.CHECK_MARKER))
+    if 'bounded-queues' in selected:
+        findings.extend(bounded_queues.check(project))
+        checks_run.add(bounded_queues.CHECK)
     return apply_suppressions(project, findings, checks_run), lock_edges

@@ -74,6 +74,14 @@ THREAD_GUARDS = (
         'from any test, so the sweep runs on every test.',
         marker=None, action='fail'),
     ThreadGuard(
+        'pst-mem-governor', 'petastorm_tpu.membudget',
+        'Refcount-armed process-wide sampler: every pipeline built while '
+        'PETASTORM_TPU_HOST_MEM_BUDGET is set takes an arm reference and '
+        'releases it at teardown; the last release joins the thread. '
+        'Armable by env from any factory, so the sweep runs on every '
+        'test — a leak means an owner skipped its release.',
+        marker=None, action='fail'),
+    ThreadGuard(
         'pst-lineage-writer', 'petastorm_tpu.lineage',
         'LineageLedger.close() joins the write-behind drain; a leak holds '
         'the ledger file open.', marker='lineage', action='fail'),

@@ -296,11 +296,17 @@ class AutoTuner(object):
     :param classify_fn: ``(deltas, gauges, dt, config) -> (label, detail)``.
     :param watchdog_active_fn: ``() -> bool``; True pauses tuning for the
         tick (an active stall episode — recovery owns the pipeline).
+    :param memory_state_fn: ``() -> int`` pressure-ladder level of the
+        host memory governor (``membudget.get_governor().pressure_level``;
+        0 while unarmed). At advisory or worse the tuner stops growing and
+        instead takes one ``mem-shrink`` step per cooldown — prefetch,
+        in-flight window, arena depth, workers, watermark all step down —
+        releasing host memory ahead of the governor's harder rungs.
     """
 
     def __init__(self, telemetry_fn, knobs, config=None, tracer=None,
                  classify_fn=classify_loader, watchdog_active_fn=None,
-                 name='pst-autotune'):
+                 memory_state_fn=None, name='pst-autotune'):
         self._telemetry_fn = telemetry_fn
         self.knobs = dict(knobs)
         self.config = config if config is not None else AutotuneConfig()
@@ -310,6 +316,8 @@ class AutoTuner(object):
         self._tracer = tracer
         self._classify_fn = classify_fn
         self._watchdog_active_fn = watchdog_active_fn
+        self._memory_state_fn = memory_state_fn
+        self.mem_shrinks = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=name)
@@ -418,6 +426,31 @@ class AutoTuner(object):
                               'detail': 'watchdog stall episode active'}, now)
             return None
         self._paused_streak = False
+        if self._memory_state_fn is not None and self._mem_pressure():
+            # Advisory-or-worse memory pressure: the governor's ladder
+            # owns the pipeline's direction. Growing any knob would add
+            # bytes against the budget, and the throughput guard would
+            # "revert" memory relief the moment rate dipped — so both are
+            # suspended, and one additive shrink step runs per cooldown
+            # instead (the same AIMD step _shrink uses, applied for bytes
+            # rather than for a consumer-bound classification).
+            self._pending = None
+            self._streak = (None, 0)
+            if self._cooldown > 0:
+                self._cooldown -= 1
+                return None
+            changes = self._shrink()
+            if not changes:
+                return None   # every knob already at its floor
+            self.mem_shrinks += 1
+            decision = {'action': 'mem-shrink', 'class': 'memory-pressure',
+                        'changes': changes,
+                        'detail': 'host memory governor at advisory or '
+                                  'worse: biasing every knob down one step'}
+            self._record(decision, now)
+            self._snapshot_trajectory(now)
+            self._cooldown = self.config.cooldown
+            return decision
         if prev is None:
             self._snapshot_trajectory(now)
             return None
@@ -501,6 +534,13 @@ class AutoTuner(object):
         self._streak = (label, 0)
         return decision
 
+    def _mem_pressure(self):
+        """True at advisory (level 1) or worse; a dying probe reads 0."""
+        try:
+            return int(self._memory_state_fn()) >= 1
+        except Exception:  # noqa: BLE001 - a dying probe must not kill the tuner
+            return False
+
     def _grow(self, label):
         for name, step in _GROW_ACTIONS.get(label, ()):
             knob = self.knobs.get(name)
@@ -579,6 +619,7 @@ class AutoTuner(object):
             return {'ticks': self.ticks,
                     'paused_ticks': self.paused_ticks,
                     'reverts': self.reverts,
+                    'mem_shrinks': self.mem_shrinks,
                     'last_class': self.last_class,
                     'knobs': knobs,
                     'decisions': list(self._log),

@@ -14,13 +14,13 @@ LRU eviction.
 import hashlib
 import os
 import pickle
-import sys
 import tempfile
 import threading
 
 import pyarrow as pa
 
 from petastorm_tpu.errors import CorruptChunkError
+from petastorm_tpu.membudget import approx_nbytes
 
 
 class CacheBase(object):
@@ -79,20 +79,11 @@ class MemoryCache(CacheBase):
 
     @staticmethod
     def _nbytes(value):
-        if hasattr(value, 'nbytes'):
-            return int(value.nbytes)
-        if isinstance(value, dict):
-            # Keys count too: on wide schemas (hundreds of string keys per
-            # cached chunk dict) ignoring them systematically under-
-            # estimates the byte cap.
-            return sum(MemoryCache._nbytes(k) + MemoryCache._nbytes(v)
-                       for k, v in value.items())
-        if isinstance(value, (list, tuple)):
-            return sum(MemoryCache._nbytes(v) for v in value)
-        try:
-            return sys.getsizeof(value)
-        except TypeError:  # pragma: no cover
-            return 1024
+        # One definition of "how big is a cached value" for the whole
+        # package (module-scope import: this runs per cached chunk): the
+        # governor accounts the very same values this cap gates, and two
+        # drifting estimators would let them disagree.
+        return approx_nbytes(value)
 
     def get(self, key, fill_cache_func):
         # Single-flight per key: the ventilator dispatches the SAME row
@@ -148,6 +139,28 @@ class MemoryCache(CacheBase):
                     self._inflight.pop(key, None)
                 event.set()
         return value
+
+    @property
+    def nbytes(self):
+        """Current resident bytes — the memory governor's accounting hook
+        (``membudget.py``: this cache registers as pool ``memory-cache``)."""
+        with self._lock:
+            return self._total
+
+    def evict(self, keep_frac=0.5):
+        """Drop LRU entries until at most ``keep_frac`` of the current
+        bytes remain (the governor's *degrade* hook: repeated calls keep
+        halving, so a rung that persists converges on empty). Returns the
+        bytes freed. Evicted entries simply refill on their next miss —
+        slower, never wrong."""
+        freed = 0
+        with self._lock:
+            target = self._total * float(keep_frac)
+            while self._entries and self._total > target:
+                _, (_, nbytes) = self._entries.popitem(last=False)
+                self._total -= nbytes
+                freed += nbytes
+        return freed
 
     def cleanup(self):
         with self._lock:

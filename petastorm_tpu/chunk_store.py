@@ -384,9 +384,11 @@ class DecodedChunkStore(CacheBase):
         # enough. A quarantine drops the digest again.
         self._validated = set()
         self._writeq = None                # lazily started with the thread
+        self._writeq_bytes = 0             # decoded bytes pinned by the queue
         self._writer = None
         self._stopping = False
         self._throttled = False
+        self._spill_paused = False         # memory governor's advisory hook
         self._dir_bytes = None   # running size estimate; None = needs a scan
         # Registry mirror (petastorm_tpu.metrics): the same counters as
         # scrapable instruments — one registry.collect() then covers the
@@ -594,18 +596,42 @@ class DecodedChunkStore(CacheBase):
         with self._lock:
             if self._stopping:
                 return
+            if self._spill_paused:
+                # Advisory rung: refuse new spill work instead of pinning
+                # decoded bytes in the queue — counted, never silent.
+                self.write_skipped += 1
+                self._m['write_skipped'].inc()
+                return
             if self._writer is None:
                 self._writeq = queue.Queue(maxsize=self._queue_depth)
                 self._writer = threading.Thread(
                     target=self._writer_loop, daemon=True,
                     name='pst-chunk-store-writer')
                 self._writer.start()
+            nbytes = sum(int(getattr(arr, 'nbytes', 0)) for arr in cols.values())
             try:
-                self._writeq.put_nowait((key, cols))
+                self._writeq.put_nowait((key, cols, nbytes))
+                self._writeq_bytes += nbytes
             except queue.Full:
                 # NEVER block decode on NVMe: drop, self-heals next epoch.
                 self.write_skipped += 1
                 self._m['write_skipped'].inc()
+
+    def set_spill_paused(self, paused):
+        """Memory-governor advisory hook: while True, new spill work is
+        REFUSED at enqueue (counted as ``write_skipped``, self-healing on
+        the chunk's next-epoch miss) and the already-queued backlog keeps
+        draining to NVMe. Refusing-at-enqueue rather than holding the
+        writer matters: a held writer would PIN a full queue of decoded
+        chunks for the whole advisory episode — the relief rung would
+        itself sustain the pressure (and could latch the ladder at
+        advisory forever on a tight budget). Released the moment the
+        ladder leaves the advisory band."""
+        self._spill_paused = bool(paused)
+
+    @property
+    def spill_paused(self):
+        return self._spill_paused
 
     def set_writer_throttled(self, throttled):
         """Autotune hookup: while True the write-behind writer is PACED —
@@ -635,11 +661,13 @@ class DecodedChunkStore(CacheBase):
                        and waited < self._throttle_delay_s):
                     time.sleep(0.005)
                     waited += 0.005
-                key, cols = item
+                key, cols, nbytes = item
                 try:
                     self._write_entry(key, cols)
                 except Exception:  # noqa: BLE001 - spill must never kill the pipe
                     logger.exception('chunk store write-behind failed for %r', key)
+                with self._lock:
+                    self._writeq_bytes = max(0, self._writeq_bytes - nbytes)
             finally:
                 self._writeq.task_done()
 
@@ -732,6 +760,35 @@ class DecodedChunkStore(CacheBase):
         with self._lock:
             self._dir_bytes = total
 
+    # -- memory-governor accounting (membudget.py) -------------------------
+
+    def governed_nbytes(self):
+        """Bytes this store currently pins in host memory: decoded chunks
+        parked in the write-behind queue plus the resident open-entry
+        mmaps (ACCESS_COPY mappings occupy page cache / private pages for
+        every byte a hit has touched — the upper bound is the mapped
+        size, which is what a budget must assume)."""
+        with self._lock:
+            mapped = sum(entry.nbytes for entry in self._entries.values())
+            return self._writeq_bytes + mapped
+
+    def close_lru_mmaps(self, keep_frac=0.5):
+        """Drop the least-recently-used open entries until at most
+        ``keep_frac`` of them remain (the governor's *degrade* hook). The
+        mappings are dropped, not closed — live views keep their pages
+        alive until the consumer releases them (the same rule the
+        ``max_open_entries`` LRU follows) — so this is safe at any time;
+        a dropped entry just re-mmaps (without re-CRC: the per-process
+        validated set survives) on its next hit. Returns the mapped bytes
+        released from the accounting."""
+        freed = 0
+        with self._lock:
+            keep = int(len(self._entries) * float(keep_frac))
+            while len(self._entries) > keep:
+                _, entry = self._entries.popitem(last=False)
+                freed += entry.nbytes
+        return freed
+
     def flush(self, timeout_s=30.0):
         """Block until the write-behind queue drains (tests / epoch-end
         barriers). Returns False on timeout — e.g. a throttled writer."""
@@ -765,7 +822,9 @@ class DecodedChunkStore(CacheBase):
                     'readaheads': self.readaheads,
                     'unstorable': self.unstorable,
                     'pending_writes': (q.unfinished_tasks if q is not None else 0),
+                    'pending_write_bytes': self._writeq_bytes,
                     'writer_throttled': self._throttled,
+                    'spill_paused': self._spill_paused,
                     'open_entries': len(self._entries)}
 
     def close(self):

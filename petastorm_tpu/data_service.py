@@ -398,6 +398,16 @@ class DataServer(object):
             'pst_consumers_rejected_total',
             'Consumer attach requests a data-service server refused',
             labelnames=('reason',))
+        # Memory governor (petastorm_tpu.membudget): the snapshot/replay
+        # ring pins whole serialized chunk frames in host memory — it
+        # registers for byte accounting, and the ladder's *shed* rung
+        # makes this server refuse NEW consumers with the typed admission
+        # refusal below (existing consumers keep draining: shedding load
+        # must not break streams that are already moving bytes OUT).
+        from petastorm_tpu import membudget
+        self._mem_shed = False
+        self._mem_handle = membudget.register_pool(
+            'snapshot-ring', self._ring_nbytes, shed_fn=self._set_mem_shed)
         # Admission ledger: consumer_id -> last renew time. Entries expire
         # after 3 leases without a renew (the client control thread
         # re-attaches every lease), so a crashed consumer frees its
@@ -845,6 +855,15 @@ class DataServer(object):
                             'refused': 'overloaded',
                             'max_consumers': self._max_consumers,
                             'state': state}
+                if self._mem_shed and not known:
+                    # Memory-governor shed rung: same typed 'overloaded'
+                    # refusal consumers already failover/back off on, with
+                    # the reason naming the pressure for operators.
+                    self._m_rejected.labels('memory-pressure').inc()
+                    return {'server_id': self._server_id,
+                            'refused': 'overloaded',
+                            'reason': 'memory-pressure',
+                            'state': state}
                 credits = int(request.get('credits') or 0)
                 if known:
                     entry = self._consumers[consumer]
@@ -1019,7 +1038,19 @@ class DataServer(object):
         Returns True once done, False on timeout — serving continues."""
         return self._serving_done.wait(timeout)
 
+    def _ring_nbytes(self):
+        """Serialized chunk bytes pinned by the snapshot/replay ring — the
+        memory governor's ``snapshot-ring`` accounting hook. Iterates a
+        copy; a rare mutate-during-copy race raises and the governor
+        falls back to the previous sample."""
+        return sum(sum(len(frame) for frame in frames)
+                   for _, frames in list(self._ring))
+
+    def _set_mem_shed(self, active):
+        self._mem_shed = bool(active)
+
     def stop(self):
+        self._mem_handle.close()
         self._stop.set()
         # Stop the reader FIRST: it unblocks a serve thread parked inside
         # the reader's __next__. zmq sockets are not thread-safe, so they

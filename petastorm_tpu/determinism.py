@@ -353,6 +353,16 @@ class Resequencer(object):
                     'waiting_s': round(waiting, 3),
                     'out_of_order_total': self._out_of_order}
 
+    def buffered_nbytes(self):
+        """Estimated bytes held by chunks parked behind a sequence hole —
+        the memory governor's ``resequencer`` accounting hook
+        (``membudget.py``). The buffer is bounded by the ventilator's
+        in-flight cap, so walking it per sampler tick is cheap."""
+        from petastorm_tpu.membudget import approx_nbytes
+        with self._lock:
+            chunks = list(self._buffer.values())
+        return sum(approx_nbytes(chunk) for chunk in chunks)
+
     def reset(self):
         """Restart sequence expectations (``Reader.reset()`` pairs this
         with the ventilator's own reset)."""

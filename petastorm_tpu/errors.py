@@ -21,6 +21,12 @@ Exception hierarchy::
     │                                     did not clear it (carries the
     │                                     full diagnosis: classification,
     │                                     beat table, thread stacks)
+    ├── HostMemoryExceededError           the memory governor's pressure
+    │                                     ladder breached its budget after
+    │                                     degradation; carries the per-pool
+    │                                     byte ranking and the flight-dump
+    │                                     path (we die WITH a diagnosis,
+    │                                     before the kernel OOM killer)
     ├── CorruptChunkError                 a decoded-chunk store entry (or
     │                                     raw-layout disk-cache blob)
     │                                     failed structural/checksum
@@ -97,6 +103,27 @@ class PipelineStallError(PetastormTpuError):
     def __init__(self, message, diagnosis=None):
         super(PipelineStallError, self).__init__(message)
         self.diagnosis = diagnosis or {}
+
+
+class HostMemoryExceededError(PetastormTpuError):
+    """The host memory governor (``petastorm_tpu.membudget``) breached its
+    byte budget after walking the whole degradation ladder (advisory ->
+    degrade -> shed). Raised *instead of* letting the kernel OOM killer
+    SIGKILL the process: the message names the top byte-holding pool and
+    the flight-dump directory.
+
+    ``ranking`` is the per-pool byte ranking (``[{'pool', 'nbytes'}, ...]``,
+    biggest first); ``flight_dump`` the dump path (``None`` when even the
+    best-effort dump failed); ``budget``/``accounted`` the bytes that
+    tripped the breach."""
+
+    def __init__(self, message, budget=None, accounted=None, ranking=None,
+                 flight_dump=None):
+        super(HostMemoryExceededError, self).__init__(message)
+        self.budget = budget
+        self.accounted = accounted
+        self.ranking = list(ranking or [])
+        self.flight_dump = flight_dump
 
 
 class ServerOverloaded(PetastormTpuError):

@@ -60,6 +60,15 @@ Sites and their effects when they fire:
                      plane: the client's whole rpc retry budget goes
                      unanswered, which is what trips its circuit breaker.
                      Consumed via ``should_fire``.
+``mem-pressure``     inflate the bytes a registered memory-governor pool
+                     (``membudget.py``) reports, by ``bytes=`` (default:
+                     one whole budget — a guaranteed breach). ``match=``
+                     targets pools whose name contains the substring.
+                     Consumed via the non-consuming ``selected`` predicate
+                     per sampler tick, so the pressure *persists* — which
+                     is what lets a test park the ladder on one rung
+                     (advisory / degrade / shed / breach) deterministically
+                     without allocating a single real byte.
 ==================== ======================================================
 
 Params (all optional):
@@ -114,6 +123,7 @@ KNOWN_SITES = (
     'server-kill',
     'server-slow',
     'rpc-blackhole',
+    'mem-pressure',
 )
 
 #: Sites whose effect is a sleep rather than an error.
@@ -127,13 +137,29 @@ class FaultSpec(object):
     """Parsed configuration of one injection site."""
 
     def __init__(self, site, p=1.0, seed=0, max_fires=None, delay_s=_DEFAULT_DELAY_S,
-                 token=None):
+                 token=None, match=None, inflate_bytes=None):
         self.site = site
         self.p = float(p)
         self.seed = int(seed)
         self.max_fires = max_fires if max_fires is None else int(max_fires)
         self.delay_s = float(delay_s)
         self.token = token
+        #: Substring filter on the injection key: only keys containing it
+        #: are eligible (``mem-pressure`` targets one governor pool by
+        #: name this way; the hash-based ``p`` selection composes on top).
+        self.match = match
+        #: Byte inflation for ``mem-pressure`` (``bytes=`` in a spec);
+        #: None = the consumer's default (one whole budget). Accepts the
+        #: same ``k``/``m``/``g`` suffixes as the budget env var — an
+        #: operator who just wrote HOST_MEM_BUDGET=2g will write
+        #: bytes=512m, and the two surfaces must agree.
+        if inflate_bytes is None:
+            self.inflate_bytes = None
+        elif isinstance(inflate_bytes, str):
+            from petastorm_tpu.membudget import parse_bytes
+            self.inflate_bytes = parse_bytes(inflate_bytes)
+        else:
+            self.inflate_bytes = int(inflate_bytes)
 
     @classmethod
     def parse(cls, text):
@@ -148,7 +174,8 @@ class FaultSpec(object):
                 'otherwise inject nothing, silently'.format(
                     site, ', '.join(KNOWN_SITES)))
         renames = {'p': 'p', 'seed': 'seed', 'max': 'max_fires',
-                   'delay': 'delay_s', 'token': 'token'}
+                   'delay': 'delay_s', 'token': 'token', 'match': 'match',
+                   'bytes': 'inflate_bytes'}
         for param in parts[1:]:
             key, sep, value = param.partition('=')
             if not sep or key not in renames:
@@ -161,7 +188,14 @@ class FaultSpec(object):
     def __repr__(self):
         return ('FaultSpec({s.site!r}, p={s.p}, seed={s.seed}, '
                 'max_fires={s.max_fires}, delay_s={s.delay_s}, '
-                'token={s.token!r})'.format(s=self))
+                'token={s.token!r}, match={s.match!r}, '
+                'inflate_bytes={s.inflate_bytes})'.format(s=self))
+
+    def key_matches(self, key):
+        """The ``match=`` substring filter (True when unset)."""
+        if self.match is None:
+            return True
+        return key is not None and self.match in str(key)
 
 
 def _key_selected(seed, site, key, p):
@@ -205,6 +239,8 @@ class FaultInjector(object):
         spec = self._specs.get(site)
         if spec is None:
             return False
+        if not spec.key_matches(key):
+            return False
         return _key_selected(spec.seed, site, key, spec.p)
 
     def _claim_token(self, spec):
@@ -223,6 +259,8 @@ class FaultInjector(object):
         """Decide-and-consume: True when ``site`` fires for this call."""
         spec = self._specs.get(site)
         if spec is None:
+            return False
+        if not spec.key_matches(key):
             return False
         with self._lock:
             if spec.max_fires is not None \

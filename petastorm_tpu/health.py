@@ -52,6 +52,8 @@ import time
 import traceback
 
 from petastorm_tpu.errors import PipelineStallError
+from petastorm_tpu.membudget import (STATE_BREACH, STATE_DEGRADE,
+                                     STATE_SHED)
 
 logger = logging.getLogger(__name__)
 
@@ -78,6 +80,34 @@ REMOTE_SERVER_DEAD = 'remote-server-dead'
 SERVER_DRAINING = 'server-draining'
 SERVER_OVERLOADED = 'server-overloaded'
 RESEQUENCER_STALLED = 'resequencer-stalled'
+#: The host memory governor (``membudget.py``) sits at degrade-or-worse:
+#: a quiet pipeline under active memory degradation is the *governor's*
+#: episode (caches evicting, spill paused, ventilation paced), not a
+#: stage fault. SOFT at degrade/shed — the governor owns the hard path
+#: (a budget breach raises its own typed ``HostMemoryExceededError``
+#: with a flight dump; escalating to a PipelineStallError here would
+#: race it with a worse diagnosis).
+MEMORY_PRESSURE = 'memory-pressure'
+
+#: Governor ladder states that flip classification to MEMORY_PRESSURE
+#: (the canonical constants — membudget's module surface is stdlib-only,
+#: so the import is cycle-free and a renamed/added rung cannot silently
+#: stop matching here). Breach is included: while the governor's typed
+#: HostMemoryExceededError is in flight, a quiet pipeline must not be
+#: hard-escalated as an ordinary stage stall racing it.
+_MEM_DEGRADED_STATES = (STATE_DEGRADE, STATE_SHED, STATE_BREACH)
+
+#: Classifications the memory ladder REINTERPRETS as memory-pressure
+#: while degrade-or-worse holds: the starvation-shaped symptoms active
+#: degradation deliberately causes (paced ventilation starves the
+#: reader, shrunk pools starve the assembler, shedding servers refuse
+#: consumers). Deliberately NOT the whole vocabulary: a dead worker, a
+#: wedged publish behind the resequencer, or a hung device_put is a
+#: genuine fault that memory pressure does not explain — those keep
+#: their own classification (and their hard escalation), or a pipeline
+#: parked at 90% of budget could hang forever behind a soft-only label.
+_MEM_REINTERPRETED = frozenset({READER_STARVED, ARENA_POOL_WEDGED,
+                                SERVER_OVERLOADED})
 #: Pseudo-classification: every stale stage is parked in a *waiting* state
 #: (on upstream or the consumer) and no culpable stage has crossed its own
 #: deadline yet — not an actionable stall, so the watchdog records nothing
@@ -90,7 +120,8 @@ PIPELINE_WAITING = 'pipeline-waiting'
 #: training-loop pauses into failures; a draining data-service server is
 #: an *operator's* choice mid-rollout and ends in a clean END broadcast
 #: (or a failover) on its own. The diagnosis is still recorded.
-SOFT_ONLY = frozenset({CONSUMER_NOT_DRAINING, SERVER_DRAINING})
+SOFT_ONLY = frozenset({CONSUMER_NOT_DRAINING, SERVER_DRAINING,
+                       MEMORY_PRESSURE})
 
 #: States in which a stage is parked waiting on its *upstream* (or on the
 #: consumer) rather than doing its own work: a stale heartbeat in one of
@@ -275,7 +306,27 @@ def classify_stall(beats, probes):
     *waiting* state (on its upstream or its consumer) is a symptom, so
     blame lands on whoever was last seen doing (or failing to do) actual
     work. The returned ``detail`` is one human sentence.
+
+    Memory-pressure overlay: with the governor armed at degrade-or-worse,
+    starvation-shaped results (:data:`_MEM_REINTERPRETED`) reinterpret as
+    the soft-only ``memory-pressure`` — intended load-shedding, not a
+    fault — while genuine faults (dead workers, wedged publishes, hung
+    transfers) keep their own classification and escalation.
     """
+    classification, stage, detail = _classify_stall_stages(beats, probes)
+    memory = probes.get('memory') or {}
+    if memory.get('armed') and memory.get('state') in _MEM_DEGRADED_STATES \
+            and classification in _MEM_REINTERPRETED:
+        return (MEMORY_PRESSURE, 'memory',
+                'host memory governor at {!r} ({} of {} budget bytes, '
+                '{:.0%}) — would otherwise classify {}: {}'.format(
+                    memory.get('state'), memory.get('accounted_bytes'),
+                    memory.get('budget_bytes'), memory.get('frac') or 0.0,
+                    classification, detail))
+    return classification, stage, detail
+
+
+def _classify_stall_stages(beats, probes):
     def stale(name):
         entry = beats.get(name)
         return (entry is not None and entry['stall_timeout_s'] is not None

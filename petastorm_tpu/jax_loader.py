@@ -691,6 +691,11 @@ class JaxLoader(object):
                  lineage=None, resume_state=None):
         import jax
 
+        # Fail a typo'd memory budget before any staging thread starts or
+        # governor registration happens (mirrors Reader.__init__).
+        from petastorm_tpu import membudget as membudget_mod
+        membudget_mod.validate_env_budget()
+
         if tracer is None:
             from petastorm_tpu.trace import NullTracer
             tracer = NullTracer()
@@ -820,6 +825,13 @@ class JaxLoader(object):
             attach = getattr(reader, 'attach_health', None)
             if attach is not None:
                 attach(self._health.registry)
+            # Memory-pressure classification (health.classify_stall): the
+            # governor's ladder state rides every diagnosis, and a stall
+            # while degradation is active classifies as memory-pressure
+            # (soft) instead of blaming a deliberately-shrunk stage.
+            from petastorm_tpu import membudget as membudget_mod
+            self._health.registry.register_probe(
+                'memory', membudget_mod.get_governor().probe)
         # Batch provenance (petastorm_tpu.lineage): ring + ledger of what
         # exactly composed every delivered batch. Collector hooks ride the
         # host-batch iterators; records are minted at delivery in __next__.
@@ -870,6 +882,11 @@ class JaxLoader(object):
         self._stats_lock = threading.Lock()
         self._stage_s = 0.0
         self._staged_bytes = 0
+        # Latest staged batch's bytes (membudget prefetch-queue pool =
+        # depth x this). Initialized BEFORE the staging engine starts:
+        # a stage thread may record a size before __init__ finishes, and
+        # a later zeroing would blank the accounting at spin-up.
+        self._last_batch_nbytes = 0
         try:
             self._dlpack_staging = jax.default_backend() == 'cpu'
         except Exception:  # noqa: BLE001 - backend probe must not kill init
@@ -973,6 +990,54 @@ class JaxLoader(object):
         if self._health is not None:
             self._health.start()
 
+        # Host memory governor (petastorm_tpu.membudget): the loader's
+        # byte-holding pools register for unified accounting — the arena
+        # pool (which also covers the staging in-flight window: staged
+        # batches are arena-backed), the prefetch queue (staged batches x
+        # the latest batch's bytes), and the shuffling buffer. Arming is
+        # env-driven (PETASTORM_TPU_HOST_MEM_BUDGET) and refcounted;
+        # breaches are delivered into the consumer queue exactly like a
+        # watchdog hard stall — the trainer raises HostMemoryExceededError
+        # with a flight dump instead of eating a kernel SIGKILL.
+        from petastorm_tpu import membudget as membudget_mod
+        governor = membudget_mod.get_governor()
+        self._mem_handles = []
+        if self._arena_pool is not None:
+            pool = self._arena_pool
+            self._mem_handles.append(governor.register_pool(
+                'arena-pool', lambda: pool.nbytes))
+        def prefetch_queue_nbytes():
+            # Arena-backed staging (the prefetch>0 engine path): every
+            # queued batch's HOST bytes are already accounted by the
+            # arena pool (zero-copy backends alias the arena; copying
+            # backends queue device arrays that hold no host memory) —
+            # reporting them here too would double-count the same bytes
+            # and walk the ladder on phantom pressure. Only batches that
+            # bypassed the arena pool are this pool's to count.
+            if self._arena_pool is not None:
+                return 0
+            return ((self._queue.qsize() + len(self._ready))
+                    * self._last_batch_nbytes)
+
+        self._mem_handles.append(governor.register_pool(
+            'prefetch-queue', prefetch_queue_nbytes))
+        if self._shuffler is not None:
+            shuffler = self._shuffler
+            degrade = None
+            if getattr(reader, 'deterministic', None) is False:
+                # Halving the buffer changes the draw sequence — only
+                # readers that EXPLICITLY report non-deterministic register
+                # the hook. Fail closed on readers without the property
+                # (RemoteReader may be carrying a deterministic stream):
+                # the deterministic contract outranks memory relief, and
+                # the other rungs still apply.
+                degrade = shuffler.shrink_capacity
+            self._mem_handles.append(governor.register_pool(
+                'shuffling-buffer', lambda: shuffler.nbytes,
+                degrade_fn=degrade))
+        self._mem_breach_sink = governor.add_breach_sink(self._deliver_stall)
+        self._mem_armed = membudget_mod.maybe_arm_from_env()
+
         # Adaptive autotuning (petastorm_tpu.autotune): one controller for
         # the whole pipeline — the loader's knobs (prefetch depth, in-flight
         # transfer window, arena depth) merged with the reader tier's
@@ -1010,7 +1075,10 @@ class JaxLoader(object):
                     telemetry_fn=self._autotune_telemetry, knobs=knobs,
                     config=cfg, tracer=self._tracer,
                     classify_fn=autotune_mod.classify_loader,
-                    watchdog_active_fn=watchdog_active).start()
+                    watchdog_active_fn=watchdog_active,
+                    # Advisory rung of the memory ladder: the tuner stops
+                    # growing and steps every knob down instead.
+                    memory_state_fn=governor.pressure_level).start()
                 store = getattr(reader, 'chunk_store', None)
                 if store is not None:
                     # Epoch-0 spill throttling (the reader's own controller
@@ -1143,6 +1211,9 @@ class JaxLoader(object):
         with self._stats_lock:
             self._stage_s += time.perf_counter() - t0
             self._staged_bytes += nbytes
+        # Prefetch-queue byte accounting (membudget): depth x the latest
+        # batch's bytes. Int rebind is atomic; staging thread only.
+        self._last_batch_nbytes = nbytes
         self._m_staged_bytes.inc(nbytes)
         return out
 
@@ -1433,6 +1504,12 @@ class JaxLoader(object):
             # Provenance ledger health: records minted vs dropped, the
             # write-behind lag, and where the ledger landed on disk.
             out['lineage'] = self._lineage.stats()
+        from petastorm_tpu import membudget as membudget_mod
+        governor = membudget_mod.get_governor()
+        if governor.armed:
+            # Memory governor: budget, ladder position + peaks, per-pool
+            # bytes, degrade-action counts (the bench's `mem` block).
+            out['mem'] = governor.stats()
         return out
 
     @property
@@ -1495,6 +1572,14 @@ class JaxLoader(object):
                 self._reader.rows_consumed(len(rows))
 
     def stop(self):
+        from petastorm_tpu import membudget as membudget_mod
+        governor = membudget_mod.get_governor()
+        for handle in self._mem_handles:
+            handle.close()
+        governor.remove_breach_sink(self._mem_breach_sink)
+        if self._mem_armed:
+            self._mem_armed = False
+            governor.release()
         if self._autotuner is not None:
             # First: a tuner firing mid-teardown would retune stages that
             # are being joined.

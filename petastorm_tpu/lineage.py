@@ -312,6 +312,8 @@ class LineageTracker(object):
         self._next_batch_id = 0
         self.records = 0
         self.dropped = 0
+        self.pressure_dropped = 0   # records shed by the memory governor
+        self._pressure_shed = False
         self.collector = LineageCollector(self, digest=digest)
         self._m_records = metrics.counter(
             'pst_lineage_records_total',
@@ -325,6 +327,17 @@ class LineageTracker(object):
             self._ledger = LineageLedger(ledger_dir, self.ctx,
                                          max_records=max_records,
                                          queue_size=queue_size)
+        # Memory-governor accounting (membudget.py): the write-behind
+        # queue's records are the only unbounded-ish bytes here (ring and
+        # pending are small and bounded); under *degrade* the governor
+        # sheds records — counted in pressure_dropped + the dropped
+        # metric, never silently.
+        from petastorm_tpu import membudget
+        self._mem_handle = membudget.register_pool(
+            'lineage-queue',
+            self.queued_nbytes,
+            degrade_fn=lambda: self.set_pressure_shedding(True),
+            degrade_release_fn=lambda: self.set_pressure_shedding(False))
         with _live_lock:
             _live_trackers.add(self)
 
@@ -372,11 +385,41 @@ class LineageTracker(object):
             self.records += 1
         self._m_records.inc()
         if self._ledger is not None:
-            if not self._ledger.append(record):
+            if self._pressure_shed:
+                # Governor degrade rung: the spill is shed — counted, not
+                # silent (the ring above still holds the record).
+                with self._lock:
+                    self.dropped += 1
+                    self.pressure_dropped += 1
+                self._m_dropped.inc()
+            elif not self._ledger.append(record):
                 with self._lock:
                     self.dropped += 1
                 self._m_dropped.inc()
         return record
+
+    def set_pressure_shedding(self, shed):
+        """Memory-governor degrade hook: while True, delivered batches
+        still mint ring records (bounded, the post-mortem surface) but the
+        ledger spill is SHED — each skipped record counts in
+        ``pressure_dropped``/``dropped`` and the dropped metric, never
+        silently. Returns True when the flag actually flipped (the
+        governor counts transitions, not the per-tick re-asserts)."""
+        shed = bool(shed)
+        with self._lock:
+            changed = shed != self._pressure_shed
+            self._pressure_shed = shed
+        if changed:
+            logger.warning('lineage ledger spill %s under memory pressure',
+                           'shed' if shed else 'restored')
+        return changed
+
+    def queued_nbytes(self):
+        """Estimated bytes parked in the ledger's write-behind queue — the
+        memory governor's ``lineage-queue`` accounting hook."""
+        if self._ledger is None:
+            return 0
+        return self._ledger.queued_nbytes()
 
     def ring(self):
         with self._lock:
@@ -396,6 +439,7 @@ class LineageTracker(object):
         with self._lock:
             out = {'records': self.records,
                    'dropped': self.dropped,
+                   'pressure_dropped': self.pressure_dropped,
                    'pending': len(self._pending),
                    'ring': len(self._ring)}
         if self._ledger is not None:
@@ -414,6 +458,7 @@ class LineageTracker(object):
     def close(self):
         with _live_lock:
             _live_trackers.discard(self)
+        self._mem_handle.close()
         if self._ledger is not None:
             self._ledger.close()
 
@@ -437,6 +482,7 @@ class LineageLedger(object):
         self._max_records = int(max_records)
         self._accepted = 0      # gated synchronously in append()
         self._written = 0
+        self._record_bytes_ema = 512.0   # serialized-size estimate (drain)
         self.dropped = 0        # accepted but discarded (write failure/bound)
         self._failed = False
         self._closed = False
@@ -479,6 +525,12 @@ class LineageLedger(object):
     def lag(self):
         return self._queue.qsize()
 
+    def queued_nbytes(self):
+        """Estimated queued record bytes: depth x the serialized-size EMA
+        the drain thread maintains (records are JSON dicts — re-serializing
+        them here just to weigh them would double the writer's work)."""
+        return int(self._queue.qsize() * self._record_bytes_ema)
+
     def append(self, record):
         """Enqueue one record for the writer; False when it was dropped
         (ledger closed, writer dead, queue full, or past the line bound).
@@ -509,7 +561,12 @@ class LineageLedger(object):
                     self._m_dropped.inc()
                     continue
                 try:
-                    self._file.write(json.dumps(record, default=repr) + '\n')
+                    line = json.dumps(record, default=repr) + '\n'
+                    # Size EMA feeds queued_nbytes (governor accounting);
+                    # float rebind is atomic, writer thread only.
+                    self._record_bytes_ema += 0.2 * (len(line)
+                                                     - self._record_bytes_ema)
+                    self._file.write(line)
                     self._written += 1
                 except (OSError, ValueError):
                     logger.warning('lineage ledger write failed; disabling',

@@ -13,9 +13,10 @@ import logging
 import os
 import warnings
 
-from petastorm_tpu import determinism
+from petastorm_tpu import determinism, membudget
 from petastorm_tpu.arrow_worker import ArrowResultsQueueReader, ArrowWorker
-from petastorm_tpu.cache import LocalDiskArrowTableCache, LocalDiskCache, NullCache
+from petastorm_tpu.cache import (LocalDiskArrowTableCache, LocalDiskCache,
+                                 MemoryCache, NullCache)
 from petastorm_tpu.checkpoint import ConsumptionTracker
 from petastorm_tpu.errors import NoDataAvailableError, PipelineStallError
 from petastorm_tpu.etl.dataset_metadata import (PetastormMetadataError,
@@ -517,6 +518,11 @@ class Reader(object):
                  shuffle_rows_in_chunk=False, error_budget=None,
                  watchdog=None, stall_timeout_s=None, autotune=None,
                  deterministic=False):
+        # A typo'd memory budget must fail HERE — before the worker pool,
+        # ventilator, watchdog, or autotuner threads start and before any
+        # process-wide governor registration (the arm at the tail of this
+        # constructor would otherwise raise with no teardown path).
+        membudget.validate_env_budget()
         self._store = store
         self.stored_schema = stored_schema
         self.ngram = ngram
@@ -803,13 +809,99 @@ class Reader(object):
                     telemetry_fn=self._autotune_telemetry, knobs=knobs,
                     config=cfg, tracer=get_global_tracer(),
                     classify_fn=autotune_mod.classify_reader,
-                    watchdog_active_fn=self._watchdog_episode_active).start()
+                    watchdog_active_fn=self._watchdog_episode_active,
+                    memory_state_fn=membudget.get_governor().pressure_level,
+                ).start()
                 if self.chunk_store is not None:
                     # Epoch-0 spill throttling: pause the store's write-
                     # behind writer whenever the tuner classifies the
                     # pipeline itself as the bottleneck.
                     self._autotuner.add_listener(
                         autotune_mod.writer_throttle_listener(self.chunk_store))
+
+        # --- host memory governor (petastorm_tpu.membudget) -----------------
+        # The reader tier's byte-holding pools register for unified
+        # accounting: the decoded-chunk results queue (with the shed-rung
+        # ventilation pacing hook), the RAM cache (degrade = LRU evict),
+        # the NVMe chunk store (advisory = pause spill, degrade = close
+        # LRU mmaps), and the deterministic resequencer's reorder buffer.
+        # Arming is env-driven + refcounted; a breach is injected into the
+        # pool's consumer wait exactly like a watchdog hard stall.
+        governor = membudget.get_governor()
+        self._mem_handles = []
+        # Initialized BEFORE register_pool: a reader built while the
+        # governor already sits at shed gets its shed_fn fired during
+        # registration, which writes this save slot.
+        self._mem_shed_saved_watermark = None
+        self._mem_shed_tight = None
+        self._mem_shed_active = False
+        pool = self._workers_pool
+        if hasattr(pool, 'results_nbytes'):
+            self._mem_handles.append(governor.register_pool(
+                'results-queue', pool.results_nbytes,
+                shed_fn=self._shed_ventilation))
+        if isinstance(self._cache, MemoryCache):
+            cache = self._cache
+            self._mem_handles.append(governor.register_pool(
+                'memory-cache', lambda: cache.nbytes,
+                degrade_fn=cache.evict))
+        if self.chunk_store is not None:
+            store = self.chunk_store
+            self._mem_handles.append(governor.register_pool(
+                'chunk-store', store.governed_nbytes,
+                degrade_fn=store.close_lru_mmaps,
+                advisory_fn=store.set_spill_paused))
+        if self._resequencer is not None:
+            self._mem_handles.append(governor.register_pool(
+                'resequencer', self._resequencer.buffered_nbytes))
+
+        def deliver_breach(error):
+            # Same delivery shape as the watchdog's hard stall: surfaces
+            # at the next __next__ entry AND wakes a consumer parked in an
+            # unbounded get_results().
+            self._stall_error = error
+            inject = getattr(self._workers_pool, 'inject_consumer_error',
+                             None)
+            if inject is not None:
+                inject(error)
+
+        self._mem_breach_sink = governor.add_breach_sink(deliver_breach)
+        self._mem_armed = membudget.maybe_arm_from_env()
+
+    def _shed_ventilation(self, active):
+        """Shed-rung hook: arm a tight results watermark so the ventilator
+        falls back to paced, one-item-per-ack feeding (bounding decoded
+        bytes at a handful of chunks); restore the previous watermark when
+        the ladder recedes. Order is preserved — pacing changes *when*
+        chunks are fed, never which or in what order, so deterministic
+        streams stay bit-identical."""
+        pool = self._workers_pool
+        if not hasattr(pool, 'results_watermark'):
+            return
+        # Idempotent on re-assert: register_pool fires the toggle for a
+        # reader built mid-episode and _apply_rung can fire it again for
+        # the same transition — a second True must not capture the tight
+        # watermark into the save slot (the restore would then leave
+        # paced feeding on forever).
+        if active:
+            if self._mem_shed_active:
+                return
+            self._mem_shed_active = True
+            self._mem_shed_saved_watermark = pool.results_watermark
+            capacity = pool.results_capacity or 8
+            self._mem_shed_tight = max(2, capacity // 8)
+            pool.results_watermark = self._mem_shed_tight
+        else:
+            if not self._mem_shed_active:
+                return
+            self._mem_shed_active = False
+            # Restore ONLY if the knob still holds our tight value: the
+            # autotuner's mem-shrink also writes this watermark during a
+            # pressure episode, and clobbering its setting with the stale
+            # pre-shed value would disarm paced feeding while the ladder
+            # (still at degrade) needs the relief.
+            if pool.results_watermark == self._mem_shed_tight:
+                pool.results_watermark = self._mem_shed_saved_watermark
 
     def _watchdog_episode_active(self):
         return (self._health is not None
@@ -921,6 +1013,10 @@ class Reader(object):
             return diag
 
         registry.register_probe('worker-pool', pool_probe)
+        # Ladder position of the host memory governor: rides every
+        # diagnosis, and classify_stall reads it FIRST — a quiet stage
+        # under active degradation is load-shedding, not a fault.
+        registry.register_probe('memory', membudget.get_governor().probe)
         if self._resequencer is not None:
             # The resequencer-stalled signature (health.classify_stall):
             # chunks buffered behind a ventilation-seq hole while the
@@ -1208,6 +1304,13 @@ class Reader(object):
         self._ventilator.reset()
 
     def stop(self):
+        governor = membudget.get_governor()
+        for handle in self._mem_handles:
+            handle.close()
+        governor.remove_breach_sink(self._mem_breach_sink)
+        if self._mem_armed:
+            self._mem_armed = False
+            governor.release()
         if self._autotuner is not None:
             # First: a tuner firing mid-teardown would resize a pool whose
             # workers are being joined.
@@ -1247,6 +1350,9 @@ class Reader(object):
             diag['heartbeats'] = self._health_registry.beat_table()
         if self._autotuner is not None:
             diag['autotune'] = self._autotuner.stats()
+        governor = membudget.get_governor()
+        if governor.armed:
+            diag['mem'] = governor.stats()
         return diag
 
     def __enter__(self):

@@ -87,6 +87,7 @@ class RandomShufflingBuffer(ShufflingBufferBase):
         self._min_after_retrieve = min_after_retrieve
         self._extra_capacity = extra_capacity
         self._store = []
+        self._row_nbytes = None   # per-row estimate, sampled on first add
         self._pending = None   # armed by track_pending()
         self._done_adding = False
         self._rng = np.random.default_rng(seed)
@@ -106,6 +107,17 @@ class RandomShufflingBuffer(ShufflingBufferBase):
                     'add_many of {} items would exceed capacity+extra ({}+{}); current size {}. '
                     'Check can_add() before adding.'.format(
                         len(items), self._capacity, self._extra_capacity, len(self._store)))
+            if len(items):
+                # Running EMA over one sampled row per add (not a frozen
+                # first-row sample): variable-length rows whose early
+                # values are small would otherwise under-report the
+                # governor's largest loader-side pool for the whole run.
+                from petastorm_tpu.membudget import approx_nbytes
+                sample = max(1, approx_nbytes(items[0]))
+                if self._row_nbytes is None:
+                    self._row_nbytes = sample
+                else:
+                    self._row_nbytes += 0.2 * (sample - self._row_nbytes)
             self._store.extend(items)
 
     def retrieve(self):
@@ -135,6 +147,48 @@ class RandomShufflingBuffer(ShufflingBufferBase):
     @property
     def size(self):
         return len(self._store)
+
+    @property
+    def capacity(self):
+        return self._capacity
+
+    @property
+    def nbytes(self):
+        """Estimated resident bytes (buffered + pending rows x the sampled
+        per-row size) — the memory governor's ``shuffling-buffer`` pool."""
+        if self._row_nbytes is None:
+            return 0
+        pending = len(self._pending) if self._pending is not None else 0
+        return int((len(self._store) + pending) * self._row_nbytes)
+
+    def shrink_capacity(self, factor=2):
+        """Halve (by default) the soft capacity AND the decorrelation
+        floor — the governor's *degrade* hook for NON-deterministic
+        pipelines (changing the buffer depth changes the draw sequence,
+        so deterministic readers never register it). The floor is what
+        actually sets steady-state residency (retrieval stops at
+        ``min_after_retrieve`` buffered rows), so shrinking the cap alone
+        would free nothing; halving both trades shuffle quality for
+        bytes, gradually. No buffered row is dropped — the store drains
+        under the new floor as the consumer retrieves. Returns True when
+        anything moved."""
+        factor = max(1, int(factor))
+        with self._lock:
+            new_min = max(1, self._min_after_retrieve // factor)
+            # Never below the CURRENT fill: the loader's feed path calls
+            # add_many without a can_add gate (overshoot headroom is the
+            # contract), so a cap under the resident rows would turn the
+            # next add into a RuntimeError — the rung meant to prevent an
+            # OOM kill must not kill the run itself. The per-tick degrade
+            # cadence ratchets the cap further down as the store drains
+            # below each new floor.
+            new_cap = max(new_min + 1, self._capacity // factor,
+                          len(self._store))
+            if new_cap >= self._capacity and new_min >= self._min_after_retrieve:
+                return False
+            self._capacity = min(new_cap, self._capacity)
+            self._min_after_retrieve = min(new_min, self._min_after_retrieve)
+            return True
 
     def finish(self):
         self._done_adding = True

@@ -374,6 +374,21 @@ class ArenaPool(object):
             return self._depth
 
     @property
+    def nbytes(self):
+        """Bytes pinned by every allocated arena (free, filled, and
+        in-flight alike: an arena waiting recycle is just as resident) —
+        the memory governor's ``arena-pool`` accounting hook. This also
+        covers the staging engine's in-flight window: staged batches are
+        arena-backed, so window bytes ARE allocated-arena bytes."""
+        with self._cond:
+            if self._spec is None:
+                return 0
+            per_arena = sum(
+                int(np.prod(shape)) * np.dtype(dtype).itemsize
+                for shape, dtype in self._spec.values())
+            return self._allocated * per_arena
+
+    @property
     def wait_seconds(self):
         """Cumulative assembler backpressure seconds (the autotuner's
         arena-bound signal)."""

@@ -13,6 +13,7 @@ import queue
 import threading
 from collections import deque
 
+from petastorm_tpu.membudget import approx_nbytes, get_governor
 from petastorm_tpu.utils import drain_queue
 from petastorm_tpu.workers import (EmptyResultError, RowGroupQuarantined,
                                    TimeoutWaitingForResultError,
@@ -20,8 +21,16 @@ from petastorm_tpu.workers import (EmptyResultError, RowGroupQuarantined,
                                    deliver_quarantine, quarantine_record_for)
 
 _DEFAULT_RESULTS_QUEUE_SIZE = 50
+#: Ventilation-queue bound when no ventilator declares a window (manual
+#: ventilate() callers): far above any real in-flight cap, but no longer
+#: the one genuinely unbounded cross-thread channel in the package —
+#: start() re-sizes it down to the ventilator's actual window.
+_DEFAULT_VENTILATION_QUEUE_SIZE = 1024
 _VENTILATION_POLL_TIMEOUT_S = 0.001
 _RESULTS_POLL_TIMEOUT_S = 0.01
+#: Ventilation-queue headroom over the worker count after a live resize
+#: (mirrors the reader's workers + extra in-flight convention).
+_RESIZE_VENT_SLACK = 4
 
 
 class _WorkerTerminationRequested(Exception):
@@ -82,7 +91,8 @@ class ThreadPool(object):
                  profiling_enabled=False):
         self._workers_count = workers_count
         self._results_queue = queue.Queue(maxsize=results_queue_size)
-        self._ventilator_queue = queue.Queue()
+        self._ventilator_queue = queue.Queue(
+            maxsize=_DEFAULT_VENTILATION_QUEUE_SIZE)
         self._stop_event = threading.Event()
         self._workers = []
         self._retired_workers = []
@@ -114,6 +124,10 @@ class ThreadPool(object):
         self.quarantine_sink = None
         #: Optional health.Heartbeat (set by ``Reader.attach_health``).
         self.health_heartbeat = None
+        #: EMA of one published result's bytes (written by worker threads,
+        #: racy float rebinds tolerated — it feeds an *estimate*): the
+        #: memory governor's results-queue accounting is depth x this.
+        self.result_nbytes_ema = 0.0
 
     @property
     def workers_count(self):
@@ -128,6 +142,19 @@ class ThreadPool(object):
             self._spawn_worker(worker_id)
         self._ventilator = ventilator
         if ventilator is not None:
+            # Size the ventilation queue from the ventilator's in-flight
+            # window: the feeder caps outstanding items (queued + being
+            # processed) at the window, so the queue can never legitimately
+            # hold more — a tight bound that makes queued decode work a
+            # *visible*, bounded quantity instead of an open-ended pile.
+            # Rebuilt here (before ventilator.start(), so it is empty):
+            # the window isn't known at construction. set_max_in_flight may
+            # later raise the cap past this bound — ventilate()'s
+            # stop-aware put then briefly backpressures the feeder instead
+            # of deadlocking shutdown.
+            window = getattr(ventilator, '_max_ventilation_queue_size', None)
+            if window:
+                self._ventilator_queue = queue.Queue(maxsize=max(1, int(window)))
             ventilator._ventilate_fn = self.ventilate
             if getattr(ventilator, 'backpressure_fn', None) is None:
                 ventilator.backpressure_fn = self._results_backpressure
@@ -176,6 +203,15 @@ class ThreadPool(object):
                 self._next_worker_id += spawn
             for i in range(spawn):
                 self._spawn_worker(worker_id + i)
+            # Grow the ventilation-queue bound with the pool: the reader's
+            # resize hook raises the ventilator's in-flight cap to track
+            # the worker count, and a queue still sized for the old window
+            # would quietly re-backpressure the feeder to the old width.
+            vent_queue = self._ventilator_queue
+            with vent_queue.mutex:
+                if vent_queue.maxsize and n + _RESIZE_VENT_SLACK > vent_queue.maxsize:
+                    vent_queue.maxsize = n + _RESIZE_VENT_SLACK
+                    vent_queue.not_full.notify_all()
             return n
 
     def _should_retire(self, thread):
@@ -212,13 +248,36 @@ class ThreadPool(object):
     def ventilate(self, *args, **kwargs):
         with self._count_lock:
             self._ventilated_unprocessed += 1
-        self._ventilator_queue.put((args, kwargs))
+        # Stop-aware bounded put (mirrors _put_result): the ventilation
+        # queue is bounded now, and the feeder thread must never wedge
+        # stop()/join() by blocking into a pool that is shutting down. An
+        # item dropped at stop time must also retract its in-flight count
+        # — _all_done() requires the counter to reach zero, and a leaked
+        # +1 would spin a concurrently-stopping consumer forever.
+        while True:
+            if self._stop_event.is_set():
+                with self._count_lock:
+                    self._ventilated_unprocessed -= 1
+                return
+            try:
+                self._ventilator_queue.put((args, kwargs),
+                                           timeout=_RESULTS_POLL_TIMEOUT_S)
+                return
+            except queue.Full:
+                continue
 
     def _put_result(self, data):
         # Stop-aware bounded put (parity: thread_pool.py:200-214): never block
         # forever on a full queue if the pool is being stopped.
         from petastorm_tpu.faults import maybe_inject
         maybe_inject('queue-stall')
+        if not isinstance(data, VentilatedItemProcessedMessage):
+            # Weighed only while a governor is armed: the size walk is
+            # cheap but non-zero, and pipelines that never opt in must not
+            # pay it per published chunk.
+            if get_governor().armed:
+                self.result_nbytes_ema += 0.25 * (approx_nbytes(data)
+                                                  - self.result_nbytes_ema)
         while True:
             if self._stop_event.is_set():
                 raise _WorkerTerminationRequested()
@@ -380,3 +439,9 @@ class ThreadPool(object):
     @property
     def results_capacity(self):
         return self._results_queue.maxsize
+
+    def results_nbytes(self):
+        """Estimated decoded bytes parked in the results queue (+ the
+        consumer's drain buffer): depth x the published-result size EMA —
+        the memory governor's ``results-queue`` accounting hook."""
+        return int(self.results_qsize * self.result_nbytes_ema)

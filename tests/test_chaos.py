@@ -1066,3 +1066,196 @@ def test_watchdog_recovered_stall_does_not_kill_reader(
                 continue   # diagnosed mid-stall; pipeline still consumable
             rows += len(chunk.id)
     assert rows == ROWS
+
+
+# ---------------------------------------------------------------------------
+# (e) host memory governor: the mem-pressure site drives every ladder rung
+#     deterministically (ISSUE 12) — no real gigabytes are ever allocated.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fresh_governor():
+    """Isolated process-wide governor with a fast sampler; the previous
+    one is restored (and this one's thread provably released) after."""
+    from petastorm_tpu import membudget
+    gov = membudget.MemoryGovernor(
+        config=membudget.GovernorConfig(interval_s=0.02))
+    previous = membudget.set_governor(gov)
+    try:
+        yield gov
+    finally:
+        while gov._arm_count > 0:
+            gov.release()
+        membudget.set_governor(previous)
+
+
+def _wait_until(predicate, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.mark.membudget
+def test_mem_pressure_advisory_shrinks_knobs_and_pauses_spill(
+        chaos_dataset, tmp_path, monkeypatch, fresh_governor):
+    """Advisory rung: the autotuner stops growing and takes mem-shrink
+    steps (observed in diagnostics()['autotune']), and the chunk store's
+    write-behind spill is paused — all driven by the mem-pressure site
+    inflating the chunk-store pool's REPORTED bytes into the advisory
+    band of a 1 MB synthetic budget."""
+    from petastorm_tpu.autotune import AutotuneConfig
+
+    monkeypatch.setenv('PETASTORM_TPU_HOST_MEM_BUDGET', '1000000')
+    monkeypatch.setenv(ENV_VAR, 'mem-pressure:match=chunk:bytes=750000')
+    store_dir = tmp_path / 'store'
+    with make_tensor_reader(chaos_dataset.url, reader_pool_type='thread',
+                            workers_count=2, num_epochs=None,
+                            shuffle_row_groups=False,
+                            cache_type='chunk-store',
+                            cache_location=str(store_dir),
+                            autotune=AutotuneConfig(interval_s=0.02,
+                                                    hysteresis=1,
+                                                    cooldown=0)) as reader:
+        assert fresh_governor.armed
+        it = iter(reader)
+        next(it)
+
+        def advisory_acted():
+            next(it)   # keep the pipeline moving
+            if not reader.chunk_store.spill_paused:
+                return False
+            decisions = reader.diagnostics()['autotune']['decisions']
+            return any(d['action'] == 'mem-shrink' for d in decisions)
+
+        assert _wait_until(advisory_acted), (
+            fresh_governor.stats(), reader.diagnostics().get('autotune'))
+        assert fresh_governor.probe()['state'] == 'advisory'
+        # The inflated pool is the chunk store, and only it.
+        pools = fresh_governor.probe()['pools']
+        assert pools['chunk-store'] >= 750000
+        assert pools.get('results-queue', 0) < 750000
+
+
+@pytest.mark.membudget
+def test_mem_pressure_degrade_evicts_and_counts_drops(
+        chaos_dataset, tmp_path, monkeypatch, fresh_governor):
+    """Degrade rung: the RAM cache is LRU-evicted (counted in
+    pst_mem_degrade_actions_total via stats()['degrade_actions']) and
+    lineage ledger records are shed — counted in pressure_dropped, never
+    silently."""
+    import jax  # noqa: F401 - JaxLoader needs it
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    monkeypatch.setenv('PETASTORM_TPU_HOST_MEM_BUDGET', '1000000')
+    monkeypatch.setenv(ENV_VAR, 'mem-pressure:match=memory-cache:bytes=870000')
+    ledger_dir = tmp_path / 'ledger'
+    with make_tensor_reader(chaos_dataset.url, reader_pool_type='thread',
+                            workers_count=2, num_epochs=None,
+                            shuffle_row_groups=False,
+                            cache_type='memory') as reader:
+        with JaxLoader(reader, batch_size=4, prefetch=2, autotune=False,
+                       lineage=str(ledger_dir)) as loader:
+            it = iter(loader)
+
+            def degraded():
+                next(it)
+                stats = fresh_governor.stats()
+                if not stats['degrade_actions'].get('degrade:memory-cache'):
+                    return False
+                return loader.stats['lineage']['pressure_dropped'] > 0
+
+            assert _wait_until(degraded), fresh_governor.stats()
+            assert fresh_governor.probe()['state'] == 'degrade'
+            # Eviction acts on the REAL cache (inflation is virtual): the
+            # pipeline keeps refilling between ticks, so assert the evict
+            # hook holds the resident bytes near zero rather than exactly
+            # zero (per-tick halving vs a live decode race).
+            assert reader._cache.nbytes < 10_000
+            mem = loader.stats['mem']
+            assert mem['peak_state'] in ('degrade', 'shed', 'breach')
+
+
+@pytest.mark.membudget
+def test_mem_pressure_breach_raises_typed_error_with_flight_dump(
+        chaos_dataset, tmp_path, monkeypatch, fresh_governor):
+    """Breach rung: the consumer raises HostMemoryExceededError (never a
+    bare SIGKILL) carrying a flight-dump path whose pool ranking names
+    the inflated pool."""
+    import json
+
+    from petastorm_tpu.errors import HostMemoryExceededError
+    from petastorm_tpu.jax_loader import JaxLoader
+
+    monkeypatch.setenv('PETASTORM_TPU_HOST_MEM_BUDGET', '1000000')
+    monkeypatch.setenv('PETASTORM_TPU_FLIGHT_RECORDER', str(tmp_path))
+    monkeypatch.setenv(ENV_VAR, 'mem-pressure:match=prefetch:bytes=2000000')
+    with make_tensor_reader(chaos_dataset.url, reader_pool_type='thread',
+                            workers_count=2, num_epochs=None,
+                            shuffle_row_groups=False) as reader:
+        with JaxLoader(reader, batch_size=4, prefetch=2,
+                       autotune=False) as loader:
+            it = iter(loader)
+            with pytest.raises(HostMemoryExceededError) as exc_info:
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    next(it)
+                pytest.fail('breach never delivered: {}'.format(
+                    fresh_governor.stats()))
+    error = exc_info.value
+    assert error.ranking[0]['pool'] == 'prefetch-queue'
+    assert error.flight_dump and os.path.isdir(error.flight_dump)
+    with open(os.path.join(error.flight_dump, 'diagnosis.json')) as f:
+        diagnosis = json.load(f)
+    assert diagnosis['pool_ranking'][0]['pool'] == 'prefetch-queue'
+    assert 'prefetch-queue' in str(error)
+
+
+@pytest.mark.membudget
+@pytest.mark.processpool
+def test_mem_acceptance_epoch_under_budget_is_deterministic(
+        chaos_dataset, monkeypatch, fresh_governor):
+    """ISSUE 12 acceptance: under a synthetic budget tight enough to trip
+    degrade, a process-pool deterministic epoch completes with zero OOM
+    risk (peak RSS stays under the budget), pressure-state transitions
+    are recorded, and the chunk stream is BIT-IDENTICAL to an unpressured
+    run — degradation only ever shrinks knobs the resequencer already
+    tolerates."""
+    from petastorm_tpu import membudget
+
+    def chunk_ids(**extra):
+        chunks = []
+        with make_tensor_reader(chaos_dataset.url,
+                                reader_pool_type='process-zmq',
+                                workers_count=2, num_epochs=1, seed=7,
+                                shuffle_row_groups=True,
+                                deterministic=True, **extra) as reader:
+            for chunk in reader:
+                chunks.append(chunk.id.tolist())
+        return chunks
+
+    baseline = chunk_ids()
+    assert sorted(i for c in baseline for i in c) == list(range(ROWS))
+
+    # The budget sits above current RSS (a full 1 GB of headroom: the
+    # assertion below is on REAL process RSS, and a transient allocation
+    # spike on a loaded CI host must not flake it) while the resequencer
+    # pool's inflated bytes land in the degrade band, so the whole ladder
+    # below breach engages while the epoch runs.
+    rss = membudget.process_rss_bytes() or (1 << 30)
+    budget = rss + (1 << 30)
+    monkeypatch.setenv('PETASTORM_TPU_HOST_MEM_BUDGET', str(budget))
+    monkeypatch.setenv(ENV_VAR, 'mem-pressure:match=resequencer:bytes={}'
+                       .format(int(budget * 0.87)))
+    pressured = chunk_ids()
+    stats = fresh_governor.stats()
+    # Bit-identical stream under pressure: determinism survived the ladder.
+    assert pressured == baseline
+    # The ladder provably engaged and the state trajectory was recorded.
+    assert stats['peak_state'] in ('degrade', 'shed')
+    assert any(t['state'] == 'degrade' for t in stats['transitions'])
+    # Zero kernel-OOM risk: the process peak stayed under the budget.
+    assert stats['peak_rss_bytes'] < budget
+    assert stats['breaches'] == 0

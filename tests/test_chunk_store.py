@@ -808,8 +808,10 @@ def test_memory_cache_nbytes_counts_dict_keys():
     with_keys = cache_mod.MemoryCache._nbytes({'a_long_field_name': arr})
     assert with_keys > arr.nbytes    # key strings enter the byte cap
     assert with_keys >= arr.nbytes + sys.getsizeof('a_long_field_name')
-    # import hoisted to module scope (was a per-value-call import).
-    assert hasattr(cache_mod, 'sys')
+    # One estimator for the whole package: the cache cap and the memory
+    # governor must never disagree about the same value's size.
+    from petastorm_tpu.membudget import approx_nbytes
+    assert with_keys == approx_nbytes({'a_long_field_name': arr})
 
 
 # ---------------------------------------------------------------------------

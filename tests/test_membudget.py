@@ -1,0 +1,639 @@
+"""Host memory governor tests (ISSUE 12 tentpole): budget resolution
+(env / cgroup / fallback), the unified pool registry, every pressure
+ladder rung with its actions, breach delivery with the flight-dump pool
+ranking, the ``mem-pressure`` fault site's deterministic inflation, the
+autotuner's ``mem-shrink`` bias, the watchdog's ``memory-pressure``
+classification, and the sampler thread's refcounted lifecycle.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import membudget
+from petastorm_tpu.errors import HostMemoryExceededError
+from petastorm_tpu.membudget import (GovernorConfig, MemoryGovernor,
+                                     STATE_ADVISORY, STATE_BREACH,
+                                     STATE_DEGRADE, STATE_OK, STATE_SHED,
+                                     approx_nbytes, cgroup_memory_limit,
+                                     parse_bytes, resolve_budget)
+
+pytestmark = pytest.mark.membudget
+
+
+@pytest.fixture
+def governor():
+    """A fresh, isolated process-wide governor; the previous one is
+    restored (and the fresh one's sampler provably stopped) afterwards."""
+    gov = MemoryGovernor(budget=1_000_000, config=GovernorConfig())
+    previous = membudget.set_governor(gov)
+    try:
+        yield gov
+    finally:
+        while gov._arm_count > 0:
+            gov.release()
+        membudget.set_governor(previous)
+
+
+def _armed(gov):
+    """Mark armed without starting the sampler (tests drive check())."""
+    gov._arm_count += 1
+    return gov
+
+
+# ---------------------------------------------------------------------------
+# budget resolution
+# ---------------------------------------------------------------------------
+
+def test_parse_bytes_suffixes():
+    assert parse_bytes('1024') == 1024
+    assert parse_bytes('4k') == 4096
+    assert parse_bytes('2m') == 2 << 20
+    assert parse_bytes('3G') == 3 << 30
+    assert parse_bytes('1t') == 1 << 40
+    assert parse_bytes('1.5g') == int(1.5 * (1 << 30))
+    assert parse_bytes('') is None
+    assert parse_bytes('auto') is None
+
+
+def test_parse_bytes_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_bytes('lots')
+    with pytest.raises(ValueError):
+        parse_bytes('-5m')
+
+
+def test_cgroup_limit_v2_and_v1(tmp_path):
+    # v2: memory.max at the root wins.
+    (tmp_path / 'memory.max').write_text('536870912\n')
+    assert cgroup_memory_limit(str(tmp_path)) == 536870912
+    # v2 'max' means no limit; fall through to v1.
+    (tmp_path / 'memory.max').write_text('max\n')
+    v1 = tmp_path / 'memory'
+    v1.mkdir()
+    (v1 / 'memory.limit_in_bytes').write_text('268435456\n')
+    assert cgroup_memory_limit(str(tmp_path)) == 268435456
+    # The v1 near-2**63 "unlimited" sentinel is not a budget.
+    (v1 / 'memory.limit_in_bytes').write_text(str(1 << 62))
+    assert cgroup_memory_limit(str(tmp_path)) is None
+
+
+def test_resolve_budget_env_and_auto(tmp_path, monkeypatch):
+    monkeypatch.setenv(membudget.ENV_VAR, '512m')
+    assert resolve_budget() == (512 << 20, 'env')
+    # auto: cgroup limit minus headroom.
+    (tmp_path / 'memory.max').write_text(str(1 << 30))
+    monkeypatch.setenv(membudget.ENV_VAR, 'auto')
+    budget, source = resolve_budget(cgroup_root=str(tmp_path))
+    assert source == 'cgroup'
+    headroom = max(membudget.MIN_HEADROOM_BYTES,
+                   int((1 << 30) * membudget.DEFAULT_HEADROOM_FRAC))
+    assert budget == (1 << 30) - headroom
+    # unset: unarmed, not a guess.
+    monkeypatch.delenv(membudget.ENV_VAR)
+    assert resolve_budget() == (None, None)
+
+
+def test_resolve_budget_meminfo_fallback(tmp_path, monkeypatch):
+    meminfo = tmp_path / 'meminfo'
+    meminfo.write_text('MemTotal:        8388608 kB\nMemFree: 1 kB\n')
+    monkeypatch.setenv(membudget.ENV_VAR, 'auto')
+    budget, source = resolve_budget(cgroup_root=str(tmp_path / 'nope'),
+                                    meminfo_path=str(meminfo))
+    assert source == 'meminfo'
+    assert budget == int(8388608 * 1024 * membudget.DEFAULT_HOST_FRAC)
+
+
+def test_approx_nbytes_shapes():
+    arr = np.zeros(1000, np.float32)
+    assert approx_nbytes(arr) == 4000
+    assert approx_nbytes({'a': arr, 'b': arr}) >= 8000
+    # Long lists are sampled, not walked.
+    rows = [arr] * 1000
+    estimate = approx_nbytes(rows)
+    assert 3_000_000 <= estimate <= 5_000_000
+
+
+# ---------------------------------------------------------------------------
+# ladder state machine + actions
+# ---------------------------------------------------------------------------
+
+def test_ladder_walks_every_rung(governor):
+    _armed(governor)
+    held = {'n': 0}
+    events = []
+    governor.register_pool(
+        'synthetic', lambda: held['n'],
+        degrade_fn=lambda: events.append('degrade') or True,
+        degrade_release_fn=lambda: events.append('degrade-release'),
+        shed_fn=lambda active: events.append(('shed', active)),
+        advisory_fn=lambda active: events.append(('advisory', active)))
+
+    assert governor.check() == STATE_OK
+    held['n'] = 700_000
+    assert governor.check() == STATE_ADVISORY
+    assert ('advisory', True) in events
+    held['n'] = 850_000
+    assert governor.check() == STATE_DEGRADE
+    assert 'degrade' in events
+    held['n'] = 920_000
+    assert governor.check() == STATE_SHED
+    assert ('shed', True) in events
+    # Recede: every toggle releases, shedding restores.
+    held['n'] = 100_000
+    assert governor.check() == STATE_OK
+    assert ('shed', False) in events
+    assert ('advisory', False) in events
+    assert 'degrade-release' in events
+    stats = governor.stats()
+    assert stats['peak_state'] == STATE_SHED
+    assert stats['degrade_actions'].get('degrade:synthetic', 0) >= 1
+    states = [t['state'] for t in stats['transitions']]
+    assert states == [STATE_ADVISORY, STATE_DEGRADE, STATE_SHED, STATE_OK]
+
+
+def test_degrade_runs_every_tick_while_rung_holds(governor):
+    _armed(governor)
+    calls = []
+    governor.register_pool('p', lambda: 900_000,
+                           degrade_fn=lambda: calls.append(1) or True)
+    governor.check()
+    governor.check()
+    governor.check()
+    assert len(calls) == 3
+
+
+def test_breach_fires_once_per_episode_and_ranks_pools(governor, tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_FLIGHT_RECORDER', str(tmp_path))
+    _armed(governor)
+    delivered = []
+    governor.add_breach_sink(delivered.append)
+    governor.register_pool('small', lambda: 100_000)
+    governor.register_pool('culprit', lambda: 1_100_000)
+    assert governor.check() == STATE_BREACH
+    governor.check()   # same episode: no second error
+    assert len(delivered) == 1
+    error = delivered[0]
+    assert isinstance(error, HostMemoryExceededError)
+    assert error.ranking[0]['pool'] == 'culprit'
+    assert error.accounted == 1_200_000
+    assert 'culprit' in str(error)
+    # The flight dump exists and its diagnosis carries the ranking.
+    assert error.flight_dump is not None and os.path.isdir(error.flight_dump)
+    import json
+    with open(os.path.join(error.flight_dump, 'diagnosis.json')) as f:
+        diagnosis = json.load(f)
+    assert diagnosis['pool_ranking'][0]['pool'] == 'culprit'
+    assert governor.stats()['breaches'] == 1
+
+
+def test_handle_close_unregisters(governor):
+    _armed(governor)
+    handle = governor.register_pool('gone', lambda: 999_999_999)
+    assert governor.check() == STATE_BREACH
+    handle.close()
+    handle.close()   # idempotent
+    assert governor.check() == STATE_OK
+    assert 'gone' not in governor.probe()['pools']
+
+
+def test_pool_nbytes_failure_reuses_last_sample(governor):
+    _armed(governor)
+    state = {'fail': False}
+
+    def nbytes():
+        if state['fail']:
+            raise RuntimeError('pool died')
+        return 800_000
+
+    governor.register_pool('flaky', nbytes)
+    governor.check()
+    state['fail'] = True
+    # The previous sample stands in; no crash, no false ok.
+    assert governor.check() == STATE_ADVISORY
+
+
+def test_unarmed_governor_reports_ok(governor):
+    governor.register_pool('p', lambda: 10**12)
+    assert governor.check() == STATE_OK
+    assert governor.pressure_level() == 0
+
+
+# ---------------------------------------------------------------------------
+# mem-pressure fault site (deterministic inflation)
+# ---------------------------------------------------------------------------
+
+def test_fault_site_inflates_matching_pool(governor, monkeypatch):
+    _armed(governor)
+    monkeypatch.setenv('PETASTORM_TPU_FAULTS',
+                       'mem-pressure:match=cache:bytes=860000')
+    governor.register_pool('memory-cache', lambda: 1_000)
+    governor.register_pool('arena-pool', lambda: 1_000)
+    assert governor.check() == STATE_DEGRADE
+    pools = governor.probe()['pools']
+    assert pools['memory-cache'] == 861_000   # inflated
+    assert pools['arena-pool'] == 1_000       # untouched
+    assert governor.pool_ranking()[0]['pool'] == 'memory-cache'
+
+
+def test_fault_site_default_inflation_breaches(governor, monkeypatch,
+                                               tmp_path):
+    monkeypatch.setenv('PETASTORM_TPU_FLIGHT_RECORDER', str(tmp_path))
+    monkeypatch.setenv('PETASTORM_TPU_FAULTS', 'mem-pressure:match=victim')
+    _armed(governor)
+    governor.register_pool('victim', lambda: 0)
+    assert governor.check() == STATE_BREACH   # bytes= defaults to the budget
+
+
+def test_fault_site_persists_across_ticks(governor, monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_FAULTS',
+                       'mem-pressure:match=p:bytes=700000')
+    _armed(governor)
+    governor.register_pool('p', lambda: 50_000)
+    assert governor.check() == STATE_ADVISORY
+    assert governor.check() == STATE_ADVISORY   # selected(), not consumed
+
+
+# ---------------------------------------------------------------------------
+# degrade hooks on the real pools
+# ---------------------------------------------------------------------------
+
+def test_memory_cache_evict_halves_then_empties():
+    from petastorm_tpu.cache import MemoryCache
+    cache = MemoryCache()
+    for i in range(8):
+        cache.get(i, lambda: np.zeros(1000, np.uint8))
+    assert cache.nbytes == 8000
+    freed = cache.evict()
+    assert freed >= 4000 and cache.nbytes <= 4000
+    while cache.nbytes:
+        cache.evict()
+    assert cache.nbytes == 0
+    # Evicted entries refill on the next miss — slower, never wrong.
+    assert cache.get(0, lambda: np.zeros(1000, np.uint8)).nbytes == 1000
+
+
+def test_chunk_store_accounting_and_mmap_close(tmp_path):
+    from petastorm_tpu.chunk_store import DecodedChunkStore
+    store = DecodedChunkStore(path=str(tmp_path))
+    cols = {'x': np.arange(4096, dtype=np.int64)}
+    for i in range(4):
+        store.get('key-{}'.format(i), lambda: dict(cols))
+    assert store.flush()
+    # Re-open them all as mmaps (hits).
+    for i in range(4):
+        store.get('key-{}'.format(i), lambda: dict(cols))
+    mapped = store.governed_nbytes()
+    assert mapped >= 4 * 4096 * 8
+    freed = store.close_lru_mmaps()
+    assert freed > 0
+    assert store.governed_nbytes() <= mapped - freed
+    # Dropped entries re-open on their next hit.
+    value = store.get('key-0', lambda: pytest.fail('must be a hit'))
+    np.testing.assert_array_equal(value['x'], cols['x'])
+    store.close()
+
+
+def test_chunk_store_spill_pause_sheds_then_releases(tmp_path):
+    """The advisory pause REFUSES new spill at enqueue (counted, never
+    silent) instead of pinning decoded bytes in a held queue — holding
+    the writer would make the relief rung itself sustain the pressure."""
+    from petastorm_tpu.chunk_store import DecodedChunkStore
+    store = DecodedChunkStore(path=str(tmp_path))
+    store.set_spill_paused(True)
+    store.get('k', lambda: {'x': np.arange(64, dtype=np.int64)})
+    assert store.flush()                     # nothing queued: no pinning
+    stats = store.stats()
+    assert stats['writes'] == 0
+    assert stats['write_skipped'] == 1       # counted, self-heals next epoch
+    assert stats['pending_write_bytes'] == 0
+    store.set_spill_paused(False)
+    store.get('k2', lambda: {'x': np.arange(64, dtype=np.int64)})
+    assert store.flush()
+    assert store.stats()['writes'] == 1
+    store.close()
+
+
+def test_lineage_pressure_shedding_counts_drops(tmp_path):
+    from petastorm_tpu import lineage as lineage_mod
+    tracker = lineage_mod.LineageTracker({'mode': 'test'},
+                                         ledger_dir=str(tmp_path))
+    try:
+        collector = tracker.collector
+        collector.on_chunk({'piece_index': 0}, 4)
+        collector.on_batch(4)
+        assert tracker.deliver() is not None
+        assert tracker.set_pressure_shedding(True) is True
+        assert tracker.set_pressure_shedding(True) is False   # transition-counted
+        collector.on_chunk({'piece_index': 1}, 4)
+        collector.on_batch(4)
+        record = tracker.deliver()
+        assert record is not None            # the ring still got it
+        stats = tracker.stats()
+        assert stats['pressure_dropped'] == 1
+        assert stats['dropped'] >= 1
+        tracker.set_pressure_shedding(False)
+        collector.on_chunk({'piece_index': 2}, 4)
+        collector.on_batch(4)
+        tracker.deliver()
+        assert tracker.flush()
+        assert tracker.stats()['pressure_dropped'] == 1   # shedding stopped
+    finally:
+        tracker.close()
+
+
+def test_shuffling_buffer_shrink_lowers_floor_and_releases_rows():
+    from petastorm_tpu.shuffling_buffer import RandomShufflingBuffer
+    buf = RandomShufflingBuffer(100, min_after_retrieve=10, seed=0)
+    buf.add_many([np.zeros(100, np.uint8) for _ in range(20)])
+    assert buf.nbytes > 0
+    assert buf.shrink_capacity() is True
+    assert buf.capacity == 50
+    # The decorrelation floor halves too — residency is set by the floor
+    # (retrieval stops at min_after buffered rows), so a cap-only shrink
+    # would free nothing.
+    assert buf._min_after_retrieve == 5
+    drained = 0
+    while buf.can_retrieve():
+        buf.retrieve()
+        drained += 1
+    assert buf.size == 5               # drained to the NEW floor
+    assert drained == 15
+    while buf.shrink_capacity():
+        pass
+    assert buf._min_after_retrieve == 1
+    # The cap ratchet floors at the resident rows (5 left after the
+    # drain) — never below what add_many already holds.
+    assert buf.capacity == 5
+    assert buf.shrink_capacity() is False
+
+
+def test_thread_pool_ventilation_queue_is_bounded():
+    from petastorm_tpu.workers.thread_pool import ThreadPool
+    pool = ThreadPool(1)
+    assert pool._ventilator_queue.maxsize > 0
+
+
+# ---------------------------------------------------------------------------
+# autotuner bias + watchdog classification
+# ---------------------------------------------------------------------------
+
+def test_autotuner_mem_shrink_bias():
+    from petastorm_tpu.autotune import AutoTuner, AutotuneConfig, Knob
+    values = {'prefetch': 6, 'workers': 4}
+
+    def knob(name, lo, hi):
+        return Knob(name, lambda: values[name],
+                    lambda v: values.__setitem__(name, v), lo, hi)
+
+    level = {'n': 0}
+    tuner = AutoTuner(
+        telemetry_fn=lambda: {'batches': 0, 'wait_s': 0.0},
+        knobs={'prefetch': knob('prefetch', 1, 8),
+               'workers': knob('workers', 1, 8)},
+        config=AutotuneConfig(interval_s=10, hysteresis=1, cooldown=0),
+        memory_state_fn=lambda: level['n'])
+    now = time.monotonic()
+    tuner.tick(now)
+    assert values == {'prefetch': 6, 'workers': 4}   # no pressure: untouched
+    level['n'] = 1
+    decision = tuner.tick(now + 1)
+    assert decision['action'] == 'mem-shrink'
+    assert values['prefetch'] == 5 and values['workers'] == 3
+    for i in range(10):
+        tuner.tick(now + 2 + i)
+    assert values['prefetch'] == 1 and values['workers'] == 1   # floored
+    assert tuner.tick(now + 60) is None   # nothing left to shrink
+    assert tuner.stats()['mem_shrinks'] >= 2
+
+
+def test_watchdog_classifies_memory_pressure():
+    from petastorm_tpu.health import (MEMORY_PRESSURE, SOFT_ONLY,
+                                      classify_stall)
+    # Starvation-shaped stall (a starved assembler would classify
+    # reader-starved): under active degradation this is the INTENDED
+    # load-shedding, so it reinterprets as memory-pressure.
+    starved = {'assemble': {'age_s': 99.0, 'state': 'reader-wait',
+                            'stall_timeout_s': 1.0, 'beats': 5}}
+    probes = {'memory': {'state': 'degrade', 'armed': True, 'frac': 0.9,
+                         'accounted_bytes': 900, 'budget_bytes': 1000}}
+    classification, stage, detail = classify_stall(starved, probes)
+    assert classification == MEMORY_PRESSURE
+    assert stage == 'memory'
+    assert 'degrade' in detail and 'reader-starved' in detail
+    assert MEMORY_PRESSURE in SOFT_ONLY
+    # Breach too: the governor's typed error is in flight — the watchdog
+    # must not race it with a hard PipelineStallError.
+    probes['memory']['state'] = 'breach'
+    assert classify_stall(starved, probes)[0] == MEMORY_PRESSURE
+    # A GENUINE fault under pressure keeps its own classification (and
+    # its hard escalation): a pipeline parked at 90% of budget must not
+    # hang forever behind a soft-only label.
+    wedged = {'assemble': {'age_s': 99.0, 'state': 'collate',
+                           'stall_timeout_s': 1.0, 'beats': 5}}
+    assert classify_stall(wedged, probes)[0] == 'assemble-stuck'
+    probes['worker-pool'] = {'dead_workers': [1]}
+    assert classify_stall(starved, probes)[0] == 'worker-pool-dead'
+    del probes['worker-pool']
+    # A STALE (disarmed) governor state must not soft-classify anything.
+    probes['memory'] = {'state': 'degrade', 'armed': False}
+    classification, _, _ = classify_stall(starved, probes)
+    assert classification != MEMORY_PRESSURE
+    # Without governor pressure the same beats blame the stage.
+    classification, _, _ = classify_stall(starved, {})
+    assert classification != MEMORY_PRESSURE
+
+
+# ---------------------------------------------------------------------------
+# arming lifecycle (refcounted sampler thread)
+# ---------------------------------------------------------------------------
+
+def _governor_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith('pst-mem-governor')]
+
+
+def test_arm_release_lifecycle(monkeypatch):
+    gov = MemoryGovernor(config=GovernorConfig(interval_s=0.02))
+    previous = membudget.set_governor(gov)
+    try:
+        monkeypatch.setenv(membudget.ENV_VAR, '64m')
+        assert membudget.maybe_arm_from_env() is True
+        assert membudget.maybe_arm_from_env() is True   # second owner
+        assert gov.armed and gov.budget == 64 << 20
+        assert any(t.is_alive() for t in _governor_threads())
+        gov.release()
+        assert any(t.is_alive() for t in _governor_threads())  # one owner left
+        gov.release()
+        deadline = time.monotonic() + 5
+        while any(t.is_alive() for t in _governor_threads()) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not any(t.is_alive() for t in _governor_threads())
+    finally:
+        while gov._arm_count > 0:
+            gov.release()
+        membudget.set_governor(previous)
+
+
+def test_maybe_arm_unset_env_is_noop(monkeypatch):
+    monkeypatch.delenv(membudget.ENV_VAR, raising=False)
+    gov = MemoryGovernor()
+    previous = membudget.set_governor(gov)
+    try:
+        assert membudget.maybe_arm_from_env() is False
+        assert not gov.armed
+    finally:
+        membudget.set_governor(previous)
+
+
+# ---------------------------------------------------------------------------
+# pipeline integration: pools register and the reader arms/releases
+# ---------------------------------------------------------------------------
+
+def test_reader_registers_pools_and_arms(tmp_path, monkeypatch):
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('MemSchema', [
+        UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False)])
+    url = 'file://' + str(tmp_path / 'dataset')
+    write_dataset(url, schema, [{'id': i} for i in range(20)],
+                  rows_per_row_group=5)
+    gov = MemoryGovernor(config=GovernorConfig(interval_s=0.05))
+    previous = membudget.set_governor(gov)
+    try:
+        monkeypatch.setenv(membudget.ENV_VAR, '1g')
+        with make_tensor_reader(url, reader_pool_type='thread',
+                                workers_count=1, num_epochs=1,
+                                cache_type='memory',
+                                shuffle_row_groups=False) as reader:
+            assert gov.armed
+            names = {h.name for h in gov._pools}
+            assert {'results-queue', 'memory-cache'} <= names
+            rows = list(reader)
+            assert rows
+            gov.check()
+            assert gov.probe()['accounted_bytes'] >= 0
+        assert gov._arm_count == 0   # teardown released
+    finally:
+        while gov._arm_count > 0:
+            gov.release()
+        membudget.set_governor(previous)
+
+
+def test_arm_with_malformed_budget_fails_loudly(monkeypatch):
+    """A typo'd budget must fail the run that set it — a governor that
+    silently stayed unarmed would hand the next OOM to the kernel."""
+    monkeypatch.setenv(membudget.ENV_VAR, '2gb')   # trailing 'b' typo
+    gov = MemoryGovernor()
+    previous = membudget.set_governor(gov)
+    try:
+        with pytest.raises(ValueError):
+            membudget.maybe_arm_from_env()
+        assert not gov.armed
+    finally:
+        membudget.set_governor(previous)
+
+
+def test_disarm_resets_ladder_and_releases_toggles():
+    """The last release must return the ladder to ok: surviving pools'
+    advisory/shed toggles disengage (a paused spill with no sampler to
+    unpause it would be forever), and the watchdog probe stops reporting
+    a stale degraded state."""
+    gov = MemoryGovernor(budget=1_000_000)
+    previous = membudget.set_governor(gov)
+    events = []
+    try:
+        gov.register_pool('p', lambda: 950_000,
+                          degrade_fn=lambda: True,
+                          degrade_release_fn=lambda: events.append('d-rel'),
+                          shed_fn=lambda a: events.append(('shed', a)),
+                          advisory_fn=lambda a: events.append(('adv', a)))
+        _armed(gov)
+        assert gov.check() == STATE_SHED
+        assert ('shed', True) in events
+        gov.release()
+        assert gov.probe()['state'] == STATE_OK
+        assert not gov.probe()['armed']
+        assert ('shed', False) in events
+        assert ('adv', False) in events
+        assert 'd-rel' in events
+        assert gov.stats()['transitions'][-1].get('reason') == 'disarmed'
+    finally:
+        while gov._arm_count > 0:
+            gov.release()
+        membudget.set_governor(previous)
+
+
+def test_shrink_capacity_never_undercuts_current_fill():
+    """The loader feeds add_many without a can_add gate — a shrink below
+    the resident rows would turn the next add into a RuntimeError."""
+    from petastorm_tpu.shuffling_buffer import RandomShufflingBuffer
+    buf = RandomShufflingBuffer(100, min_after_retrieve=80, seed=0,
+                                extra_capacity=10)
+    buf.add_many([np.zeros(8, np.uint8)] * 90)   # steady state near floor
+    assert buf.shrink_capacity() is True
+    assert buf.capacity == 90                    # clamped at current fill
+    buf.add_many([np.zeros(8, np.uint8)] * 5)    # still legal (extra)
+    # Drain below the new floor, then the ratchet continues downward.
+    while buf.can_retrieve():
+        buf.retrieve()
+    assert buf.shrink_capacity() is True
+    assert buf.capacity < 90
+
+
+def test_fault_site_bytes_param_accepts_suffixes(governor, monkeypatch):
+    _armed(governor)
+    monkeypatch.setenv('PETASTORM_TPU_FAULTS', 'mem-pressure:match=p:bytes=1m')
+    governor.register_pool('p', lambda: 0)
+    assert governor.check() == STATE_BREACH       # 1m >= the 1MB budget
+    assert governor.probe()['pools']['p'] == 1 << 20
+
+
+def test_shed_toggle_reassert_is_idempotent(tmp_path, monkeypatch):
+    """A reader built while the ladder already sits at shed gets the
+    toggle fired at registration AND again by the sampler's transition
+    pass — the save/restore of the ventilation watermark must survive
+    the double-fire (restore the pre-shed value, not the tight one)."""
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('S', [
+        UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False)])
+    url = 'file://' + str(tmp_path / 'ds')
+    write_dataset(url, schema, [{'id': i} for i in range(10)],
+                  rows_per_row_group=5)
+    gov = MemoryGovernor(budget=1_000_000)
+    previous = membudget.set_governor(gov)
+    monkeypatch.setenv(membudget.ENV_VAR, '1000000')
+    monkeypatch.setenv('PETASTORM_TPU_FAULTS',
+                       'mem-pressure:match=results:bytes=950000')
+    try:
+        with make_tensor_reader(url, reader_pool_type='thread',
+                                workers_count=1, num_epochs=None,
+                                shuffle_row_groups=False) as reader:
+            gov.check()
+            assert gov.probe()['state'] == STATE_SHED
+            pool = reader._workers_pool
+            tight = pool.results_watermark
+            assert tight is not None
+            # Double-fire the toggle the way a registration race would.
+            reader._shed_ventilation(True)
+            assert pool.results_watermark == tight
+            monkeypatch.setenv('PETASTORM_TPU_FAULTS', '')
+            gov.check()
+            assert gov.probe()['state'] == STATE_OK
+            assert pool.results_watermark is None   # pre-shed value back
+    finally:
+        while gov._arm_count > 0:
+            gov.release()
+        membudget.set_governor(previous)

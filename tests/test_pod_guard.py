@@ -127,10 +127,25 @@ def _run_two_process_consensus(mode, tmp_path, timeout=180):
     return results
 
 
+def _skip_if_cpu_multiprocess_unsupported(*outcomes):
+    """Capability gate: some jax builds cannot run multi-process
+    collectives on the CPU backend at all ("Multiprocess computations
+    aren't implemented on the CPU backend"). That is a missing platform
+    capability, not a pod-guard regression — skip with the reason rather
+    than failing identically on every tree."""
+    import pytest as _pytest
+    for outcome in outcomes:
+        if "Multiprocess computations aren't implemented" in outcome:
+            _pytest.skip('this jax build does not support 2-process '
+                         'jax.distributed collectives on the CPU backend: '
+                         '{!r}'.format(outcome))
+
+
 def test_two_process_peer_failure_aborts_healthy_host(tmp_path):
     """Real 2-process jax.distributed consensus: host 1's pipeline raises,
     host 0 must get PodAbortError instead of wedging (VERDICT r1 next #6)."""
     (out0, n0), (out1, n1) = _run_two_process_consensus('fail', tmp_path)
+    _skip_if_cpu_multiprocess_unsupported(out0, out1)
     assert out1.startswith('local_error:simulated input failure')
     assert n1 == 2
     assert out0 == 'pod_abort'
@@ -139,6 +154,7 @@ def test_two_process_peer_failure_aborts_healthy_host(tmp_path):
 
 def test_two_process_uneven_tails_stop_together(tmp_path):
     (out0, n0), (out1, n1) = _run_two_process_consensus('uneven', tmp_path)
+    _skip_if_cpu_multiprocess_unsupported(out0, out1)
     assert out0 == 'completed' and out1 == 'completed'
     assert n1 == 3
     assert n0 == 3  # longer shard stops at the shorter shard's tail

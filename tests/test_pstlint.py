@@ -567,7 +567,7 @@ def test_registry_thread_prefixes_cover_live_thread_names():
                  'pst-lineage-writer', 'pst-chunk-store-writer',
                  'pst-ventilator', 'pst-staging-assemble',
                  'pst-data-service-serve', 'pst-pool-worker-3',
-                 'pst-orphan-watch'):
+                 'pst-orphan-watch', 'pst-mem-governor'):
         assert any(name.startswith(p) for p in prefixes), name
     for guard in registry.THREAD_GUARDS:
         assert guard.prefix.startswith('pst-')
@@ -927,3 +927,103 @@ def test_seeded_lock_inversion_silent_when_unarmed(monkeypatch):
     spec = {'x': ((2, 3), np.dtype(np.float32))}
     delivered = _run_engine(ArenaPool(depth=2), spec)
     assert delivered[-1] is _END
+
+
+# ---------------------------------------------------------------------------
+# bounded-queues checker (ISSUE 12 satellite): every queue.Queue in the
+# package carries an explicit maxsize or a reasoned suppression
+# ---------------------------------------------------------------------------
+
+def test_bounded_queues_flags_unbounded_constructions(tmp_path):
+    from petastorm_tpu.analysis import bounded_queues
+    project = _project(tmp_path, {'m.py': '''
+        import queue
+        from queue import LifoQueue
+        from queue import Queue as Q
+
+        a = queue.Queue()
+        b = LifoQueue()
+        c = Q()
+        d = queue.Queue(maxsize=0)      # the stdlib "infinite" spelling
+        e = queue.Queue(maxsize=-1)     # ...and its negative spelling
+        f = queue.SimpleQueue()         # can never be bounded
+    '''})
+    findings = bounded_queues.check(project)
+    assert len(findings) == 6
+    assert all(f.check == 'bounded-queues' for f in findings)
+    assert any('SimpleQueue' in f.message for f in findings)
+
+
+def test_bounded_queues_accepts_explicit_bounds(tmp_path):
+    from petastorm_tpu.analysis import bounded_queues
+    project = _project(tmp_path, {'m.py': '''
+        import queue
+        from queue import Queue
+
+        DEPTH = 16
+        a = queue.Queue(maxsize=5)
+        b = queue.Queue(50)                  # positional counts too
+        c = Queue(maxsize=DEPTH)             # named bound counts
+        d = queue.Queue(maxsize=max(1, DEPTH))
+        e = queue.PriorityQueue(maxsize=2)
+        not_a_queue = dict(maxsize=0)
+    '''})
+    assert bounded_queues.check(project) == []
+
+
+def test_bounded_queues_suppression_needs_reason(tmp_path):
+    from petastorm_tpu.analysis import bounded_queues
+    project = _project(tmp_path, {'m.py': '''
+        import queue
+        a = queue.Queue()  # pstlint: disable=bounded-queues(drained every tick by the owner loop; growth bounded by tick items)
+        b = queue.Queue()  # pstlint: disable=bounded-queues
+    '''})
+    findings = core.apply_suppressions(
+        project, bounded_queues.check(project),
+        {'bounded-queues', 'suppression'})
+    checks = sorted(f.check for f in findings)
+    assert checks == ['bounded-queues', 'suppression']
+
+
+def test_bounded_queues_in_driver_and_cli():
+    from petastorm_tpu import analysis
+    assert 'bounded-queues' in analysis.CHECKS
+    assert 'bounded-queues' in _run_cli('--list-checks').stdout
+
+
+def test_thread_pool_ventilation_queue_sized_from_window():
+    """The one historically unbounded cross-thread channel: the ThreadPool
+    ventilation queue is bounded at construction and re-sized to the
+    ventilator's in-flight window at start()."""
+    from petastorm_tpu.workers.thread_pool import ThreadPool
+    from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+
+    class _NopWorker(object):
+        def __init__(self, worker_id, publish_func, args):
+            self.worker_id = worker_id
+
+        def initialize(self):
+            pass
+
+        def process(self, **kw):
+            pass
+
+        def shutdown(self):
+            pass
+
+    pool = ThreadPool(1)
+    assert pool._ventilator_queue.maxsize > 0
+    ventilator = ConcurrentVentilator(None, [{'value': i} for i in range(9)],
+                                      iterations=1,
+                                      max_ventilation_queue_size=3)
+    pool.start(_NopWorker, None, ventilator)
+    try:
+        assert pool._ventilator_queue.maxsize == 3
+        while True:
+            try:
+                pool.get_results()
+            except Exception:
+                break
+    finally:
+        pool.stop()
+        pool.join()
