@@ -590,6 +590,73 @@ def _measure_cache_tier(url, workers, batch, warm, measure, kwargs, out, tier):
     out[tier] = record
 
 
+def _decode_path_sweep(url):
+    """Cold-path img/s per decode path (ISSUE 13): ``scalar`` (one native
+    call per image — the pre-batched behavior), ``batched`` (one native
+    call per (row-group, field), fanned across the decode-thread budget),
+    and ``chunk-store-warm`` (pre-transcoded via ``tools.transcode`` — no
+    JPEG ever touched). Decode-bound protocol: ONE pool worker and a cold
+    cache, so the scalar row is a single decode thread and the batched
+    row is that worker spending the whole thread budget — the per-worker
+    speedup 2605.08731's single-thread analysis says is recoverable. The
+    ``ratio_batched_vs_scalar`` >= ``gate_min_ratio`` (1.5x) acceptance
+    gate rides the stage profile."""
+    import shutil
+    import tempfile as tempfile_mod
+
+    from petastorm_tpu import make_tensor_reader
+    from petastorm_tpu.codecs import DECODE_PATH_ENV
+    from petastorm_tpu.tools.transcode import transcode_dataset
+
+    workers = int(os.environ.get('BENCH_PIPELINE_DECODE_WORKERS', '1'))
+    out = {'workers': workers}
+
+    def _measure(**reader_kwargs):
+        reader = make_tensor_reader(
+            url, schema_fields=['image', 'label'],
+            reader_pool_type='thread', workers_count=workers,
+            num_epochs=1, shuffle_row_groups=False, autotune=False,
+            **reader_kwargs)
+        with reader:
+            t0 = time.perf_counter()
+            images = sum(len(chunk.image) for chunk in reader)
+            elapsed = time.perf_counter() - t0
+            timings = dict(reader.stage_timings)
+        return {'img_per_sec': round(images / elapsed, 2),
+                'images': images,
+                'wall_s': round(elapsed, 4),
+                'read_s': round(timings.get('read_s', 0.0), 4),
+                'decode_s': round(timings.get('decode_s', 0.0), 4)}
+
+    saved = os.environ.get(DECODE_PATH_ENV)
+    store_dir = tempfile_mod.mkdtemp(prefix='pst-chunk-store-decode-sweep-')
+    try:
+        os.environ[DECODE_PATH_ENV] = 'scalar'
+        out['scalar'] = _measure(cache_type='null')
+        os.environ[DECODE_PATH_ENV] = 'batched'
+        out['batched'] = _measure(cache_type='null')
+        transcode_dataset(url, store_dir, schema_fields=['image', 'label'],
+                          workers_count=max(2, workers))
+        out['chunk-store-warm'] = _measure(cache_type='chunk-store',
+                                           cache_location=store_dir)
+    except Exception as e:  # noqa: BLE001 - a failed sweep row must not
+        # discard the child's already-measured results
+        out['error'] = '{}: {}'.format(type(e).__name__, e)
+    finally:
+        if saved is None:
+            os.environ.pop(DECODE_PATH_ENV, None)
+        else:
+            os.environ[DECODE_PATH_ENV] = saved
+        shutil.rmtree(store_dir, ignore_errors=True)
+    scalar_rate = (out.get('scalar') or {}).get('img_per_sec')
+    batched_rate = (out.get('batched') or {}).get('img_per_sec')
+    if scalar_rate and batched_rate:
+        out['ratio_batched_vs_scalar'] = round(batched_rate / scalar_rate, 4)
+        out['gate_min_ratio'] = 1.5
+        out['gate_passed'] = out['ratio_batched_vs_scalar'] >= 1.5
+    return out
+
+
 def _child_pipeline(url, workers, cache_tiers=None):
     """Loader-only pipeline capacity (VERDICT r4 #2): the same tensor reader +
     JaxLoader path as the imagenet child but with NO train step — measures how
@@ -761,6 +828,12 @@ def _child_pipeline(url, workers, cache_tiers=None):
     if cache_tiers:
         profile['cache_tier_sweep'] = _cache_tier_sweep(
             url, workers, batch, cache_tiers.split(','))
+    # Decode-path sweep (ISSUE 13): scalar vs batched vs chunk-store-warm
+    # on the decode-bound (1-worker, cold-cache) config, with the 1.5x
+    # batched-vs-scalar ratio gate. On by default so every BENCH round
+    # records the decode block; BENCH_PIPELINE_DECODE_SWEEP=0 skips.
+    if os.environ.get('BENCH_PIPELINE_DECODE_SWEEP', '1') == '1':
+        profile['decode_path_sweep'] = _decode_path_sweep(url)
     out = {
         'pipeline_img_per_sec': round(median, 2),
         'pipeline_img_per_sec_reps': [round(r, 2) for r in rates],

@@ -12,6 +12,8 @@ __version__ = '0.1.0'
 
 from petastorm_tpu.autotune import AutotuneConfig  # noqa: F401
 from petastorm_tpu.chunk_store import DecodedChunkStore  # noqa: F401
+from petastorm_tpu.decode_budget import (  # noqa: F401
+    DecodeThreadBudget, get_decode_budget)
 from petastorm_tpu.determinism import (DeterministicCursor,  # noqa: F401
                                        det_tag_cursor, merge_cursors)
 from petastorm_tpu.converter import make_converter  # noqa: F401

@@ -111,6 +111,7 @@ class AutotuneConfig(object):
                  min_inflight=1, max_inflight=8,
                  min_arena_depth=2, max_arena_depth=16,
                  min_watermark=4,
+                 min_decode_threads=1, max_decode_threads=None,
                  starve_frac=0.05, signal_frac=0.05):
         if interval_s <= 0:
             raise ValueError('interval_s must be positive, got {}'.format(interval_s))
@@ -132,6 +133,14 @@ class AutotuneConfig(object):
         self.min_arena_depth = max(1, int(min_arena_depth))
         self.max_arena_depth = max(self.min_arena_depth, int(max_arena_depth))
         self.min_watermark = max(2, int(min_watermark))
+        self.min_decode_threads = max(1, int(min_decode_threads))
+        if max_decode_threads is None:
+            # Decode threads are GIL-free C++ — mild oversubscription
+            # hides IO bubbles, heavy oversubscription just context-
+            # switches (2605.08731's single-thread-decode analysis).
+            max_decode_threads = 2 * (os.cpu_count() or 4)
+        self.max_decode_threads = max(self.min_decode_threads,
+                                      int(max_decode_threads))
         # Below this fraction of wall time blocked, the consumer counts as
         # "kept fed"; above it, the biggest stage-wait fraction must also
         # clear signal_frac to earn the blame.
@@ -229,20 +238,31 @@ def classify_reader(deltas, gauges, dt, config):
 
 
 # Per-classification grow preferences: the first listed knob that exists
-# and is not already at its clamp takes one additive step.
+# and is not already at its clamp takes one additive step. ``input-bound``
+# (the pipeline's own work is the limit — on image workloads that work IS
+# decode) grows native decode parallelism FIRST: widening the GIL-free
+# C++ decode pool attacks the bottleneck directly, where another Python
+# worker mostly adds scheduling overhead; workers remain the fallback
+# once the thread budget clamps. ``reader-starved`` keeps workers first
+# (a standalone reader's signal — the queue is empty because too few
+# row-groups are in flight) with decode threads as its second lever.
 _GROW_ACTIONS = {
-    READER_STARVED: (('workers', 1), ('results_watermark', 8)),
-    INPUT_BOUND: (('workers', 1),),
+    READER_STARVED: (('workers', 1), ('decode_threads', 2),
+                     ('results_watermark', 8)),
+    INPUT_BOUND: (('decode_threads', 2), ('workers', 1)),
     DISPATCH_BOUND: (('inflight', 1), ('prefetch', 1)),
     ARENA_BOUND: (('arena_depth', 2),),
 }
 
 # Consumer-bound shrink: one step down on every present knob (release
-# memory), with the ventilation watermark tightened hardest — over-
+# memory/CPU), with the ventilation watermark tightened hardest — over-
 # ventilating row-groups into a saturated results queue only pins memory
-# and stretches tail latency.
+# and stretches tail latency. decode_threads participates (incl. the
+# governor's mem-shrink sweep): a pipeline ahead of its consumer has no
+# business saturating the host's cores either.
 _SHRINK_STEPS = (('workers', 1), ('prefetch', 1), ('inflight', 1),
-                 ('arena_depth', 2), ('results_watermark', 8))
+                 ('arena_depth', 2), ('decode_threads', 2),
+                 ('results_watermark', 8))
 
 # Cumulative telemetry counters (everything else is a gauge).
 _CUMULATIVE_KEYS = ('batches', 'wait_s', 'reader_wait_s', 'arena_wait_s',

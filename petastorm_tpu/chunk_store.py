@@ -54,6 +54,12 @@ Activation: ``cache_type='chunk-store'`` on the reader factories (location
 from ``cache_location`` or the ``PETASTORM_TPU_CHUNK_STORE`` environment
 variable), or set the env var alone — ``make_tensor_reader`` with the
 default ``cache_type`` then adopts the store without a code change.
+
+Offline pre-fill: ``python -m petastorm_tpu.tools.transcode`` walks a
+dataset through the tensor decode path once and publishes every chunk via
+this module's flock'd single-writer protocol, so a production job's
+epoch 0 already serves from the store (``decode_s`` = 0) — the
+``pre-transcoded`` row of the decode-paths table (docs/tpu_guide.rst).
 """
 
 import hashlib

@@ -15,6 +15,7 @@ TPU-first differences from the reference:
 """
 
 import io
+import os
 import warnings
 
 import numpy as np
@@ -434,3 +435,185 @@ class CompressedImageCodec(DataframeColumnCodec):
 
 if not _HAS_CV2 and not _HAS_PIL:  # pragma: no cover
     warnings.warn('Neither cv2 nor PIL available: CompressedImageCodec disabled')
+
+
+# --------------------------------------------------------------------------
+# batched image-column decode (the worker fast path)
+# --------------------------------------------------------------------------
+
+#: Decode-path override: ``scalar`` forces one native call per image (the
+#: pre-batched behavior — the bench sweep's baseline and the determinism
+#: acceptance gate's reference stream); ``batched``/``auto``/unset keep the
+#: default one-native-call-per-(row-group, field) fast path. Read per call
+#: (like PETASTORM_TPU_FAULTS) so tests and bench sweeps flip it between
+#: readers in one process.
+DECODE_PATH_ENV = 'PETASTORM_TPU_DECODE_PATH'
+
+#: Deliberately unguessable stand-in blob for the ``decode-corrupt-batch``
+#: fault site: fails the container sniff (neither JPEG nor PNG magic), so
+#: the native batch call reports PST_ERR_FORMAT for exactly that slot and
+#: the per-cell fallback fails the same way — the real corrupt-image path.
+_CORRUPT_BLOB = b'\xde\xad not-an-image \xbe\xef'
+
+
+def decode_path():
+    """Resolve :data:`DECODE_PATH_ENV`: ``'batched'`` (default) or
+    ``'scalar'``; anything else raises (a typo must not silently run the
+    slow path)."""
+    raw = os.environ.get(DECODE_PATH_ENV, '').strip().lower()
+    if raw in ('', 'auto', 'batched'):
+        return 'batched'
+    if raw == 'scalar':
+        return 'scalar'
+    raise ValueError('{} must be "batched" or "scalar", got {!r}'.format(
+        DECODE_PATH_ENV, raw))
+
+
+def _resolve_decode_threads(decode_threads):
+    """``None`` means "my fair share of the process budget" — resolved at
+    call time so a live ``ThreadPool.resize()`` or an autotuner
+    ``decode_threads`` step takes effect on the very next row-group."""
+    if decode_threads is not None:
+        return max(1, int(decode_threads))
+    from petastorm_tpu import decode_budget
+    return decode_budget.get_budget().share()
+
+
+def _decode_cell_into(out, i, field, codec, blob, native_error=None):
+    """Per-image decode of stream ``i`` into ``out[i]`` — the scalar path's
+    body and the batched path's per-slot fallback. Byte-identical to a
+    successful batched slot: both end as the codec's decoded, channel-
+    conformed pixels in the same block row."""
+    from petastorm_tpu.errors import DecodeFieldError
+    try:
+        value = np.asarray(codec.decode(field, blob))
+    except Exception as e:
+        raise DecodeFieldError(
+            'Image {} of field {!r} failed to decode: {}'.format(
+                i, field.name, e),
+            native_error=native_error) from e
+    if value.shape != out.shape[1:]:
+        # Exact-shape, never broadcast: numpy would happily repeat a
+        # mis-sized decode (e.g. a 1x1 stream) across the slot — the
+        # batched path raises on such streams and this path must match.
+        raise DecodeFieldError(
+            'Image {} of field {!r} decodes to shape {}, declared {}'
+            .format(i, field.name, value.shape, tuple(field.shape)))
+    out[i] = value
+
+
+def decode_image_batch_into(field, out, blob_fn, ptrs=None, lens=None,
+                            decode_threads=None, fault_key=None):
+    """Decode ``len(out)`` encoded JPEG/PNG streams into ``out[i]`` slots.
+
+    The worker fast path: ONE native call per (row-group, field) fanning
+    across the process's fair-shared decode threads
+    (:mod:`petastorm_tpu.decode_budget`), writing each image straight into
+    its slot of the caller's contiguous block — zero intermediate
+    per-image ndarrays.
+
+    :param field: the Unischema image field (shape/dtype/codec authority).
+    :param out: C-contiguous ``[N, ...field.shape]`` destination block.
+    :param blob_fn: ``i -> bytes`` of stream ``i`` — called lazily, only
+        for the scalar path and per-slot fallbacks (the batched native
+        call uses ``ptrs``/``lens`` pointer math when provided and never
+        materializes per-cell ``bytes``).
+    :param ptrs/lens: optional integer arrays of blob addresses/sizes
+        (e.g. :func:`~petastorm_tpu.tensor_worker._binary_column_view`
+        over an Arrow BinaryArray). Built from ``blob_fn`` when omitted.
+    :param decode_threads: C++ threads for the batched call; ``None`` =
+        the current fair share of ``PETASTORM_TPU_DECODE_THREADS``.
+    :param fault_key: row-group identity for the ``decode-corrupt-batch``
+        fault site (one poisoned blob inside an otherwise-good batch; the
+        resulting :class:`~petastorm_tpu.errors.DecodeFieldError` carries
+        the native error string and fails only this row-group).
+
+    Returns the number of per-slot fallback decodes (0 on the pure fast
+    path). ``PETASTORM_TPU_DECODE_PATH=scalar`` and a missing native
+    extension both take the per-image loop instead — byte-identical
+    output, proven by the forced-fallback parity test.
+    """
+    from petastorm_tpu import metrics
+    from petastorm_tpu.errors import DecodeFieldError
+    from petastorm_tpu.faults import get_injector
+
+    n = len(out)
+    if n == 0:
+        return 0
+    codec = field.resolved_codec()
+    poisoned = None
+    if fault_key is not None and get_injector().should_fire(
+            'decode-corrupt-batch', key=fault_key):
+        # Poison slot 0 with a non-image blob: the batch call must fail
+        # exactly this slot (and thereby this row-group), never the
+        # neighbors decoded by the same native call.
+        poisoned = _CORRUPT_BLOB
+        real_blob_fn = blob_fn
+        blob_fn = lambda i, _real=real_blob_fn: (  # noqa: E731
+            poisoned if i == 0 else _real(i))
+
+    native = _native_image()
+    batched = (native is not None and decode_path() == 'batched'
+               and out.dtype == np.uint8)
+    if not batched:
+        for i in range(n):
+            _decode_cell_into(out, i, field, codec, blob_fn(i))
+        return 0
+
+    keepalive = []
+    if ptrs is None or lens is None:
+        blobs = [blob_fn(i) for i in range(n)]
+        views = [np.frombuffer(b, dtype=np.uint8) for b in blobs]
+        keepalive.extend(views)      # the address views alias the bytes
+        ptrs = [v.ctypes.data for v in views]
+        lens = [len(b) for b in blobs]
+    elif poisoned is not None:
+        poison_view = np.frombuffer(poisoned, dtype=np.uint8)
+        keepalive.append(poison_view)
+        ptrs = np.array(ptrs, dtype=np.int64)
+        lens = np.array(lens, dtype=np.int64)
+        ptrs[0] = poison_view.ctypes.data
+        lens[0] = len(poisoned)
+
+    results, chs, hs, ws = native.decode_batch_into(
+        ptrs, lens, out, num_threads=_resolve_decode_threads(decode_threads))
+    del keepalive
+    metrics.counter('pst_decode_batch_calls_total',
+                    'Batched native image decode calls (one per '
+                    '(row-group, field) on the fast path)').inc()
+    metrics.counter('pst_decode_batch_images_total',
+                    'Images decoded through the batched native fast '
+                    'path').inc(n)
+
+    want_ch = field.shape[2] if len(field.shape) == 3 else 1
+    want_h, want_w = field.shape[0], field.shape[1]
+    fallbacks = 0
+    for i in range(n):
+        if results[i] != 0:
+            # Slot decode failed — commonly an RGBA/16-bit stream whose
+            # native layout exceeds the RGB-capacity slot ('buffer too
+            # small' fires before the channel count is knowable). The
+            # per-cell fallback decodes unconstrained and conforms
+            # channels; a truly corrupt stream fails there too and the
+            # DecodeFieldError carries the native error string for the
+            # quarantine record.
+            fallbacks += 1
+            _decode_cell_into(out, i, field, codec, blob_fn(i),
+                              native_error=native.decode_error_message(
+                                  results[i]))
+            continue
+        if hs[i] != want_h or ws[i] != want_w:
+            raise DecodeFieldError(
+                'Image {} of field {!r} decodes to {}x{}, declared {}x{}'
+                .format(i, field.name, hs[i], ws[i], want_h, want_w))
+        if chs[i] != want_ch:
+            # Gray stream inside an RGB field: the slot holds a partial
+            # channel layout; conform from a clean per-cell decode.
+            out[i] = CompressedImageCodec.conform_channels(
+                native.decode_image(blob_fn(i)), field)
+            fallbacks += 1
+    if fallbacks:
+        metrics.counter('pst_decode_batch_fallbacks_total',
+                        'Per-image fallback decodes after a batched call '
+                        '(failed or channel-mismatched slots)').inc(fallbacks)
+    return fallbacks

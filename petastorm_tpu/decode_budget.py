@@ -1,0 +1,159 @@
+"""Process-wide native decode-thread budget, fair-shared across pool workers.
+
+The native image codec (``native/src/image_codec.cc``) fans each batched
+decode call across its own C++ thread pool. Before this module, every
+worker computed a *static* fair share at reader construction
+(``cores // workers``) — correct at construction time and wrong forever
+after: a live ``ThreadPool.resize()`` (the autotuner's workers knob)
+changed the worker count without changing anyone's thread allotment, and
+two readers in one process each assumed they owned the whole host.
+
+:class:`DecodeThreadBudget` centralizes the arithmetic:
+
+* the **total** comes from ``PETASTORM_TPU_DECODE_THREADS`` (default: the
+  host's cores) and is itself a live autotuner knob (``decode_threads``) —
+  an ``input-bound`` classification grows decode parallelism directly
+  instead of blindly ratcheting workers;
+* every in-process worker pool **registers** its worker count
+  (:meth:`register_pool` -> :class:`PoolShare`), and
+  ``ThreadPool.resize()`` re-divides the budget through
+  :meth:`PoolShare.resize` the moment the pool grows or shrinks;
+* each decode call asks :meth:`share` for the *current* per-worker fair
+  share — ``max(1, total // sum(registered workers))`` — so N concurrent
+  workers never oversubscribe the host no matter how the pool churns.
+
+Process pools cannot share a live Python object; their workers keep a
+static allotment computed from the same env-resolved total at construction
+(they cannot resize either, so the static number stays correct).
+"""
+
+import os
+import threading
+
+ENV_VAR = 'PETASTORM_TPU_DECODE_THREADS'
+
+
+def default_total():
+    """The process's decode-thread budget: ``PETASTORM_TPU_DECODE_THREADS``
+    when set (a positive integer), else the host's core count."""
+    raw = os.environ.get(ENV_VAR, '').strip()
+    if raw:
+        try:
+            value = int(raw)
+        except ValueError:
+            raise ValueError(
+                '{} must be a positive integer, got {!r}'.format(ENV_VAR, raw))
+        if value <= 0:
+            raise ValueError(
+                '{} must be a positive integer, got {!r}'.format(ENV_VAR, raw))
+        return value
+    return os.cpu_count() or 4
+
+
+class PoolShare(object):
+    """One registered worker pool's stake in the process budget.
+
+    Handed out by :meth:`DecodeThreadBudget.register_pool`; the owner
+    calls :meth:`resize` on live pool resizes and :meth:`release` at
+    teardown (idempotent — a released share stops counting toward the
+    fair-share divisor)."""
+
+    def __init__(self, budget, key):
+        self._budget = budget
+        self._key = key
+
+    def resize(self, workers):
+        self._budget._resize(self._key, workers)
+
+    def release(self):
+        self._budget._release(self._key)
+
+    @property
+    def share(self):
+        """This pool's current per-worker thread allotment."""
+        return self._budget.share()
+
+
+class DecodeThreadBudget(object):
+    """Fair-share accountant over the process's native decode threads."""
+
+    def __init__(self, total=None):
+        self._lock = threading.Lock()
+        self._total = int(total) if total else default_total()
+        self._pools = {}          # key -> workers
+        self._next_key = 0
+
+    @property
+    def total(self):
+        return self._total
+
+    def set_total(self, n):
+        """Autotuner hookup (the ``decode_threads`` knob): retarget the
+        process-wide budget at runtime. Takes effect on the next decode
+        call of every sharing worker — the C++ pool is per-call, so there
+        is no live pool to rethread."""
+        n = int(n)
+        if n < 1:
+            raise ValueError('decode thread budget must be >= 1, got {}'.format(n))
+        self._total = n
+
+    def register_pool(self, workers):
+        """Add ``workers`` concurrent decode clients to the fair-share
+        divisor; returns the :class:`PoolShare` handle that re-divides on
+        resize and unregisters on release."""
+        with self._lock:
+            key = self._next_key
+            self._next_key += 1
+            self._pools[key] = max(1, int(workers))
+        return PoolShare(self, key)
+
+    def _resize(self, key, workers):
+        with self._lock:
+            if key in self._pools:
+                self._pools[key] = max(1, int(workers))
+
+    def _release(self, key):
+        with self._lock:
+            self._pools.pop(key, None)
+
+    def sharers(self):
+        """Total registered concurrent decode clients (0 when no pool is
+        registered — e.g. process pools, whose workers budget statically)."""
+        with self._lock:
+            return sum(self._pools.values())
+
+    def share(self):
+        """The per-worker fair share right now: ``total`` split across
+        every registered worker, floor 1. With nothing registered (a
+        standalone decode, the transcode ETL, the loader's staging-step
+        decode) the caller is presumed alone and gets the whole budget."""
+        workers = self.sharers()
+        return max(1, self._total // workers) if workers else self._total
+
+
+_budget = None
+_budget_lock = threading.Lock()
+
+
+def get_budget():
+    """The process-wide budget (total resolved from the environment on
+    first use)."""
+    global _budget
+    if _budget is None:
+        with _budget_lock:
+            if _budget is None:
+                _budget = DecodeThreadBudget()
+    return _budget
+
+
+def set_budget(budget):
+    """Test isolation hook (mirrors ``metrics.set_registry``). Returns the
+    previous budget."""
+    global _budget
+    with _budget_lock:
+        previous, _budget = _budget, budget
+    return previous
+
+
+#: Package-level export name (``petastorm_tpu.get_decode_budget``).
+get_decode_budget = get_budget

@@ -65,7 +65,18 @@ class DecodeFieldError(PetastormTpuError):
     """Raised when a field value cannot be decoded by its codec.
 
     Parity: reference ``petastorm/utils.py:50``.
+
+    ``native_error`` (optional) carries the native codec's own error string
+    (``native.image.decode_error_message``) when the failure came out of
+    the C++ batch decoder — quarantine records surface it so a poisoned
+    image reads as e.g. ``'not a JPEG or PNG stream'`` in
+    ``Reader.diagnostics()['quarantined_rowgroups']`` instead of a bare
+    exception repr.
     """
+
+    def __init__(self, message, native_error=None):
+        super().__init__(message)
+        self.native_error = native_error
 
 
 class SchemaError(PetastormTpuError):

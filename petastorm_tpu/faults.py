@@ -19,6 +19,16 @@ Sites and their effects when they fire:
 ``fs-read-error``    raise ``IOError`` at the row-group read / filesystem call
 ``fs-read-delay``    sleep ``delay`` seconds at the same points
 ``decode-corrupt``   raise ``DecodeFieldError`` before codec decode
+``decode-corrupt-batch`` poison ONE blob inside an otherwise-good batched
+                     native decode call (``codecs.decode_image_batch_into``
+                     swaps slot 0's pointer for a non-image buffer): the
+                     native call fails exactly that slot, the per-cell
+                     fallback fails the same way, and the resulting
+                     ``DecodeFieldError`` carries the native error string
+                     — proving a single poison image quarantines only its
+                     own row-group, never the neighbors decoded by the
+                     same call. Consumed via ``should_fire`` keyed by the
+                     row-group fault key.
 ``worker-kill``      ``SIGKILL`` the current (worker) process
 ``queue-stall``      sleep ``delay`` seconds before publishing a result
 ``device-put-delay`` sleep ``delay`` seconds in the loader's device staging
@@ -114,6 +124,7 @@ KNOWN_SITES = (
     'fs-read-error',
     'fs-read-delay',
     'decode-corrupt',
+    'decode-corrupt-batch',
     'worker-kill',
     'queue-stall',
     'device-put-delay',

@@ -133,6 +133,25 @@ def _sanitize_array(array, x64=False):
 # host-side batch assembly (no jax dependency — independently testable)
 # --------------------------------------------------------------------------
 
+#: Optional on-device image decode op (``register_device_decode``): when a
+#: backend exposes a real JPEG->tensor op inside XLA, registering it here
+#: makes the loader ship raw bytes all the way to the device. No such op
+#: exists on stock CPU/TPU jax — the staging step then host-decodes via
+#: the native batched codec (the documented fallback), which still moves
+#: decode OFF the worker pool and NEXT to the transfer.
+_DEVICE_DECODE_HOOK = None
+
+
+def register_device_decode(fn):
+    """Register ``fn(encoded_column, shape, dtype) -> device array`` as the
+    on-device image decode op (``encoded_column`` is an object ndarray of
+    JPEG/PNG bytes; the result must be a ``[N, *shape]`` device array).
+    Pass ``None`` to clear. Returns the previously registered hook."""
+    global _DEVICE_DECODE_HOOK
+    previous, _DEVICE_DECODE_HOOK = _DEVICE_DECODE_HOOK, fn
+    return previous
+
+
 def _build_shuffling_buffer(capacity, min_after_dequeue, seed):
     """The one shuffling-buffer construction shared by ``JaxLoader`` and
     standalone ``iter_numpy_batches`` callers — same decorrelation floor
@@ -148,7 +167,8 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
                        shuffling_queue_capacity=0, min_after_dequeue=None,
                        seed=None, last_batch='drop', x64=False,
                        strict_fields=False, batch_buffers=None, views_ok=True,
-                       lineage=None, shuffler=None, commit_rows=None):
+                       lineage=None, shuffler=None, commit_rows=None,
+                       raw_fields=None):
     """Yield dicts of numpy arrays with exact leading dim ``batch_size``.
 
     Works over both row readers (``make_reader``) and batch readers
@@ -184,6 +204,9 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
     if last_batch not in ('drop', 'pad', 'partial'):
         raise ValueError("last_batch must be drop|pad|partial, got {!r}".format(last_batch))
     shape_policies = dict(shape_policies or {})
+    raw_fields = tuple(raw_fields
+                       if raw_fields is not None
+                       else getattr(reader, 'raw_image_fields', ()) or ())
 
     field_names = None
     dropped = set()
@@ -254,6 +277,11 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
                           '(select fields explicitly or add a TransformSpec '
                           'to keep them)'.format(sorted(dropped)))
         field_names = names
+        if shuffler is not None:
+            # Ride the checkpoint: the buffered row tuples are ordered by
+            # this selection, and a resumed reader may yield zero samples
+            # to re-learn it from (see the drain below).
+            shuffler.field_names = list(names)
 
     def to_rows(sample):
         """Batched sample -> per-row tuples (reference pytorch.py:166-175)."""
@@ -327,8 +355,17 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
         yield from _iter_block_batches(reader, batch_size, shape_policies,
                                        last_batch, x64, strict_fields,
                                        batch_buffers=batch_buffers,
-                                       views_ok=views_ok, lineage=lineage)
+                                       views_ok=views_ok, lineage=lineage,
+                                       raw_fields=raw_fields)
         return
+
+    if raw_fields:
+        raise ValueError(
+            'raw image fields {} require the block fast path: a row-level '
+            'shuffling buffer (shuffling_queue_capacity) re-rows encoded '
+            'byte columns the staging-step decode cannot follow — shuffle '
+            'with shuffle_row_groups/shuffle_rows_in_chunk instead'.format(
+                sorted(raw_fields)))
 
     for sample in reader:
         if field_names is None:
@@ -363,6 +400,17 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
 
     if shuffler is not None:
         shuffler.finish()
+        if field_names is None and shuffler.can_retrieve():
+            # The reader yielded nothing — every remaining row was already
+            # buffered at checkpoint time, so the selection was never
+            # learned from a sample. The snapshot carried it.
+            field_names = getattr(shuffler, 'field_names', None)
+            if field_names is None:
+                raise ValueError(
+                    'restored shuffling buffer holds rows but the resumed '
+                    'reader yielded no samples and the snapshot predates '
+                    'field-name capture — the rows cannot be attributed '
+                    'to fields (re-checkpoint with this version)')
         while shuffler.can_retrieve():
             row = shuffler.retrieve()
             for name, value in zip(field_names, row):
@@ -375,7 +423,7 @@ def iter_numpy_batches(reader, batch_size, shape_policies=None,
 
 def _iter_block_batches(reader, batch_size, shape_policies, last_batch, x64,
                         strict_fields, batch_buffers=None, views_ok=True,
-                        lineage=None):
+                        lineage=None, raw_fields=()):
     """Fixed-size batches assembled from column blocks (no per-row Python).
 
     Chunks (one per row-group) are sanitized once on arrival; batches are
@@ -390,8 +438,22 @@ def _iter_block_batches(reader, batch_size, shape_policies, last_batch, x64,
     that exactly covers a batch may instead be handed out directly (its
     buffer is unshared, so downstream may keep or alias it freely without
     ever corrupting the cache).
+
+    ``raw_fields`` names encoded-bytes columns (the on-device decode
+    handoff, ``make_tensor_reader(raw_image_fields=...)``): object-dtype
+    columns of raw JPEG/PNG bytes that flow through batching as O(1)
+    reference slices — never sanitized, never arena-collated (an arena is
+    a pixel buffer; these are pointers) — and leave this iterator still
+    encoded for the loader's staging step to decode.
     """
     shape_policies = dict(shape_policies or {})
+    raw_fields = frozenset(raw_fields or ())
+    overlap = raw_fields & set(shape_policies)
+    if overlap:
+        raise ValueError(
+            'shape policies on raw image fields {} are impossible: the '
+            'column holds encoded bytes until the staging-step decode'
+            .format(sorted(overlap)))
     field_names = None
     dropped = []
     chunks = []   # list of [dict name -> sanitized array, private_bool]
@@ -423,6 +485,9 @@ def _iter_block_batches(reader, batch_size, shape_policies, last_batch, x64,
     def select(sample):
         names = []
         for name in sample._fields:
+            if name in raw_fields:
+                names.append(name)
+                continue
             column = np.asarray(getattr(sample, name))
             probe = column[0] if (column.dtype.kind == 'O' and len(column)) else column
             arr = np.asarray(probe)
@@ -446,13 +511,20 @@ def _iter_block_batches(reader, batch_size, shape_policies, last_batch, x64,
 
     def out_buffers(n, head):
         """A destination for ``n`` collated rows: an arena from the
-        provider when available (recycled, zero allocations), else fresh."""
+        provider when available (recycled, zero allocations), else fresh.
+        Raw (encoded-bytes) columns never ride arenas — their cells are
+        object references, not pixels — and always get a fresh tiny
+        object array."""
         spec = {name: ((n,) + head[name].shape[1:], head[name].dtype)
-                for name in field_names}
-        out = batch_buffers(spec) if batch_buffers is not None else None
+                for name in field_names if name not in raw_fields}
+        out = (batch_buffers(spec)
+               if batch_buffers is not None and spec else None)
         if out is None:
             out = {name: np.empty(shape, dtype)
                    for name, (shape, dtype) in spec.items()}
+        for name in raw_fields:
+            if name in field_names:
+                out[name] = np.empty(n, dtype=object)
         return out
 
     def take(n):
@@ -499,6 +571,13 @@ def _iter_block_batches(reader, batch_size, shape_policies, last_batch, x64,
         all_copied = True
         for name in field_names:
             source = np.asarray(getattr(sample, name))
+            if name in raw_fields:
+                # Encoded bytes pass through untouched (decoded at the
+                # staging step); slicing an object column copies refs,
+                # so treat it like any shared block.
+                chunk[name] = source
+                all_copied = False
+                continue
             arr = _sanitize_array(densify(name, source), x64)
             if arr is None:
                 raise ValueError('Field {!r} dtype is not TPU-compatible'.format(name))
@@ -680,6 +759,19 @@ class JaxLoader(object):
         ``None`` defers to the environment variable; ``False`` disables.
         The record of the latest batch is ``last_batch_provenance``;
         counters ride ``stats['lineage']``.
+    :param on_device_augment: the decode/augment-at-staging path. A
+        callable ``batch_dict -> batch_dict`` is jit-compiled and applied
+        to every staged device batch INSIDE the XLA step (augmentation
+        composes with ``ops.train_augment``/``imagenet_train_augment``);
+        ``True`` arms the staging-step decode without an augment. Pairs
+        with ``make_tensor_reader(raw_image_fields=...)``: workers then
+        ship raw JPEG/PNG bytes and the staging step runs JPEG->tensor —
+        through a registered on-device decode op
+        (:func:`register_device_decode`) when the backend has one, else
+        the host batched decoder right next to the transfer (the
+        fallback) — cutting the worker pool's decode CPU out of the
+        steady state. With a plain (decoded) reader the augment still
+        applies; the decode step is a no-op.
     """
 
     def __init__(self, reader, batch_size, mesh=None, sharding=None,
@@ -688,7 +780,7 @@ class JaxLoader(object):
                  last_batch='drop', strict_fields=False, echo=1, tracer=None,
                  stage_chunks=1, arena_depth=None, inflight=2,
                  watchdog=None, stall_timeout_s=None, autotune=None,
-                 lineage=None, resume_state=None):
+                 lineage=None, resume_state=None, on_device_augment=None):
         import jax
 
         # Fail a typo'd memory budget before any staging thread starts or
@@ -707,6 +799,35 @@ class JaxLoader(object):
         self._batch_axis = batch_axis
         self._jax = jax
         x64 = bool(jax.config.jax_enable_x64)
+
+        # On-device decode/augment (see the on_device_augment param): raw
+        # image fields the reader ships encoded, decoded at the staging
+        # step; an optional jitted augment applied to every staged batch.
+        self._raw_specs = {}
+        raw_fields = tuple(getattr(reader, 'raw_image_fields', ()) or ())
+        if raw_fields:
+            if shuffling_queue_capacity:
+                raise ValueError(
+                    'raw image fields {} require the block fast path; a '
+                    'row-level shuffling buffer cannot carry encoded byte '
+                    'columns — shuffle with shuffle_row_groups/'
+                    'shuffle_rows_in_chunk instead'.format(sorted(raw_fields)))
+            for name in raw_fields:
+                self._raw_specs[name] = reader.schema.fields[name]
+            # Staging-decode thread sizing: when raw fields cover EVERY
+            # image field the worker pool decodes nothing and the staging
+            # thread may spend the whole process budget; a partial
+            # selection leaves workers decoding the rest, so the staging
+            # thread takes a fair share like any other decoder.
+            from petastorm_tpu.codecs import CompressedImageCodec
+            image_fields = {n for n, f in reader.schema.fields.items()
+                            if isinstance(f.resolved_codec(),
+                                          CompressedImageCodec)}
+            self._staging_owns_budget = set(raw_fields) >= image_fields
+        self._augment_fn = None
+        if callable(on_device_augment):
+            self._augment_fn = jax.jit(on_device_augment)
+        self._stage_decode_s = 0.0
 
         if mesh is not None or sharding is not None:
             n_proc = jax.process_count()
@@ -1162,16 +1283,64 @@ class JaxLoader(object):
             staged = [jax.device_put(p) for p in parts]
         return self._stage_concat(*staged)
 
+    def _decode_raw_columns(self, host_batch):
+        """Staging-step JPEG->tensor for raw (encoded-bytes) columns: the
+        registered on-device decode op when the backend has one (falling
+        back on any failure), else ONE host batched-native call per
+        column — spending the WHOLE process decode-thread budget when the
+        raw selection covers every image field (the workers then decode
+        nothing), else a fair share alongside the still-decoding
+        workers."""
+        from petastorm_tpu import decode_budget
+        from petastorm_tpu.codecs import decode_image_batch_into
+        budget = decode_budget.get_budget()
+        decode_threads = (budget.total if self._staging_owns_budget
+                          else budget.share())
+        out = dict(host_batch)
+        t0 = time.perf_counter()
+        for name, field in self._raw_specs.items():
+            column = out.get(name)
+            if column is None or getattr(column, 'dtype', None) != np.dtype(object):
+                continue   # already dense (e.g. a custom pipeline decoded it)
+            hook = _DEVICE_DECODE_HOOK
+            if hook is not None:
+                try:
+                    out[name] = hook(column, tuple(field.shape),
+                                     np.dtype(field.numpy_dtype))
+                    continue
+                except Exception:  # noqa: BLE001 - fall back to host decode
+                    logger.warning(
+                        'on-device decode hook failed for field %r; host-'
+                        'decoding this batch', name, exc_info=True)
+            block = np.empty((len(column),) + tuple(field.shape),
+                             dtype=field.numpy_dtype)
+            decode_image_batch_into(
+                field, block, lambda i, _c=column: _c[i],
+                decode_threads=decode_threads)
+            out[name] = block
+        with self._stats_lock:
+            self._stage_decode_s += time.perf_counter() - t0
+        return out
+
     def _stage(self, host_batch):
         from petastorm_tpu.faults import maybe_inject
         maybe_inject('device-put-delay')
         jax = self._jax
+        if self._raw_specs:
+            host_batch = self._decode_raw_columns(host_batch)
         out = {}
         t0 = time.perf_counter()
         nbytes = 0
         with self._tracer.span('stage', 'device'):
             for name, array in host_batch.items():
                 nbytes += array.nbytes
+                if hasattr(array, 'is_ready'):
+                    # A device-decode hook already produced a committed
+                    # jax array: any re-staging path (process-local-data
+                    # assembly, chunked puts, dlpack import) would at
+                    # best round-trip it through the host.
+                    out[name] = array
+                    continue
                 chunkable = (self._stage_chunks > 1
                              and array.nbytes >= _STAGE_CHUNK_MIN_BYTES
                              and len(array) >= self._stage_chunks)
@@ -1206,6 +1375,11 @@ class JaxLoader(object):
                         out[name] = jax.device_put(array)
                 else:
                     out[name] = jax.device_put(array)
+            if self._augment_fn is not None:
+                # Inside the XLA step: the jitted augment consumes the
+                # just-staged device arrays asynchronously — its compute
+                # overlaps the consumer's step exactly like the transfer.
+                out = dict(self._augment_fn(out))
         # Dispatch time only (device_put is async); the transfer itself
         # overlaps the consumer's step. Block-to-measure lives in bench.py.
         with self._stats_lock:
@@ -1431,6 +1605,7 @@ class JaxLoader(object):
         with self._stats_lock:
             self._stage_s = 0.0
             self._staged_bytes = 0
+            self._stage_decode_s = 0.0
         if self._engine is not None:
             self._engine.reset_stats()
         if self._arena_pool is not None:
@@ -1457,12 +1632,17 @@ class JaxLoader(object):
                    if self._first_get_t is not None else 0.0)
         with self._stats_lock:
             stage_s, staged_bytes = self._stage_s, self._staged_bytes
+            stage_decode_s = self._stage_decode_s
         out = {'batches': self._batches_delivered,
                'wait_s': round(self._wait_s, 4),
                'input_stall_frac': round(self._wait_s / elapsed, 4) if elapsed else 0.0,
                'stage_dispatch_s': round(stage_s, 4),
                'staged_bytes': staged_bytes,
                'reader_diagnostics': self._reader.diagnostics}
+        if self._raw_specs:
+            # Staging-step decode seconds of the on-device path (host
+            # fallback; 0 when a device decode op carried the batches).
+            out['stage_decode_s'] = round(stage_decode_s, 4)
         if self._engine is not None:
             # Pipeline shape of the staging engine: per-stage busy seconds,
             # how much of the smaller stage ran concurrently with the other

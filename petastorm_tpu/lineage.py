@@ -149,7 +149,19 @@ def chunk_lineage(piece, piece_index, shuffle_row_drop_partition, n_rows,
 
 def _digest_array(arr):
     """CRC32 of an array's bytes (C-order) — fast (~GB/s) and enough to
-    prove bit-identity between a live batch and its replay."""
+    prove bit-identity between a live batch and its replay. Object
+    columns of bytes (raw image fields on the on-device decode path)
+    digest their CONTENTS in order — hashing the object pointers would
+    make every run's digest unique."""
+    arr = np.asarray(arr)
+    if arr.dtype.kind == 'O':
+        crc = 0
+        for cell in arr.ravel():
+            if isinstance(cell, (bytes, bytearray, memoryview)):
+                crc = zlib.crc32(cell, crc)
+            else:
+                crc = zlib.crc32(np.ascontiguousarray(cell), crc)
+        return crc & 0xFFFFFFFF
     arr = np.ascontiguousarray(arr)
     return zlib.crc32(arr.view(np.uint8) if arr.dtype.kind in ('M', 'm')
                       else arr) & 0xFFFFFFFF

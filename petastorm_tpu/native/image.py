@@ -50,6 +50,7 @@ def _load():
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
     lib.pst_image_decode_batch.restype = ctypes.c_int
+    lib.pst_image_info_batch.restype = ctypes.c_int
     lib.pst_jpeg_encode.restype = ctypes.c_int
     lib.pst_jpeg_encode.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
@@ -115,11 +116,37 @@ def decode_image(data):
     return _squeeze(out)
 
 
+def image_info_batch(blobs, num_threads=None):
+    """Header-probe N byte streams with ONE native call (C++ threads, GIL
+    released): returns ``(heights, widths, channels, bit_depths)`` lists.
+    Raises on the first unprobeable stream."""
+    lib = _load()
+    n = len(blobs)
+    if n == 0:
+        return [], [], [], []
+    if num_threads is None:
+        num_threads = min(n, os.cpu_count() or 4)
+    datas = (ctypes.c_char_p * n)(*blobs)
+    lens = (ctypes.c_size_t * n)(*[len(b) for b in blobs])
+    ws = (ctypes.c_int * n)()
+    hs = (ctypes.c_int * n)()
+    chs = (ctypes.c_int * n)()
+    bds = (ctypes.c_int * n)()
+    results = (ctypes.c_int * n)()
+    rc = lib.pst_image_info_batch(n, datas, lens, ws, hs, chs, bds, results,
+                                  num_threads)
+    if rc != 0:
+        bad = [i for i in range(n) if results[i] != 0]
+        raise ValueError('image_info_batch failed for images {}: {}'.format(
+            bad[:5], _ERRORS.get(results[bad[0]] if bad else rc, 'error')))
+    return list(hs), list(ws), list(chs), list(bds)
+
+
 def decode_batch(blobs, num_threads=None):
     """Decode a list of JPEG/PNG byte streams in parallel C++ threads.
 
     GIL is released for the whole batch; allocation happens up front from
-    header probes so worker threads never touch Python state.
+    ONE batched header probe so worker threads never touch Python state.
     """
     lib = _load()
     n = len(blobs)
@@ -127,7 +154,10 @@ def decode_batch(blobs, num_threads=None):
         return []
     if num_threads is None:
         num_threads = min(n, os.cpu_count() or 4)
-    outs = [_alloc_output(b) for b in blobs]
+    heights, widths, channels, depths = image_info_batch(
+        blobs, num_threads=num_threads)
+    outs = [np.empty((h, w, ch), dtype=np.uint16 if bd == 16 else np.uint8)
+            for h, w, ch, bd in zip(heights, widths, channels, depths)]
 
     datas = (ctypes.c_char_p * n)(*blobs)
     lens = (ctypes.c_size_t * n)(*[len(b) for b in blobs])

@@ -286,6 +286,40 @@ int pst_image_decode(const uint8_t* data, size_t len, uint8_t* out,
   return PST_ERR_FORMAT;
 }
 
+// Batch header probe with an internal thread pool: one native call sizes
+// every output of a heterogeneous batch (the variable-shape decode_batch
+// path) instead of n round trips through ctypes. All arrays have length n;
+// results[i] gets the per-image error code. Returns the first nonzero
+// result (callers inspect results[] for the rest).
+int pst_image_info_batch(int n, const uint8_t** datas, const size_t* lens,
+                         int* ws, int* hs, int* chs, int* bit_depths,
+                         int* results, int num_threads) {
+  if (n < 0 || !datas || !results) return PST_ERR_ARGS;
+  if (num_threads <= 0) num_threads = 1;
+  if (num_threads > n) num_threads = n > 0 ? n : 1;
+  std::atomic<int> next{0};
+  auto worker = [&]() {
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n) break;
+      results[i] = pst_image_info(datas[i], lens[i], &ws[i], &hs[i], &chs[i],
+                                  &bit_depths[i]);
+    }
+  };
+  if (num_threads == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(num_threads);
+    for (int t = 0; t < num_threads; t++) threads.emplace_back(worker);
+    for (auto& th : threads) th.join();
+  }
+  for (int i = 0; i < n; i++) {
+    if (results[i] != PST_OK) return results[i];
+  }
+  return PST_OK;
+}
+
 // Batch decode with an internal thread pool. All arrays have length n;
 // results[i] gets the per-image error code.
 int pst_image_decode_batch(int n, const uint8_t** datas, const size_t* lens,

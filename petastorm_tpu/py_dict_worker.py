@@ -129,6 +129,7 @@ class PyDictWorker(RowGroupWorkerBase):
             hashlib.md5(','.join(field_names).encode()).hexdigest()[:8])
 
         def load():
+            from petastorm_tpu.faults import rowgroup_fault_key
             from petastorm_tpu.trace import get_global_tracer
             if decoded_fresh is not None:
                 decoded_fresh.append(True)
@@ -138,7 +139,9 @@ class PyDictWorker(RowGroupWorkerBase):
                 if self.args['ngram'] is not None else schema)
             with get_global_tracer().span('decode', 'worker'):
                 return decode_rows(encoded_rows, decode_schema,
-                                   num_threads=self.args.get('decode_threads'))
+                                   num_threads=self.args.get('decode_threads'),
+                                   fault_key=rowgroup_fault_key(
+                                       piece.path, piece.row_group))
 
         return self.args['cache'].get(cache_key, load)
 

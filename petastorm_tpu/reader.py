@@ -233,7 +233,8 @@ def make_tensor_reader(dataset_url,
                        watchdog=None,
                        stall_timeout_s=None,
                        autotune=None,
-                       deterministic=False):
+                       deterministic=False,
+                       raw_image_fields=None):
     """Decoded-columnar reader: the TPU hot path (no reference equivalent).
 
     Like :func:`make_reader` (codecs run, values are decoded) but columnar
@@ -268,6 +269,16 @@ def make_tensor_reader(dataset_url,
     ``shuffle_row_groups``), which is what keeps mid-epoch checkpoint
     resume exact; for full row-level decorrelation use the JaxLoader's
     ``shuffling_queue_capacity`` (which leaves the block path).
+
+    ``raw_image_fields`` (the on-device decode handoff): ``True`` ships
+    every fixed-shape uint8 image-codec field ENCODED — workers skip its
+    decode entirely and publish the raw JPEG/PNG bytes as an object
+    column; a wrapping :class:`~petastorm_tpu.jax_loader.JaxLoader` runs
+    the JPEG->tensor step at device staging (an XLA decode op when one is
+    registered, else the host batched decoder) and any
+    ``on_device_augment`` function inside the compiled step. An iterable
+    selects specific image fields. Incompatible with ``transform_spec``
+    (transforms see decoded blocks).
     """
     from petastorm_tpu.ngram import NGram
     from petastorm_tpu.tensor_worker import (TensorResultsQueueReader,
@@ -293,6 +304,13 @@ def make_tensor_reader(dataset_url,
     else:
         view = stored_schema
     validate_tensor_schema(view)
+    raw_image_fields = _resolve_raw_image_fields(view, raw_image_fields)
+    if raw_image_fields and transform_spec is not None:
+        raise ValueError(
+            'raw_image_fields is incompatible with transform_spec: tensor '
+            'transforms operate on decoded column blocks, but raw fields '
+            'ship encoded bytes (augment on device via '
+            'JaxLoader(on_device_augment=...) instead)')
     if predicate is not None:
         bad = [f for f in predicate.get_fields()
                if f in stored_schema.fields and stored_schema.fields[f].shape != ()]
@@ -321,7 +339,44 @@ def make_tensor_reader(dataset_url,
                   shuffle_rows_in_chunk=shuffle_rows_in_chunk,
                   error_budget=error_budget,
                   watchdog=watchdog, stall_timeout_s=stall_timeout_s,
-                  autotune=autotune, deterministic=deterministic)
+                  autotune=autotune, deterministic=deterministic,
+                  raw_image_fields=raw_image_fields)
+
+
+def _resolve_raw_image_fields(view, raw_image_fields):
+    """Validate/expand ``make_tensor_reader(raw_image_fields=)``: ``True``
+    selects every fixed-shape uint8 image-codec field in the view; an
+    iterable is checked field by field. Returns a tuple of names."""
+    import numpy as _np
+
+    from petastorm_tpu.codecs import CompressedImageCodec
+    if not raw_image_fields:
+        return ()
+
+    def eligible(field):
+        return (isinstance(field.resolved_codec(), CompressedImageCodec)
+                and field.shape
+                and not any(d is None for d in field.shape)
+                and _np.dtype(field.numpy_dtype) == _np.uint8)
+
+    if raw_image_fields is True:
+        names = tuple(n for n, f in view.fields.items() if eligible(f))
+        if not names:
+            raise ValueError(
+                'raw_image_fields=True but the schema view has no fixed-'
+                'shape uint8 image-codec field to ship raw')
+        return names
+    names = tuple(raw_image_fields)
+    for name in names:
+        if name not in view.fields:
+            raise ValueError('raw_image_fields names unknown field {!r}'
+                             .format(name))
+        if not eligible(view.fields[name]):
+            raise ValueError(
+                'raw_image_fields field {!r} is not a fixed-shape uint8 '
+                'image-codec field — only those can defer decode to the '
+                'staging step'.format(name))
+    return names
 
 
 def make_batch_reader(dataset_url,
@@ -391,6 +446,18 @@ def make_batch_reader(dataset_url,
                   autotune=autotune, deterministic=deterministic)
 
 
+def _schema_has_image_fields(schema):
+    """True when any selected field decodes through the image codec — the
+    gate for decode-thread-budget registration (and thereby the autotuner
+    ``decode_threads`` knob)."""
+    from petastorm_tpu.codecs import CompressedImageCodec
+    try:
+        return any(isinstance(f.resolved_codec(), CompressedImageCodec)
+                   for f in schema.fields.values())
+    except Exception:  # noqa: BLE001 - inferred schemas may lack codecs
+        return False
+
+
 class _CallableDict(dict):
     """Dict that also answers ``()`` returning itself.
 
@@ -450,6 +517,12 @@ class QuarantineLog(object):
         entry = {'worker_id': quarantine.worker_id,
                  'error': quarantine.error,
                  'occurrences': 1}
+        decode_error = getattr(quarantine, 'decode_error', None)
+        if decode_error is not None:
+            # The native codec's own message ('not a JPEG or PNG stream',
+            # 'decode failed (corrupt stream?)', ...) — the triage-ready
+            # form of a poison image, next to the exception repr.
+            entry['decode_error'] = decode_error
         item = quarantine.item if isinstance(quarantine.item, dict) else {}
         piece_index = item.get('piece_index')
         entry['piece_index'] = piece_index
@@ -517,7 +590,7 @@ class Reader(object):
                  cache=None, transform_spec=None, ngram=None, resume_state=None,
                  shuffle_rows_in_chunk=False, error_budget=None,
                  watchdog=None, stall_timeout_s=None, autotune=None,
-                 deterministic=False):
+                 deterministic=False, raw_image_fields=None):
         # A typo'd memory budget must fail HERE — before the worker pool,
         # ventilator, watchdog, or autotuner threads start and before any
         # process-wide governor registration (the arm at the tail of this
@@ -552,6 +625,7 @@ class Reader(object):
         self._shard_count = shard_count
         self._predicate = predicate
         self._shuffle_rows_in_chunk = bool(shuffle_rows_in_chunk)
+        self._raw_image_fields = tuple(raw_image_fields or ())
         self._lineage_mode = getattr(worker_class, 'lineage_mode', None)
 
         if bool(cur_shard is None) != bool(shard_count is None):
@@ -661,6 +735,21 @@ class Reader(object):
             results_queue_reader.set_resequencer(self._resequencer)
 
         self._cache = cache if cache is not None else NullCache()
+        # Native decode-thread fair sharing (petastorm_tpu.decode_budget):
+        # in-process pools register their worker count with the process-
+        # wide budget (below, AFTER pool.start — see there) and workers
+        # resolve their share PER DECODE CALL — a live resize() or an
+        # autotuner decode_threads step re-divides immediately. Process
+        # pools can't share a live object: their workers get a static
+        # share of the same env-resolved total (they can't resize either,
+        # so static stays correct).
+        from petastorm_tpu import decode_budget
+        self._decode_share = None
+        if hasattr(reader_pool, 'resize'):
+            decode_threads = None
+        else:
+            decode_threads = max(1, decode_budget.get_budget().total
+                                 // max(1, self._pool_workers_count()))
         worker_args = {
             'store_factory': _StoreFactory(store.url, store.storage_options),
             'schema': self.schema,
@@ -672,8 +761,10 @@ class Reader(object):
             'transformed_schema': self._transformed_schema,
             'partition_names': store.partition_names,
             'dataset_path_hash': hashlib.md5(store.url.encode()).hexdigest()[:12],
-            # fair share of host cores for each worker's native decode threads
-            'decode_threads': max(1, (os.cpu_count() or 4) // max(1, self._pool_workers_count())),
+            # None = live fair share of the process decode-thread budget
+            # (in-process pools); a static share for process pools.
+            'decode_threads': decode_threads,
+            'raw_image_fields': tuple(raw_image_fields or ()),
             'shuffle_rows_in_chunk': bool(shuffle_rows_in_chunk),
             'shuffle_seed': seed,
             # Poison row-group quarantine (docs/failure_model.rst): when the
@@ -762,6 +853,19 @@ class Reader(object):
 
             self._ventilator.on_ventilate = on_ventilate
         self._workers_pool.start(worker_class, worker_args, ventilator=self._ventilator)
+        # Decode-budget registration deliberately sits AFTER every
+        # constructor raise (filter/validation errors, pool spawn failure):
+        # stop() is the only release path, and a failed Reader must not
+        # leave phantom workers shrinking other readers' fair shares
+        # forever. Only image-decoding schemas register — a scalar-only
+        # reader never batch-decodes, and counting its workers would both
+        # starve real decoders and hand the autotuner a no-op
+        # decode_threads knob to waste input-bound grow ticks on.
+        if hasattr(self._workers_pool, 'resize') \
+                and _schema_has_image_fields(self.schema):
+            self._decode_share = decode_budget.get_budget().register_pool(
+                self._pool_workers_count())
+            self._workers_pool.decode_share = self._decode_share
 
         # --- pipeline health supervision (petastorm_tpu.health) ------------
         # A standalone reader owns its monitor; a wrapping JaxLoader calls
@@ -919,25 +1023,26 @@ class Reader(object):
             ventilator = self._ventilator
 
             def set_workers(n):
-                # Re-fair-share the native decode threads for workers
-                # spawned from now on: the per-worker allotment computed at
-                # construction assumed the construction-time pool size, and
-                # growing e.g. 2 -> 16 workers each carrying cores//2
-                # native threads would oversubscribe the host. (Already-
-                # running workers keep their allotment — a live C++ pool
-                # can't be rethreaded — so the correction lands as the pool
-                # churns.)
-                worker_args = getattr(pool, '_worker_args', None)
-                if isinstance(worker_args, dict) \
-                        and 'decode_threads' in worker_args:
-                    worker_args['decode_threads'] = max(
-                        1, (os.cpu_count() or 4) // max(1, n))
+                # pool.resize() re-divides the process decode-thread
+                # budget through the registered PoolShare — every
+                # worker's next decode call sees the new fair share.
                 pool.resize(n)
                 ventilator.set_max_in_flight(n + _VENTILATE_EXTRA_ROWGROUPS)
 
             knobs['workers'] = Knob(
                 'workers', lambda: pool.workers_count, set_workers,
                 lo=cfg.min_workers, hi=cfg.max_workers)
+        if self._decode_share is not None:
+            # The process-wide native decode-thread budget as a first-
+            # class knob: input-bound classifications grow decode
+            # parallelism directly instead of blindly ratcheting workers
+            # (autotune._GROW_ACTIONS), and mem-shrink steps it down with
+            # everything else.
+            from petastorm_tpu import decode_budget
+            budget = decode_budget.get_budget()
+            knobs['decode_threads'] = Knob(
+                'decode_threads', lambda: budget.total, budget.set_total,
+                lo=cfg.min_decode_threads, hi=cfg.max_decode_threads)
         if hasattr(pool, 'results_watermark'):
             capacity = pool.results_capacity
 
@@ -1197,6 +1302,16 @@ class Reader(object):
         return self._deterministic
 
     @property
+    def raw_image_fields(self):
+        """Image-codec fields this reader ships ENCODED (raw bytes as
+        object columns) instead of decoded pixel blocks — the on-device
+        decode handoff (``make_tensor_reader(raw_image_fields=...)``). A
+        wrapping ``JaxLoader`` decodes them at its staging step (device
+        op when registered, host batched decode otherwise). Empty tuple
+        on ordinary readers."""
+        return self._raw_image_fields
+
+    @property
     def last_chunk_det(self):
         """Deterministic-mode tag (``{'seq', 'epoch', 'pos'}``) of the
         most recently yielded chunk/row — what a data-service server
@@ -1315,6 +1430,12 @@ class Reader(object):
             # First: a tuner firing mid-teardown would resize a pool whose
             # workers are being joined.
             self._autotuner.stop()
+        if self._decode_share is not None:
+            # Stop counting toward the process decode-thread fair share:
+            # surviving readers' workers widen to the freed threads on
+            # their next decode call.
+            self._decode_share.release()
+            self._decode_share = None
         if self._health is not None:
             self._health.stop()
         self._workers_pool.stop()

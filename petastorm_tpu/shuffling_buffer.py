@@ -89,6 +89,13 @@ class RandomShufflingBuffer(ShufflingBufferBase):
         self._store = []
         self._row_nbytes = None   # per-row estimate, sampled on first add
         self._pending = None   # armed by track_pending()
+        #: Field order of the buffered row tuples (set by the batch
+        #: iterator once it learns its selection). Rides the checkpoint:
+        #: a resumed reader that yields ZERO samples (every remaining row
+        #: was already buffered at checkpoint time) has no first sample to
+        #: learn field names from — the snapshot's names are then the only
+        #: way to drain the restored rows.
+        self.field_names = None
         self._done_adding = False
         self._rng = np.random.default_rng(seed)
         # Guards store + RNG mutations against a concurrent state_dict():
@@ -233,6 +240,8 @@ class RandomShufflingBuffer(ShufflingBufferBase):
             return {'version': self.STATE_VERSION,
                     'rows': rows,
                     'rng_state': self._rng.bit_generator.state,
+                    'field_names': (list(self.field_names)
+                                    if self.field_names is not None else None),
                     'size': len(rows)}
 
     def restore(self, state):
@@ -248,3 +257,5 @@ class RandomShufflingBuffer(ShufflingBufferBase):
                 raise RuntimeError('restore() into a non-empty buffer')
             self._store = list(state['rows'])
             self._rng.bit_generator.state = state['rng_state']
+            if state.get('field_names'):
+                self.field_names = list(state['field_names'])

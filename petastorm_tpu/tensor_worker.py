@@ -96,8 +96,10 @@ class TensorWorker(RowGroupWorkerBase):
             # is what makes worker-subprocess decode visible on a merged
             # timeline; the histogram is its scrape-surface twin.
             with get_global_tracer().span('decode', 'worker'):
-                cols = decode_table_to_blocks(table, schema,
-                                              self.args.get('decode_threads'))
+                cols = decode_table_to_blocks(
+                    table, schema, self.args.get('decode_threads'),
+                    fault_key=rowgroup_fault_key(piece.path, piece.row_group),
+                    raw_fields=self.args.get('raw_image_fields') or ())
             timings['decode_s'] = time.perf_counter() - t0
             metrics.histogram(
                 'pst_decode_seconds',
@@ -362,8 +364,15 @@ class TensorResultsQueueReader(DeferredRowAccounting, ResequencedReads):
 # columnar decode
 # --------------------------------------------------------------------------
 
-def decode_table_to_blocks(table, schema, decode_threads=None):
-    """Arrow table -> dict of contiguous per-field numpy blocks, decoded."""
+def decode_table_to_blocks(table, schema, decode_threads=None,
+                           fault_key=None, raw_fields=()):
+    """Arrow table -> dict of contiguous per-field numpy blocks, decoded.
+
+    ``raw_fields`` names image-codec columns shipped *encoded* (the
+    on-device decode path): those come out as object-dtype columns of the
+    raw bytes instead of decoded pixel blocks — the loader's staging step
+    owns their decode (``JaxLoader`` docstring, ``on_device_augment``).
+    """
     cols = {}
     for name in schema.fields:
         if name not in table.column_names:
@@ -378,7 +387,11 @@ def decode_table_to_blocks(table, schema, decode_threads=None):
         codec = field.resolved_codec()
         try:
             if isinstance(codec, CompressedImageCodec):
-                cols[name] = _decode_image_column(column, field, decode_threads)
+                if name in raw_fields:
+                    cols[name] = _raw_image_column(column)
+                else:
+                    cols[name] = _decode_image_column(
+                        column, field, decode_threads, fault_key=fault_key)
             elif isinstance(codec, (NdarrayCodec, CompressedNdarrayCodec)):
                 cols[name] = _decode_ndarray_column(column, field, codec)
             else:  # scalars (incl. partition-value columns)
@@ -403,48 +416,32 @@ def _binary_column_view(column):
     return base + offsets[:-1], np.diff(offsets)
 
 
-def _decode_image_column(column, field, decode_threads):
+def _decode_image_column(column, field, decode_threads, fault_key=None):
+    """One contiguous ``[N, ...field.shape]`` block per column via the
+    shared batched core (:func:`petastorm_tpu.codecs.decode_image_batch_into`):
+    pointer math over the Arrow value buffer feeds one native call for the
+    whole row-group; scalar/fallback paths produce byte-identical blocks."""
+    from petastorm_tpu.codecs import decode_image_batch_into
     n = len(column)
     dtype = np.dtype(field.numpy_dtype)
     out = np.empty((n,) + tuple(field.shape), dtype=dtype)
-    native = _native_image()
-    codec = field.resolved_codec()
-    if native is not None and dtype == np.uint8:
+    ptrs = lens = None
+    if _native_image() is not None and dtype == np.uint8:
         ptrs, lens = _binary_column_view(column)
-        results, chs, hs, ws = native.decode_batch_into(
-            ptrs, lens, out, num_threads=decode_threads)
-        want_ch = field.shape[2] if len(field.shape) == 3 else 1
-        want_h, want_w = field.shape[0], field.shape[1]
-        for i in range(n):
-            if results[i] != 0:
-                # Slot decode failed — commonly an RGBA/16-bit stream whose
-                # native layout exceeds the RGB-capacity slot ('buffer too
-                # small' fires before the channel count is knowable). The
-                # codec path decodes unconstrained and conforms channels;
-                # it raises its own DecodeFieldError-able error if the
-                # stream is truly corrupt.
-                try:
-                    out[i] = codec.decode(field, column[i].as_py())
-                except Exception as e:
-                    raise DecodeFieldError(
-                        'Image {} of field {!r}: batch decode failed ({}) and '
-                        'per-cell fallback failed: {}'.format(
-                            i, field.name, native.decode_error_message(results[i]),
-                            e)) from e
-                continue
-            if hs[i] != want_h or ws[i] != want_w:
-                raise DecodeFieldError(
-                    'Image {} of field {!r} decodes to {}x{}, declared {}x{}'
-                    .format(i, field.name, hs[i], ws[i], want_h, want_w))
-            if chs[i] != want_ch:
-                # Gray stream inside an RGB field: the slot holds a partial
-                # channel layout; conform from a clean per-cell decode.
-                out[i] = CompressedImageCodec.conform_channels(
-                    native.decode_image(column[i].as_py()), field)
-        return out
-    # Fallback: per-cell codec decode (cv2/PIL), still into one block.
+    decode_image_batch_into(field, out, lambda i: column[i].as_py(),
+                            ptrs=ptrs, lens=lens,
+                            decode_threads=decode_threads,
+                            fault_key=fault_key)
+    return out
+
+
+def _raw_image_column(column):
+    """Encoded bytes as an object-dtype column (the raw-image handoff for
+    on-device decode): O(1)-per-cell reference copies, no pixel work."""
+    n = len(column)
+    out = np.empty(n, dtype=object)
     for i, cell in enumerate(column):
-        out[i] = codec.decode(field, cell.as_py())
+        out[i] = cell.as_py()
     return out
 
 

@@ -1,0 +1,129 @@
+"""Offline transcode ETL: pre-fill the decoded-chunk store.
+
+``python -m petastorm_tpu.tools.transcode --dataset-url URL --store DIR``
+walks every row-group of a dataset through the tensor decode path ONCE and
+leaves the NVMe decoded-chunk store (:mod:`petastorm_tpu.chunk_store`)
+fully populated — so steady-state production training never touches a
+JPEG: epoch 0 of every later job mmaps decoded tensors (``decode_s`` ~ 0,
+the zero-decode property the epoch-2 chunk-store test proves, moved to
+epoch 0).
+
+Everything rides the existing store machinery — ``tensor_chunk_key`` (so
+training readers compute the identical keys), the flock'd single-writer
+protocol (N transcode jobs or a transcode racing a training job produce
+exactly one entry per chunk), and the write-behind thread (decode never
+blocks on NVMe). Because write-behind DROPS on queue overflow (by design),
+one pass is not a guarantee: the tool re-walks the dataset until a pass
+serves every row-group from the store (drops re-enqueue on their next
+miss — the documented self-healing), or ``--max-passes`` is exhausted.
+
+The tool prints one JSON report line::
+
+    {"row_groups": 12, "passes": 2, "writes": 12, "preexisting": 0,
+     "bytes_written": 123456, "complete": true, ...}
+
+Exit status: 0 when the final verification pass was all hits, 1 otherwise.
+"""
+
+import argparse
+import json
+import sys
+
+#: Deeper-than-default write-behind queue: an ETL job's whole point is the
+#: spill, so give it room before the drop-and-retry path kicks in.
+_ETL_WRITER_QUEUE_DEPTH = 64
+
+
+def transcode_dataset(dataset_url, store_path, schema_fields=None,
+                      workers_count=4, max_passes=4, flush_timeout_s=300.0,
+                      size_limit=None):
+    """Pre-fill ``store_path`` with every decoded chunk of ``dataset_url``.
+
+    Returns the report dict (see module docstring). ``schema_fields``
+    narrows the transcoded columns — the store key carries the schema
+    hash, so a training job selecting different fields misses and refills
+    its own entries (document the field set with your dataset).
+    """
+    from petastorm_tpu import make_tensor_reader
+
+    report = {'dataset_url': dataset_url, 'store': store_path, 'passes': 0,
+              'row_groups': None, 'writes': 0, 'write_races': 0,
+              'preexisting': 0, 'bytes_written': 0, 'unstorable': 0,
+              'complete': False}
+    for _ in range(max_passes):
+        report['passes'] += 1
+        reader = make_tensor_reader(
+            dataset_url, schema_fields=schema_fields,
+            reader_pool_type='thread', workers_count=workers_count,
+            shuffle_row_groups=False, num_epochs=1,
+            cache_type='chunk-store', cache_location=store_path,
+            cache_size_limit=size_limit,
+            cache_extra_settings={'writer_queue_depth':
+                                  _ETL_WRITER_QUEUE_DEPTH})
+        store = reader.chunk_store
+        try:
+            for _ in reader:
+                pass
+            # The pass only counts once its write-behind backlog is ON
+            # DISK — a timed-out flush means entries may be missing and
+            # another pass must verify.
+            flushed = store.flush(timeout_s=flush_timeout_s)
+            stats = store.stats()
+        finally:
+            reader.stop()
+            reader.join()
+        report['row_groups'] = stats['hits'] + stats['misses']
+        report['writes'] += stats['writes']
+        report['write_races'] += stats['write_races']
+        report['bytes_written'] += stats['bytes_written']
+        report['unstorable'] = stats['unstorable']
+        if report['passes'] == 1:
+            # First-pass hits are entries a previous transcode (or a
+            # training job's epoch-0 spill) already published.
+            report['preexisting'] = stats['hits']
+        if stats['unstorable']:
+            # Object/void columns can never be stored: more passes would
+            # loop forever re-decoding them. Narrow schema_fields.
+            break
+        if flushed and stats['misses'] == 0:
+            # Every row-group served from the store: the dataset is fully
+            # transcoded (this pass doubled as the verification read).
+            report['complete'] = True
+            break
+    return report
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='python -m petastorm_tpu.tools.transcode',
+        description='Pre-fill the NVMe decoded-chunk store so production '
+                    'training never decodes a JPEG')
+    parser.add_argument('--dataset-url', required=True,
+                        help='petastorm_tpu dataset URL (file://...)')
+    parser.add_argument('--store', required=True,
+                        help='chunk-store directory (the same path training '
+                             'jobs pass as cache_location / '
+                             'PETASTORM_TPU_CHUNK_STORE)')
+    parser.add_argument('--fields', nargs='*', default=None,
+                        help='schema fields to transcode (default: all; the '
+                             'store key carries the field set)')
+    parser.add_argument('--workers', type=int, default=4)
+    parser.add_argument('--max-passes', type=int, default=4,
+                        help='re-walk budget until a pass is all hits '
+                             '(write-behind drops self-heal on later passes)')
+    parser.add_argument('--size-limit', type=int, default=None,
+                        help='store size cap in bytes (oldest entries evict '
+                             'past it — a cap smaller than the dataset can '
+                             'never transcode completely)')
+    args = parser.parse_args(argv)
+
+    report = transcode_dataset(
+        args.dataset_url, args.store, schema_fields=args.fields,
+        workers_count=args.workers, max_passes=args.max_passes,
+        size_limit=args.size_limit)
+    print(json.dumps(report))
+    return 0 if report['complete'] else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

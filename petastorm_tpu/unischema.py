@@ -337,7 +337,7 @@ def decode_row(row, schema):
     return decoded
 
 
-def decode_rows(rows, schema, num_threads=None):
+def decode_rows(rows, schema, num_threads=None, fault_key=None):
     """Decode a whole row-group's encoded rows.
 
     Equivalent to ``[decode_row(r, schema) for r in rows]`` but image fields
@@ -345,26 +345,42 @@ def decode_rows(rows, schema, num_threads=None):
     (``native/src/image_codec.cc``) with the GIL released — the hot-loop
     upgrade over the reference's per-row ``cv2.imdecode`` dispatch
     (reference ``py_dict_reader_worker.py:181`` -> ``utils.py:54-87``).
+    Fixed-shape uint8 image fields go through the same one-native-call-
+    per-(row-group, field) block core as the tensor path
+    (:func:`petastorm_tpu.codecs.decode_image_batch_into`) — each row's
+    value is a disjoint view of the column block, zero intermediate
+    per-image ndarrays; variable-shape fields keep the per-image-output
+    ``decode_batch`` (one batched header probe sizes the outputs).
 
-    ``num_threads`` caps the C++ decode threads; pool workers pass their
-    fair share of the host cores so N concurrent workers don't oversubscribe.
+    ``num_threads`` caps the C++ decode threads; ``None`` resolves to the
+    caller's live fair share of the process decode-thread budget
+    (``PETASTORM_TPU_DECODE_THREADS``) so N concurrent workers don't
+    oversubscribe. ``fault_key`` is the row-group identity for the
+    ``decode-corrupt-batch`` fault site.
     """
     from petastorm_tpu import codecs as _codecs
     from petastorm_tpu.errors import DecodeFieldError
 
     native = _codecs._native_image()
     image_fields = []
-    if native is not None and len(rows) > 1:
+    if native is not None and len(rows) > 1 \
+            and _codecs.decode_path() == 'batched':
         image_fields = [name for name, field in schema.fields.items()
                         if isinstance(field.resolved_codec(), _codecs.CompressedImageCodec)]
     if not image_fields:
         return [decode_row(row, schema) for row in rows]
+    if num_threads is None:
+        from petastorm_tpu import decode_budget
+        num_threads = decode_budget.get_budget().share()
+
+    def _block_decodable(field):
+        return (field.shape and not any(d is None for d in field.shape)
+                and np.dtype(field.numpy_dtype) == np.uint8)
 
     rest_fields = [n for n in schema.fields if n not in image_fields]
     rest_schema = schema.create_schema_view(rest_fields) if rest_fields else None
     decoded = []
-    blob_slots = []  # (row_index, field_name)
-    blobs = []
+    slots = {name: [] for name in image_fields}   # (row_index, blob) per field
     for i, row in enumerate(rows):
         # decode_row skips fields outside the view, so no need to pre-filter
         d = decode_row(row, rest_schema) if rest_schema is not None else {}
@@ -375,19 +391,38 @@ def decode_rows(rows, schema, num_threads=None):
             if value is None:
                 d[name] = None
             else:
-                blob_slots.append((i, name))
-                blobs.append(bytes(value))
+                slots[name].append((i, bytes(value)))
                 d[name] = None  # filled below
         decoded.append(d)
-    if blobs:
+    conform = _codecs.CompressedImageCodec.conform_channels
+    for name in image_fields:
+        present = slots[name]
+        if not present:
+            continue
+        field = schema.fields[name]
+        if _block_decodable(field):
+            out = np.empty((len(present),) + tuple(field.shape),
+                           dtype=np.uint8)
+            _codecs.decode_image_batch_into(
+                field, out, lambda j, _p=present: _p[j][1],
+                decode_threads=num_threads, fault_key=fault_key)
+            for j, (i, _) in enumerate(present):
+                # Copied OUT of the scratch block, never a view of it:
+                # rows live independent lives downstream (row caches,
+                # shuffling buffers retain single rows for a long time),
+                # and one retained view would pin the whole row-group
+                # block. The copy is one extra memcpy against a decode
+                # that costs 10-50x more.
+                decoded[i][name] = out[j].copy()
+            continue
         try:
-            images = native.decode_batch(blobs, num_threads=num_threads)
+            images = native.decode_batch([b for _, b in present],
+                                         num_threads=num_threads)
         except Exception as e:
-            raise DecodeFieldError('Unable to batch-decode image fields {}: {}'.format(
-                image_fields, e)) from e
-        conform = _codecs.CompressedImageCodec.conform_channels
-        for (i, name), img in zip(blob_slots, images):
-            decoded[i][name] = conform(img, schema.fields[name])
+            raise DecodeFieldError('Unable to batch-decode image field {!r}: {}'
+                                   .format(name, e)) from e
+        for (i, _), img in zip(present, images):
+            decoded[i][name] = conform(img, field)
     return decoded
 
 

@@ -46,14 +46,21 @@ class RowGroupQuarantined(object):
 
     ``item`` is a pickle-safe summary of the ventilated kwargs (the raw
     kwargs may close over un-picklable predicates/transforms).
+    ``decode_error`` carries the native codec's own error string when the
+    failure came out of the C++ batch decoder
+    (``DecodeFieldError.native_error``) — a corrupt image then reads as
+    e.g. ``'not a JPEG or PNG stream'`` in the quarantine diagnostics
+    instead of a bare exception repr.
     """
 
-    def __init__(self, worker_id, item, error, traceback_str, seq=None):
+    def __init__(self, worker_id, item, error, traceback_str, seq=None,
+                 decode_error=None):
         self.worker_id = worker_id
         self.item = item
         self.error = error
         self.traceback_str = traceback_str
         self.seq = seq
+        self.decode_error = decode_error
 
 
 def _summarize_item(args, kwargs):
@@ -94,7 +101,8 @@ def quarantine_record_for(worker, exc, args, kwargs):
         worker_id=getattr(worker, 'worker_id', None),
         item=_summarize_item(args, kwargs),
         error='{}: {}'.format(type(exc).__name__, exc),
-        traceback_str=traceback.format_exc())
+        traceback_str=traceback.format_exc(),
+        decode_error=getattr(exc, 'native_error', None))
 
 
 def deliver_quarantine(pool, record):

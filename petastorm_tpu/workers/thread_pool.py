@@ -128,6 +128,11 @@ class ThreadPool(object):
         #: racy float rebinds tolerated — it feeds an *estimate*): the
         #: memory governor's results-queue accounting is depth x this.
         self.result_nbytes_ema = 0.0
+        #: Optional ``decode_budget.PoolShare`` (set by the Reader): this
+        #: pool's registered stake in the process-wide native decode-
+        #: thread budget. ``resize()`` re-divides it so every worker's
+        #: next decode call sees the new fair share.
+        self.decode_share = None
 
     @property
     def workers_count(self):
@@ -191,6 +196,10 @@ class ThreadPool(object):
                 if delta < 0:
                     self._retire_requests += -delta
                     self._workers_count = n
+                    if self.decode_share is not None:
+                        # Shrinks widen the survivors' fair share on
+                        # their next decode call.
+                        self.decode_share.resize(n)
                     return n
                 # Growing: outstanding retire requests are cancelled first —
                 # resurrecting a not-yet-retired worker is cheaper than a
@@ -212,6 +221,11 @@ class ThreadPool(object):
                 if vent_queue.maxsize and n + _RESIZE_VENT_SLACK > vent_queue.maxsize:
                     vent_queue.maxsize = n + _RESIZE_VENT_SLACK
                     vent_queue.not_full.notify_all()
+            if self.decode_share is not None:
+                # Re-divide the process decode-thread budget: N workers
+                # each took total//old_n native threads per batch call;
+                # the next call fair-shares against the new count.
+                self.decode_share.resize(n)
             return n
 
     def _should_retire(self, thread):

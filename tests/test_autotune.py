@@ -573,22 +573,32 @@ def test_pool_drain_cap_bounds_pending_buffer():
 
 
 def test_workers_knob_rescales_decode_threads(synthetic_dataset):
-    """Growing the pool must re-fair-share the native decode threads for
-    newly spawned workers — per-worker allotments sized for the original
-    pool would oversubscribe the host as the pool grows."""
+    """Growing the pool must re-fair-share the native decode threads —
+    per-worker allotments sized for the original pool would oversubscribe
+    the host as the pool grows. Since ISSUE 13 the share lives in the
+    process decode-thread budget (``decode_budget``): every worker's NEXT
+    decode call sees the re-divided share, not just freshly spawned ones."""
     from petastorm_tpu import make_tensor_reader
-    with make_tensor_reader(synthetic_dataset.url, schema_fields=['id'],
-                            workers_count=1,
-                            shuffle_row_groups=False) as reader:
-        knob = reader._autotune_knobs(AutotuneConfig(max_workers=8))['workers']
-        pool = reader._workers_pool
-        import os as _os
-        cores = _os.cpu_count() or 4
-        knob.set(4)
-        assert pool._worker_args['decode_threads'] == max(1, cores // 4)
-        assert pool.workers_count == 4
-        for _ in reader:
-            pass
+    from petastorm_tpu.decode_budget import (DecodeThreadBudget, get_budget,
+                                             set_budget)
+    previous = set_budget(DecodeThreadBudget(total=8))
+    try:
+        with make_tensor_reader(synthetic_dataset.url,
+                                schema_fields=['id', 'image_png'],
+                                workers_count=1,
+                                shuffle_row_groups=False) as reader:
+            knobs = reader._autotune_knobs(AutotuneConfig(max_workers=8))
+            pool = reader._workers_pool
+            # thread pools resolve their share live: the static arg is unset
+            assert pool._worker_args['decode_threads'] is None
+            assert get_budget().share() == 8
+            knobs['workers'].set(4)
+            assert pool.workers_count == 4
+            assert get_budget().share() == 2   # 8 // 4, re-divided live
+            for _ in reader:
+                pass
+    finally:
+        set_budget(previous)
 
 
 def test_watermark_knob_disarms_at_capacity(synthetic_dataset):
@@ -652,6 +662,8 @@ def test_reader_standalone_autotune(synthetic_dataset):
         diag = reader.diagnostics()
     assert rows == 150
     at = diag['autotune']
+    # No image field in the selection: the decode_threads knob must NOT
+    # register (it would be a no-op lever eating input-bound grow ticks).
     assert set(at['knobs']) == {'workers', 'results_watermark'}
     assert at['ticks'] >= 1
     # The leak guard in conftest.py asserts the control thread is gone.

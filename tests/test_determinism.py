@@ -710,6 +710,47 @@ def test_loader_shuffling_buffer_survives_checkpoint(det_dataset):
                       resume_state=state)
 
 
+def test_restored_buffer_drains_without_any_fresh_sample():
+    """A resumed reader may yield ZERO samples (every remaining row was
+    already buffered at checkpoint time): the snapshot's field names —
+    not a first-sample probe — must attribute the restored rows. This
+    was a latent crash (zip(None, row)) that only fired when the head
+    run's pipeline consumed the whole dataset reader-side before the
+    checkpoint."""
+    from petastorm_tpu.jax_loader import iter_numpy_batches
+    from petastorm_tpu.shuffling_buffer import RandomShufflingBuffer
+
+    donor = RandomShufflingBuffer(30, 5, seed=1)
+    donor.field_names = ['id', 'vec']
+    donor.add_many([(i, np.full(4, i, dtype=np.float32))
+                    for i in range(12)])
+    snapshot = donor.state_dict()
+    assert snapshot['field_names'] == ['id', 'vec']
+
+    restored = RandomShufflingBuffer(30, 5, seed=1)
+    restored.restore(snapshot)
+
+    class _EmptyReader:
+        batched_output = False
+
+        def __iter__(self):
+            return iter(())
+
+    batches = list(iter_numpy_batches(_EmptyReader(), 4, shuffler=restored,
+                                      last_batch='partial'))
+    assert sum(len(b['id']) for b in batches) == 12
+    assert all(set(b) == {'id', 'vec'} for b in batches)
+
+    # a pre-capture snapshot (no field names) with an empty reader raises
+    # pointedly instead of zip(None, ...)
+    legacy = dict(snapshot, field_names=None)
+    fresh = RandomShufflingBuffer(30, 5, seed=1)
+    fresh.restore(legacy)
+    with pytest.raises(ValueError, match='field-name capture'):
+        list(iter_numpy_batches(_EmptyReader(), 4, shuffler=fresh,
+                                last_batch='partial'))
+
+
 def test_weighted_sampling_reader_resumable_draws(det_dataset):
     from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
 
