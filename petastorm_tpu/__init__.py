@@ -33,7 +33,8 @@ from petastorm_tpu.lineage import (LineageTracker,  # noqa: F401
 from petastorm_tpu.metrics import (MetricsExporter,  # noqa: F401
                                    MetricsRegistry, start_http_exporter)
 from petastorm_tpu.reader import (Reader, make_batch_reader,  # noqa: F401
-                                  make_reader, make_tensor_reader)
+                                  make_pod_reader, make_reader,
+                                  make_tensor_reader)
 from petastorm_tpu.trace import Tracer  # noqa: F401
 from petastorm_tpu.transform import TransformSpec  # noqa: F401
 from petastorm_tpu.unischema import Unischema, UnischemaField  # noqa: F401

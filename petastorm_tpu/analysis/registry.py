@@ -96,6 +96,15 @@ THREAD_GUARDS = (
         'leaked writer keeps appending decoded chunks to NVMe.',
         marker='chunkstore', action='fail'),
     ThreadGuard(
+        'pst-device-put', 'petastorm_tpu.staging',
+        'DeviceStager.stop() (called from JaxLoader.stop after the '
+        'engine joins) joins every per-device dispatch stream with a '
+        'timeout and records survivors in stats()["leaked_threads"]; on '
+        'the CPU test platform puts never wedge, so a thread outliving '
+        'its loader is a real leak the sweep should fail. Armable by any '
+        'mesh/sharded JaxLoader, so the sweep runs on every test.',
+        marker=None, action='fail'),
+    ThreadGuard(
         'pst-staging', 'petastorm_tpu.staging',
         'StagingEngine.stop() joins with a timeout and RECORDS leaks in '
         'stats()["leaked_threads"] (a device_put hung on a wedged device '

@@ -20,8 +20,9 @@ staging counters, consumer wait accounting); this module closes the loop:
 
     * reader-starved -> grow the worker pool (``ThreadPool.resize``) and
       loosen ventilation;
-    * dispatch-bound -> widen the in-flight ``device_put`` window (then
-      prefetch depth);
+    * dispatch-bound -> widen the per-device ``device_put`` windows
+      (the per-device sharded staging path), then the batch-level
+      in-flight window, then prefetch depth;
     * arena-bound -> deepen the host-arena pool;
     * consumer-bound -> shrink everything one step and tighten the
       ventilator's results-queue watermark — release memory instead of
@@ -109,6 +110,7 @@ class AutotuneConfig(object):
                  min_workers=1, max_workers=None,
                  min_prefetch=1, max_prefetch=8,
                  min_inflight=1, max_inflight=8,
+                 min_device_inflight=1, max_device_inflight=8,
                  min_arena_depth=2, max_arena_depth=16,
                  min_watermark=4,
                  min_decode_threads=1, max_decode_threads=None,
@@ -130,6 +132,9 @@ class AutotuneConfig(object):
         self.max_prefetch = max(self.min_prefetch, int(max_prefetch))
         self.min_inflight = max(1, int(min_inflight))
         self.max_inflight = max(self.min_inflight, int(max_inflight))
+        self.min_device_inflight = max(1, int(min_device_inflight))
+        self.max_device_inflight = max(self.min_device_inflight,
+                                       int(max_device_inflight))
         self.min_arena_depth = max(1, int(min_arena_depth))
         self.max_arena_depth = max(self.min_arena_depth, int(max_arena_depth))
         self.min_watermark = max(2, int(min_watermark))
@@ -250,7 +255,14 @@ _GROW_ACTIONS = {
     READER_STARVED: (('workers', 1), ('decode_threads', 2),
                      ('results_watermark', 8)),
     INPUT_BOUND: (('decode_threads', 2), ('workers', 1)),
-    DISPATCH_BOUND: (('inflight', 1), ('prefetch', 1)),
+    # dispatch-bound steps the PER-DEVICE in-flight window first (the
+    # per-device sharded staging path, ISSUE 14): transfer backpressure
+    # forms per device stream, so widening every stream's window attacks
+    # it directly; the batch-level window and prefetch depth remain the
+    # fallbacks once the per-device clamp is hit (and the only levers on
+    # single-device pipelines, which have no device_inflight knob).
+    DISPATCH_BOUND: (('device_inflight', 1), ('inflight', 1),
+                     ('prefetch', 1)),
     ARENA_BOUND: (('arena_depth', 2),),
 }
 
@@ -261,8 +273,8 @@ _GROW_ACTIONS = {
 # governor's mem-shrink sweep): a pipeline ahead of its consumer has no
 # business saturating the host's cores either.
 _SHRINK_STEPS = (('workers', 1), ('prefetch', 1), ('inflight', 1),
-                 ('arena_depth', 2), ('decode_threads', 2),
-                 ('results_watermark', 8))
+                 ('device_inflight', 1), ('arena_depth', 2),
+                 ('decode_threads', 2), ('results_watermark', 8))
 
 # Cumulative telemetry counters (everything else is a gauge).
 _CUMULATIVE_KEYS = ('batches', 'wait_s', 'reader_wait_s', 'arena_wait_s',

@@ -161,8 +161,13 @@ class DeviceDatasetCache(object):
         the default budget is 40% of HBM, not 80%. The caller clears its
         batch list right after this returns to release the inputs.
         """
-        import jax.numpy as jnp
-        jit_concat = self._jax.jit(lambda *xs: jnp.concatenate(xs, axis=0))
+        # NOT jnp.concatenate: this jaxlib's SPMD concat lowering sums
+        # replicas on partially-replicated meshes (see
+        # parallel.mesh.replica_safe_concat); equal-size batches are
+        # already a hard requirement here, so the stack+reshape form
+        # always applies.
+        from petastorm_tpu.parallel.mesh import replica_safe_concat
+        jit_concat = self._jax.jit(lambda *xs: replica_safe_concat(xs))
         self._batch_rows = len(getattr(batches[0], batches[0]._fields[0]))
         self._n_batches = len(batches)
         ragged = [i for i, b in enumerate(batches)

@@ -78,7 +78,8 @@ def _ring_attention_local(q, k, v, axis_name, causal, varying_axes):
     # so shard_map's vma check requires the initial carry be cast varying
     # over every mesh axis the inputs are mapped over (seq + any batch/head
     # axes), not just the ring axis.
-    out0, max0, denom0 = (jax.lax.pcast(x, varying_axes, to='varying')
+    from petastorm_tpu.models.shard_map_compat import pcast_varying
+    out0, max0, denom0 = (pcast_varying(x, varying_axes)
                           for x in (out0, max0, denom0))
     carry = (k, v, my_index, out0, max0, denom0)
     (_, _, _, out, _, denom), _ = jax.lax.scan(step, carry, None,
@@ -105,9 +106,10 @@ def ring_self_attention(q, k, v, mesh, seq_axis, causal=False,
     spec = PartitionSpec(batch_axis, seq_axis, head_axis, None)
     varying = tuple(a for a in (batch_axis, seq_axis, head_axis)
                     if a is not None)
-    fn = jax.shard_map(partial(_ring_attention_local, axis_name=seq_axis,
-                               causal=causal, varying_axes=varying),
-                       mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    from petastorm_tpu.models.shard_map_compat import shard_map
+    fn = shard_map(partial(_ring_attention_local, axis_name=seq_axis,
+                           causal=causal, varying_axes=varying),
+                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 
@@ -156,9 +158,10 @@ def a2a_self_attention(q, k, v, mesh, seq_axis, causal=False,
         is also active) must divide by ``mesh.shape[seq_axis]``.
     """
     spec = PartitionSpec(batch_axis, seq_axis, head_axis, None)
-    fn = jax.shard_map(partial(_a2a_attention_local, axis_name=seq_axis,
-                               causal=causal),
-                       mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    from petastorm_tpu.models.shard_map_compat import shard_map
+    fn = shard_map(partial(_a2a_attention_local, axis_name=seq_axis,
+                           causal=causal),
+                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 

@@ -1,0 +1,51 @@
+"""Version-portable ``shard_map`` access.
+
+The models were written against the stable ``jax.shard_map`` API (with its
+``check_vma`` static check and ``jax.lax.pcast`` varying-cast); the pinned
+jaxlib in some environments predates both — there the implementation lives
+at ``jax.experimental.shard_map.shard_map`` with the older ``check_rep``
+knob and no vma machinery at all. This shim keeps one call site per
+feature:
+
+``shard_map(f, mesh=..., in_specs=..., out_specs=..., check_vma=...)``
+    Resolves the stable API when present, else the experimental one
+    (mapping ``check_vma`` onto ``check_rep``; the experimental
+    replication check predates ``ppermute``-heavy bodies like ring
+    attention, so the fallback disables it — it is a static lint, not a
+    numerical semantic).
+
+``pcast_varying(x, axes)``
+    ``jax.lax.pcast(x, axes, to='varying')`` when the vma system exists;
+    identity otherwise (without vma tracking there is nothing to cast).
+"""
+
+import jax
+
+
+def shard_map(f=None, **kwargs):
+    """Drop-in for ``jax.shard_map`` across jax versions. Usable directly
+    or as a decorator factory via ``functools.partial`` exactly like the
+    stable API."""
+    if f is None:
+        import functools
+        return functools.partial(shard_map, **kwargs)
+    native = getattr(jax, 'shard_map', None)
+    if native is not None:
+        return native(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as experimental
+    kwargs = dict(kwargs)
+    kwargs.pop('check_vma', None)
+    # The experimental replication checker rejects valid ppermute/scan
+    # bodies the stable vma system accepts — disable the lint, keep the
+    # semantics.
+    kwargs.setdefault('check_rep', False)
+    return experimental(f, **kwargs)
+
+
+def pcast_varying(x, axes):
+    """Cast ``x`` varying over mesh ``axes`` where the vma system exists;
+    identity on jax versions without it."""
+    pcast = getattr(jax.lax, 'pcast', None)
+    if pcast is None or not axes:
+        return x
+    return pcast(x, axes, to='varying')

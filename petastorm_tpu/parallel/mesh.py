@@ -21,6 +21,122 @@ def process_shard():
     return jax.process_index(), jax.process_count()
 
 
+class DeviceShardPlan(object):
+    """Per-device slicing of a batch-dim-sharded host batch.
+
+    ``devices[k]`` receives local rows ``bounds[k] = (start, stop)``; the
+    staged shards stitch into the global array with
+    ``jax.make_array_from_single_device_arrays(global_shape, sharding,
+    shards)``. Because host batches are C-contiguous with a leading batch
+    dim, every bound is a zero-copy contiguous sub-slice — the layout is
+    computed once per (sharding, shape) and costs nothing per batch.
+    """
+
+    __slots__ = ('devices', 'bounds', 'global_shape')
+
+    def __init__(self, devices, bounds, global_shape):
+        self.devices = tuple(devices)
+        self.bounds = tuple(bounds)
+        self.global_shape = tuple(global_shape)
+
+    @property
+    def n_devices(self):
+        return len(self.devices)
+
+
+def replica_safe_concat(arrays):
+    """Leading-dim concatenation safe on partially-replicated meshes.
+
+    This jaxlib's SPMD ``jnp.concatenate`` lowering SUMS replicas into
+    the result when inputs carry a replicated mesh axis (e.g. a
+    ``('data', 'model')`` batch sharding — values come back multiplied by
+    the replica count; observed on the forced-multi-device CPU platform,
+    jax 0.4.37). Equal-shaped groups take a stack+reshape instead — the
+    same concatenation through a lowering that keeps replicas
+    replicated. A ragged group (only legal off-mesh, where the bug
+    cannot occur) keeps the plain concatenate. Trace-safe: shapes are
+    static under jit.
+    """
+    import jax.numpy as jnp
+    head = arrays[0]
+    if all(x.shape == head.shape for x in arrays[1:]):
+        return jnp.stack(arrays).reshape(
+            (len(arrays) * head.shape[0],) + tuple(head.shape[1:]))
+    return jnp.concatenate(arrays)
+
+
+def device_shard_plan(sharding, local_shape, process_count=None):
+    """Plan per-device shard assembly for one field, or ``None``.
+
+    Eligibility: the sharding partitions (at most) the leading batch dim —
+    every addressable device's index is a unit-stride row range covering
+    all non-batch dims — and the distinct row ranges are equal-sized and
+    exactly tile the ``local_shape[0]`` host rows. Replication (e.g. a
+    ``('data', 'model')`` mesh with the batch only on ``'data'``) is fine:
+    replica devices share a bound and each receives its own put of the
+    same sub-slice. Anything else (a sequence-sharded dim, uneven
+    partitions, addressable shards that don't tile the local batch)
+    returns ``None`` and the caller keeps the one-shot
+    ``make_array_from_process_local_data`` path.
+
+    Multi-host: the global batch is ``local_rows * process_count`` and the
+    k-th distinct addressable row range (in global order) maps to the k-th
+    local sub-slice — the same local-rows-in-global-order rule
+    ``make_array_from_process_local_data`` applies, so the two paths stage
+    identical global arrays.
+    """
+    local_shape = tuple(local_shape)
+    if not local_shape or local_shape[0] <= 0:
+        return None
+    if process_count is None:
+        process_count = jax.process_count()
+    global_shape = (local_shape[0] * int(process_count),) + local_shape[1:]
+    try:
+        indices_map = sharding.addressable_devices_indices_map(global_shape)
+    except (AttributeError, ValueError, TypeError):
+        return None
+    if not indices_map:
+        return None
+    entries = []
+    for device, index in indices_map.items():
+        if index is None:
+            index = ()
+        if not isinstance(index, tuple):
+            index = (index,)
+        if len(index) > len(global_shape):
+            return None
+        # Non-batch dims must be unsharded (full slices).
+        for dim, idx in zip(global_shape[1:], index[1:]):
+            if not isinstance(idx, slice):
+                return None
+            if idx.step not in (None, 1):
+                return None
+            if (idx.start not in (None, 0)
+                    or idx.stop not in (None, dim)):
+                return None
+        lead = index[0] if index else slice(None)
+        if not isinstance(lead, slice) or lead.step not in (None, 1):
+            return None
+        start = 0 if lead.start is None else int(lead.start)
+        stop = global_shape[0] if lead.stop is None else int(lead.stop)
+        if stop <= start:
+            return None
+        entries.append((device, start, stop))
+    distinct = sorted({(start, stop) for _, start, stop in entries})
+    sizes = {stop - start for start, stop in distinct}
+    if len(sizes) != 1:
+        return None
+    shard_rows = sizes.pop()
+    if shard_rows * len(distinct) != local_shape[0]:
+        # The addressable shards must exactly tile this host's rows.
+        return None
+    local_bounds = {span: (k * shard_rows, (k + 1) * shard_rows)
+                    for k, span in enumerate(distinct)}
+    devices = [device for device, _, _ in entries]
+    bounds = [local_bounds[(start, stop)] for _, start, stop in entries]
+    return DeviceShardPlan(devices, bounds, global_shape)
+
+
 def make_mesh(axis_shapes, devices=None):
     """Build a ``Mesh`` from ``{'axis': size}`` (``-1`` = fill with remaining).
 

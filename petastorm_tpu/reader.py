@@ -446,6 +446,57 @@ def make_batch_reader(dataset_url,
                   autotune=autotune, deterministic=deterministic)
 
 
+def make_pod_reader(dataset_url, reader_factory=None, pod_shard=None,
+                    **kwargs):
+    """Pod-host reader factory: ``cur_shard``/``shard_count`` mapped to
+    ``jax.process_index()``/``jax.process_count()``.
+
+    The reference coordinates multi-node input purely by static sharding
+    (``cur_shard=rank, shard_count=world``); on a pod the rank IS the JAX
+    process index, so every host calls this identically and reads its
+    disjoint stride of the dataset — feed the result to a ``JaxLoader``
+    built over the same mesh and the per-device staging path stitches
+    each host's shards into one global ``jax.Array``
+    (``docs/tpu_guide.rst``, "Multi-host staging").
+
+    :param reader_factory: which factory to wrap (default
+        :func:`make_tensor_reader` — the TPU hot path; pass
+        :func:`make_reader` / :func:`make_batch_reader` for the other
+        tiers).
+    :param pod_shard: explicit ``(cur_shard, shard_count)`` override —
+        lets a CPU test (or an orchestrator with its own rank mapping)
+        simulate pod hosts without a multi-process JAX runtime; default
+        resolves :func:`petastorm_tpu.parallel.mesh.process_shard`.
+    :param kwargs: forwarded to the factory. Passing ``cur_shard`` or
+        ``shard_count`` here is an error — the whole point is that the
+        process mapping owns them.
+
+    Tip: combine with ``deterministic=True`` so the per-host streams are
+    a stride over the deterministic *global* order — their round-robin
+    concatenation is then bit-identical to the single-host stream for
+    every host count, which is what makes multi-host correctness
+    CPU-testable (and ``merge_cursors`` resumable) before TPU time.
+    """
+    if 'cur_shard' in kwargs or 'shard_count' in kwargs:
+        raise ValueError(
+            'make_pod_reader owns cur_shard/shard_count (it maps them to '
+            'jax.process_index()/process_count()); pass pod_shard=(i, n) '
+            'to override, or call the underlying factory directly')
+    if reader_factory is None:
+        reader_factory = make_tensor_reader
+    if pod_shard is None:
+        from petastorm_tpu.parallel.mesh import process_shard
+        pod_shard = process_shard()
+    cur_shard, shard_count = int(pod_shard[0]), int(pod_shard[1])
+    if shard_count > 1:
+        return reader_factory(dataset_url, cur_shard=cur_shard,
+                              shard_count=shard_count, **kwargs)
+    # Single-host pods skip the sharding arguments entirely: a 1-shard
+    # stride is the unsharded stream, and some factories treat explicit
+    # sharding as a request (e.g. deterministic cursors carry it).
+    return reader_factory(dataset_url, **kwargs)
+
+
 def _schema_has_image_fields(schema):
     """True when any selected field decodes through the image codec — the
     gate for decode-thread-budget registration (and thereby the autotuner

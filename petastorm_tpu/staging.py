@@ -58,6 +58,12 @@ logger = logging.getLogger(__name__)
 
 _DONE = object()        # assemble exhausted its iterator
 
+#: Thread-name prefix of the per-device dispatch streams
+#: (:class:`DeviceStager`); registered in
+#: ``petastorm_tpu.analysis.registry`` so the conftest leak guard and the
+#: pstlint thread-lifecycle checker both know who joins them.
+DEVICE_PUT_THREAD_PREFIX = 'pst-device-put'
+
 
 _alias_probe_memo = {}
 
@@ -146,6 +152,42 @@ class HostArena(object):
         self._retired = False
         self._reclaimed = False
         self.view_epoch = 0
+        # Device-sharded layout memo: per-device contiguous sub-slices of
+        # each buffer, built once per arena and reused on every recycle
+        # (the buffers persist, so the views stay valid) — zero re-layout
+        # work at dispatch time. Keyed by (field, bounds) because a per-
+        # field sharding dict may split fields across different device
+        # counts.
+        self._shard_views = {}
+
+    def shard_views(self, name, bounds=None):
+        """Per-device contiguous sub-slices of buffer ``name`` along the
+        batch dim. ``bounds`` is a tuple of ``(start, stop)`` row ranges
+        (default: the layout the pool learned via
+        :meth:`ArenaPool.learn_shard_layout` — the dispatch path's form);
+        the views are memoized on the arena, so after the first batch a
+        dispatch pays zero slicing or layout work — the collate path
+        already landed each device's rows contiguously in the recycled
+        buffer."""
+        if bounds is None:
+            cached = self._shard_views.get((name, None))
+            if cached is not None:
+                return cached
+            layout = self._pool.shard_layout if self._pool else None
+            bounds = (layout or {}).get(name)
+            if bounds is None:
+                raise KeyError(
+                    'no shard layout learned for field {!r}'.format(name))
+            views = self.shard_views(name, bounds)
+            self._shard_views[(name, None)] = views
+            return views
+        key = (name, tuple(bounds))
+        views = self._shard_views.get(key)
+        if views is None:
+            buf = self.buffers[name]
+            views = tuple(buf[start:stop] for start, stop in key[1])
+            self._shard_views[key] = views
+        return views
 
     def borrow(self, array):
         """Borrow-tag ``array`` (one of this arena's buffers or a view of
@@ -243,6 +285,10 @@ class ArenaPool(object):
         self._spec = None
         self._allocated = 0
         self._pending = None
+        # Device-sharded layout ({field: ((start, stop), ...)} row bounds),
+        # learned once per schema from the NamedSharding by the loader;
+        # arenas consult it to memoize per-device sub-slice views.
+        self._shard_layout = None
         # counters (reset_stats() zeroes these, never the pool itself)
         self._alloc = 0
         self._reuse = 0
@@ -343,6 +389,28 @@ class ArenaPool(object):
         arena = self.claim_pending()
         if arena is not None:
             arena.retire()
+
+    def learn_shard_layout(self, field_bounds):
+        """Teach the pool the device-sharded layout of its batches:
+        ``{field: ((start, stop), ...)}`` per-device row bounds along the
+        batch dim, computed ONCE per schema from the ``NamedSharding``
+        (see ``parallel.mesh.device_shard_plan``). Arenas then hand the
+        dispatch stage memoized contiguous sub-slice views
+        (:meth:`HostArena.shard_views`) — the collate path needs no
+        change because a batch-dim shard of a C-contiguous buffer IS a
+        contiguous sub-slice of it. Incremental: fields merge into the
+        layout as their shardings are first seen."""
+        with self._cond:
+            if self._shard_layout is None:
+                self._shard_layout = {}
+            for name, bounds in field_bounds.items():
+                self._shard_layout[name] = tuple(
+                    (int(start), int(stop)) for start, stop in bounds)
+
+    @property
+    def shard_layout(self):
+        with self._cond:
+            return dict(self._shard_layout) if self._shard_layout else None
 
     def wake(self):
         """Wake any waiter so it can observe the stop flag promptly (the
@@ -535,6 +603,345 @@ class MeteredReader(object):
         return getattr(self._pst_reader, name)
 
 
+class DeviceStagerStopped(RuntimeError):
+    """A shard wave was aborted because the stager (or its pipeline) is
+    stopping — the batch never reached the device and must not be
+    delivered."""
+
+
+class DeviceStager(object):
+    """One overlapped ``device_put`` stream per addressable device.
+
+    The one-shot ``jax.make_array_from_process_local_data`` path issues
+    every device's transfer from a single thread and fences the whole
+    batch at once, so the collate of batch N+1 can only hide under the
+    *aggregate* transfer of batch N. This runs one dispatch stream (a
+    ``pst-device-put-<k>`` thread) per device instead: shard puts issue
+    concurrently across devices, each stream keeps its own bounded
+    in-flight window (blocking on its *oldest* transfer when full), and
+    the caller stitches the staged shards into a global ``jax.Array``
+    with ``jax.make_array_from_single_device_arrays`` — so collate of
+    shard k+1 hides under the transfer of shard k on *every* device, not
+    just along the batch dim of one.
+
+    jax-free by construction (``put_fn`` injected), so the stream
+    discipline — ordering, windows, donation accounting, stop semantics —
+    is unit-testable without a backend.
+
+    :param stream_keys: one label per stream (device ids); sets the
+        stream count and the ``device`` label on
+        ``pst_device_put_seconds``.
+    :param put_fn: ``(array, stream_index, donate) -> staged array``;
+        called on the stream's own thread, must be thread-safe across
+        streams (``jax.device_put`` is).
+    :param inflight: per-stream in-flight transfer window (the autotune
+        ``device_inflight`` knob; :meth:`set_inflight` retargets live).
+    :param ready_fn: ``staged -> None`` blocking until the transfer
+        completed; used for window backpressure only.
+    :param stop_event: shared stop flag; no stream outlives it.
+    """
+
+    def __init__(self, stream_keys, put_fn, inflight=2, ready_fn=None,
+                 stop_event=None, tracer=None):
+        self._keys = tuple(str(k) for k in stream_keys)
+        if not self._keys:
+            raise ValueError('DeviceStager needs at least one stream')
+        self._put_fn = put_fn
+        self._ready_fn = ready_fn or (lambda staged: None)
+        self._inflight = max(1, int(inflight))
+        self._stop = stop_event if stop_event is not None else threading.Event()
+        if tracer is None:
+            from petastorm_tpu.trace import NullTracer
+            tracer = NullTracer()
+        self._tracer = tracer
+        from petastorm_tpu import metrics as metrics_mod
+        self._m_put = metrics_mod.histogram(
+            'pst_device_put_seconds',
+            'Per-device shard device_put latency (issue time; window '
+            'fences are reported separately)', labelnames=('device',))
+        self._m_donated = metrics_mod.counter(
+            'pst_shards_donated_total',
+            'Arena-backed shards handed to the device transfer with no '
+            'loader-side host copy (stream-tier puts additionally donate '
+            'the buffer to the backend)')
+        self._stats_lock = threading.Lock()
+        self._put_s = {k: 0.0 for k in self._keys}
+        self._put_bytes = {k: 0 for k in self._keys}
+        self._shards_put = 0
+        self._donated = 0
+        self._ready_wait_s = 0.0
+        self._window_bytes = 0
+        self._leaked_threads = []
+        # Bounded (pstlint bounded-queues): one submission wave queues at
+        # most fields-per-batch items per stream before the submitter
+        # blocks on the wave's completion, so 128 is generous headroom —
+        # the bound exists so a bug can't grow an unbounded backlog.
+        self._queues = [queue.Queue(maxsize=128) for _ in self._keys]
+        self._start_lock = threading.Lock()
+        self._started = False
+        self._threads = [
+            threading.Thread(target=self._stream_loop, args=(i,),
+                             daemon=True,
+                             name='pst-device-put-{}'.format(key))
+            for i, key in enumerate(self._keys)]
+
+    def start(self):
+        """Start the stream threads. Idempotent, and called lazily from
+        the first :meth:`put_shards` wave — an owner whose constructor
+        fails after building the stager must not leak 8 parked threads
+        with no reachable stop path (the inline tier never starts them
+        at all)."""
+        with self._start_lock:
+            if not self._started:
+                self._started = True
+                for t in self._threads:
+                    t.start()
+        return self
+
+    @property
+    def n_streams(self):
+        return len(self._keys)
+
+    # -- submission --------------------------------------------------------
+
+    def put_shards(self, items):
+        """Dispatch one wave of shards: ``items`` is a list of
+        ``(stream_index, array, donate)``; returns the staged arrays in
+        the same order once every put has been *issued* (transfers
+        complete in the background against the per-stream windows).
+        ``donate`` marks that shard's source buffer donated — an
+        arena-backed sub-slice whose recycling is already gated on
+        transfer completion (and consumer GC on aliasing backends), so
+        the backend may consume it without a defensive host copy; the
+        caller must not donate a buffer shared by another shard of the
+        wave (replicated bounds). Raises :class:`DeviceStagerStopped`
+        when the stager is stopping mid-wave; re-raises the first
+        ``put_fn`` failure otherwise."""
+        if not self._started:
+            self.start()
+        results = [None] * len(items)
+        state = {'remaining': len(items), 'error': None}
+        done = threading.Event()
+        lock = threading.Lock()
+        for slot, (stream, array, donate) in enumerate(items):
+            self._enqueue(stream, (array, bool(donate), slot, results,
+                                   state, lock, done))
+        while not done.is_set():
+            if self._stop.is_set():
+                raise DeviceStagerStopped(
+                    'device stager stopping mid-wave ({} shard(s) '
+                    'outstanding)'.format(state['remaining']))
+            done.wait(0.1)
+        if state['error'] is not None:
+            raise state['error']
+        return results
+
+    def _enqueue(self, stream, item):
+        q = self._queues[stream]
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+        raise DeviceStagerStopped('device stager stopping')
+
+    # -- per-stream loop ---------------------------------------------------
+
+    def _stream_loop(self, index):
+        window = deque()    # (staged, nbytes) — owned by this thread only
+        q = self._queues[index]
+        key = self._keys[index]
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=0.1)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        return
+                    # Idle streams opportunistically drain their window so
+                    # arenas retire without waiting for the next wave.
+                    while window and not self._stop.is_set():
+                        if not self._retire_oldest(window, block=False):
+                            break
+                    continue
+                array, donate, slot, results, state, lock, done = item
+                try:
+                    t0 = time.perf_counter()
+                    staged = self._put_fn(array, index, donate)
+                    dt = time.perf_counter() - t0
+                    nbytes = int(getattr(array, 'nbytes', 0))
+                    self._m_put.labels(key).observe(dt)
+                    if donate:
+                        self._m_donated.inc()
+                    with self._stats_lock:
+                        self._put_s[key] += dt
+                        self._put_bytes[key] += nbytes
+                        self._shards_put += 1
+                        if donate:
+                            self._donated += 1
+                        self._window_bytes += nbytes
+                    window.append((staged, nbytes))
+                    # Deliver BEFORE fencing the window tail: the caller
+                    # stitches (and the assemble thread collates the next
+                    # batch) while this stream pays its backpressure.
+                    with lock:
+                        results[slot] = staged
+                        state['remaining'] -= 1
+                        if state['remaining'] <= 0:
+                            done.set()
+                    while len(window) > self._inflight:
+                        self._retire_oldest(window, block=True)
+                except Exception as e:  # noqa: BLE001 - surfaced to the wave
+                    with lock:
+                        state['error'] = e
+                        done.set()
+        finally:
+            # Stop path: drop the window's byte accounting (the staged
+            # arrays keep their own memory alive; nothing to fence on a
+            # pipeline that is going away).
+            while window:
+                self._retire_oldest(window, block=False)
+
+    def _retire_oldest(self, window, block):
+        """Retire the stream's oldest in-flight transfer. ``block=True``
+        fences it (window backpressure); ``block=False`` only retires an
+        already-complete transfer. Returns whether an entry retired."""
+        staged, nbytes = window.popleft()
+        if block and not self._stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                self._ready_fn(staged)
+            except Exception:  # noqa: BLE001 - a dying fence must not kill the stream
+                logger.debug('device stager ready_fn failed', exc_info=True)
+            with self._stats_lock:
+                self._ready_wait_s += time.perf_counter() - t0
+                self._window_bytes -= nbytes
+            return True
+        if not block and not self._stop.is_set():
+            try:
+                if not self._probe_ready(staged):
+                    window.appendleft((staged, nbytes))
+                    return False
+            except Exception:  # noqa: BLE001
+                pass
+        with self._stats_lock:
+            self._window_bytes -= nbytes
+        return True
+
+    @staticmethod
+    def _probe_ready(staged):
+        probe = getattr(staged, 'is_ready', None)
+        return True if probe is None else bool(probe())
+
+    def record_inline_wave(self, stream_indices, nbytes_list, elapsed,
+                           donate):
+        """Account a wave the owner issued INLINE (one batched per-device
+        transfer on its own thread — the small-shard fast tier) so
+        per-device put seconds/bytes and donation counts stay coherent
+        across tiers. Issue time is attributed evenly across the wave's
+        shards (the batched call is one C++ fan-out; per-shard splits are
+        not observable)."""
+        count = max(1, len(stream_indices))
+        per_shard = elapsed / count
+        for index, nbytes in zip(stream_indices, nbytes_list):
+            key = self._keys[index]
+            self._m_put.labels(key).observe(per_shard)
+            if donate:
+                self._m_donated.inc()
+        with self._stats_lock:
+            for index, nbytes in zip(stream_indices, nbytes_list):
+                key = self._keys[index]
+                self._put_s[key] += per_shard
+                self._put_bytes[key] += int(nbytes)
+                self._shards_put += 1
+                if donate:
+                    self._donated += 1
+
+    # -- knobs / stats / lifecycle ----------------------------------------
+
+    def set_inflight(self, n):
+        """Retarget the per-stream in-flight window (the autotune
+        ``device_inflight`` knob): each stream re-reads it per shard, so
+        widening takes effect on the next put and narrowing drains by
+        fencing the oldest transfers."""
+        self._inflight = max(1, int(n))
+
+    @property
+    def inflight_window(self):
+        return self._inflight
+
+    @property
+    def ready_wait_seconds(self):
+        """Cumulative seconds streams spent fenced on their oldest
+        in-flight transfer — folded into the autotuner's dispatch-bound
+        signal next to the engine's batch-level fence."""
+        with self._stats_lock:
+            return self._ready_wait_s
+
+    @property
+    def window_nbytes(self):
+        """Host bytes currently referenced by every stream's in-flight
+        window (the membudget ``device-put-window`` pool; the loader
+        reports 0 when the same bytes are already accounted by the arena
+        pool)."""
+        with self._stats_lock:
+            return self._window_bytes
+
+    def stats(self):
+        with self._stats_lock:
+            return {
+                'n_devices': len(self._keys),
+                'device_inflight': self._inflight,
+                'shards_put': self._shards_put,
+                'shards_donated': self._donated,
+                'device_ready_wait_s': round(self._ready_wait_s, 4),
+                'device_put_s': {k: round(v, 4)
+                                 for k, v in self._put_s.items()},
+                'device_put_bytes': dict(self._put_bytes),
+                'leaked_threads': list(self._leaked_threads)}
+
+    def reset_stats(self):
+        with self._stats_lock:
+            self._put_s = {k: 0.0 for k in self._keys}
+            self._put_bytes = {k: 0 for k in self._keys}
+            self._shards_put = 0
+            self._donated = 0
+            self._ready_wait_s = 0.0
+
+    @property
+    def alive(self):
+        return any(t.is_alive() for t in self._threads)
+
+    def stop(self, join_timeout_s=10):
+        """Idempotent: set stop, join every stream. A stream outliving
+        the join (a put hung on a wedged device) is recorded in
+        ``stats()['leaked_threads']`` and logged — mirroring
+        :meth:`StagingEngine.stop`'s never-pretend-success contract."""
+        self._stop.set()
+        leaked = []
+        with self._start_lock:
+            started = self._started
+        for t in self._threads:
+            if not started:
+                break
+            t.join(timeout=join_timeout_s)
+            if t.is_alive():
+                leaked.append(t.name)
+        if leaked:
+            with self._stats_lock:
+                self._leaked_threads.extend(
+                    n for n in leaked if n not in self._leaked_threads)
+            for name in leaked:
+                self._tracer.instant('device-stager-leaked:{}'.format(name),
+                                     cat='watchdog')
+            logger.warning(
+                'DeviceStager.stop: stream thread(s) %s still alive after '
+                '%.1fs join — a hung device_put is leaking them past '
+                'shutdown.', leaked, join_timeout_s)
+        return leaked
+
+
 class _StageError(object):
     def __init__(self, exc):
         self.exc = exc
@@ -572,9 +979,16 @@ class StagingEngine(object):
     def __init__(self, host_iter, stage_fn, out_queue, stop_event,
                  end_sentinel, pool=None, inflight=2, ready_fn=None,
                  is_ready_fn=None, holds_mode=False, tracer=None,
-                 meter=None, health=None, on_drop=None):
+                 meter=None, health=None, on_drop=None,
+                 stage_with_arena=False):
         self._host_iter = host_iter
         self._stage_fn = stage_fn
+        # stage_with_arena: call ``stage_fn(batch, arena)`` so a device-
+        # sharded stage can reuse the arena's memoized per-device
+        # sub-slice views (HostArena.shard_views) instead of re-slicing
+        # every batch. The arena still joins the in-flight window AFTER
+        # staging, exactly as before.
+        self._stage_with_arena = bool(stage_with_arena)
         self._out = out_queue
         self._stop = stop_event
         self._end = end_sentinel
@@ -792,7 +1206,10 @@ class StagingEngine(object):
                 t_dispatch = time.perf_counter()
                 with self.meter.track('dispatch'):
                     with self._tracer.span('dispatch', 'device'):
-                        staged = self._stage_fn(batch)
+                        if self._stage_with_arena:
+                            staged = self._stage_fn(batch, arena)
+                        else:
+                            staged = self._stage_fn(batch)
                 self._m_dispatch.observe(time.perf_counter() - t_dispatch)
                 if arena is not None:
                     if self._holds_mode:

@@ -136,7 +136,9 @@ def test_jax_loader_stage_chunks_parity(synthetic_dataset, monkeypatch):
     """stage_chunks splits large fields into several puts + an on-device
     concat (tunnel transport optimization): delivered batches must be
     bitwise identical to one-shot staging, small fields stay one-shot, and
-    multi-device shardings fall back to the normal path."""
+    multi-device shardings chunk per device through the per-device
+    sharded path (the old fall-back-to-one-shot restriction is gone —
+    tests/test_multichip_staging.py covers its parity)."""
     import jax
     from jax.sharding import Mesh
 
@@ -154,11 +156,13 @@ def test_jax_loader_stage_chunks_parity(synthetic_dataset, monkeypatch):
     for (id1, m1), (idk, mk) in zip(*runs):
         np.testing.assert_array_equal(id1, idk)
         np.testing.assert_array_equal(m1, mk)
-    # Multi-device mesh: chunked staging must fall back, shards stay correct.
+    # Multi-device mesh: each device's shard chunks on its own stream;
+    # shards stay correct.
     mesh8 = make_mesh({'data': 8})
     with _row_reader(synthetic_dataset.url, schema_fields=['matrix']) as reader:
         with JaxLoader(reader, 16, mesh=mesh8, stage_chunks=4) as loader:
             batch = next(loader)
+            assert loader.stats['n_devices'] == 8
     assert batch.matrix.addressable_shards[0].data.shape == (2, 4, 5)
 
 

@@ -567,7 +567,8 @@ def test_registry_thread_prefixes_cover_live_thread_names():
                  'pst-lineage-writer', 'pst-chunk-store-writer',
                  'pst-ventilator', 'pst-staging-assemble',
                  'pst-data-service-serve', 'pst-pool-worker-3',
-                 'pst-orphan-watch', 'pst-mem-governor'):
+                 'pst-orphan-watch', 'pst-mem-governor',
+                 'pst-device-put-3'):
         assert any(name.startswith(p) for p in prefixes), name
     for guard in registry.THREAD_GUARDS:
         assert guard.prefix.startswith('pst-')
