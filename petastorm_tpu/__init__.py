@@ -32,6 +32,8 @@ from petastorm_tpu.lineage import (LineageTracker,  # noqa: F401
                                    replay_record, verify_record)
 from petastorm_tpu.metrics import (MetricsExporter,  # noqa: F401
                                    MetricsRegistry, start_http_exporter)
+from petastorm_tpu.serving import (LookupClient, LookupEngine,  # noqa: F401
+                                   LookupServer)
 from petastorm_tpu.reader import (Reader, make_batch_reader,  # noqa: F401
                                   make_pod_reader, make_reader,
                                   make_tensor_reader)

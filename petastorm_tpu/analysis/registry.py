@@ -126,6 +126,13 @@ THREAD_GUARDS = (
         'data-service tests assert server shutdown explicitly.',
         action='note'),
     ThreadGuard(
+        'pst-lookup', 'petastorm_tpu.serving.server',
+        'Lookup-tier rpc/worker/lease threads (pst-lookup-rpc, '
+        'pst-lookup-worker-<i>, pst-lookup-lease) are daemons joined by '
+        'LookupServer.stop(); serving tests assert server shutdown, and '
+        'the sweep fails a server leaked past its test.',
+        marker='serving', action='fail'),
+    ThreadGuard(
         'pst-pool-worker', 'petastorm_tpu.workers.thread_pool',
         'Daemon pool workers joined by ThreadPool.join(); retirement '
         'between items is the resize contract, tested in '

@@ -50,6 +50,72 @@ class SingleFieldIndexer(RowGroupIndexerBase):
                 'values': {v: sorted(ids) for v, ids in self._values.items()}}
 
 
+class SingleFieldRowIndexer(RowGroupIndexerBase):
+    """Row-level key index: value -> ``[(row-group ordinal, row offset)]``.
+
+    The row-group-level :class:`SingleFieldIndexer` answers "which
+    row-groups contain key K" — enough to prune an epoch scan, too coarse
+    for a point read (the reader still decodes the whole group and scans
+    it). This indexer keeps the offset of every matching row *inside* its
+    row-group, so the serving tier (``petastorm_tpu.serving``) can slice
+    exactly the requested rows out of a decoded block in one step.
+
+    The payload stays selector-compatible: each value maps to a list of
+    ``[piece, offset]`` pairs, and the selectors treat a pair's first
+    element as the row-group ordinal (``selectors.entry_row_groups``), so
+    ``SingleIndexSelector``/``IntersectIndexSelector``/``UnionIndexSelector``
+    compose over a row-level index unchanged.
+    """
+
+    def __init__(self, index_name, index_field):
+        self._index_name = index_name
+        self._field_name = index_field
+        self._values = {}
+
+    @property
+    def index_name(self):
+        return self._index_name
+
+    @property
+    def column_names(self):
+        return [self._field_name]
+
+    @property
+    def indexed_values(self):
+        return sorted(self._values)
+
+    def get_row_group_indexes(self, value_key):
+        """Row-group ordinals (the base-class contract); use
+        :meth:`get_row_locations` for the per-row positions."""
+        return sorted({piece for piece, _ in
+                       self._values.get(str(value_key), ())})
+
+    def get_row_locations(self, value_key):
+        """``[(piece_index, row_offset)]`` of every row holding the value,
+        in dataset order."""
+        return sorted(self._values.get(str(value_key), ()))
+
+    def build_index(self, decoded_rows, piece_index):
+        for offset, row in enumerate(decoded_rows):
+            value = row.get(self._field_name)
+            if value is None:
+                continue
+            self._values.setdefault(str(value), []).append(
+                (piece_index, offset))
+
+    def __add__(self, other):
+        if other.index_name != self.index_name:
+            raise ValueError('Cannot merge indexers of different indexes')
+        for value, locations in other._values.items():
+            self._values.setdefault(value, []).extend(locations)
+        return self
+
+    def to_json_payload(self):
+        return {'type': 'single_field_rows', 'field': self._field_name,
+                'values': {v: [list(loc) for loc in sorted(locs)]
+                           for v, locs in self._values.items()}}
+
+
 class FieldNotNullIndexer(RowGroupIndexerBase):
     """Indexes row-groups that contain at least one non-null value of a field."""
 

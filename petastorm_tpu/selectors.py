@@ -1,8 +1,21 @@
 """Row-group selectors: choose row-groups via stored indexes.
 
 Parity: reference ``petastorm/selectors.py`` — ``RowGroupSelectorBase``,
-``SingleIndexSelector``, plus intersection/union combinators.
+``SingleIndexSelector``, plus intersection/union combinators. Selectors
+compose over BOTH index granularities: the classic row-group-level
+payloads (``SingleFieldIndexer``: value -> ordinals) and the serving
+tier's row-level payloads (``SingleFieldRowIndexer``: value ->
+``[piece, offset]`` pairs) — :func:`entry_row_groups` normalizes either
+entry shape to row-group ordinals.
 """
+
+
+def entry_row_groups(entries):
+    """Row-group ordinals from one index value's entry list: plain ints
+    (row-group-level indexes) or ``[piece, row_offset]`` pairs (the
+    row-level ``SingleFieldRowIndexer`` payload)."""
+    return {entry[0] if isinstance(entry, (list, tuple)) else entry
+            for entry in entries}
 
 
 class RowGroupSelectorBase(object):
@@ -32,7 +45,7 @@ class SingleIndexSelector(RowGroupSelectorBase):
         value_map = indexes[self._index_name]['values']
         selected = set()
         for value in self._values:
-            selected.update(value_map.get(str(value), ()))
+            selected |= entry_row_groups(value_map.get(str(value), ()))
         return selected
 
 

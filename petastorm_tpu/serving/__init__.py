@@ -1,0 +1,50 @@
+"""Online lookup tier: millisecond point reads over the training cache.
+
+ROADMAP item 5 ("millions of users means point reads, not just epoch
+streams"): a feature-store-grade random-access path composed from pieces
+already in-tree — the row-group index machinery
+(``etl/rowgroup_indexing``) extended to row granularity, the
+predicates/selectors, the mmap decoded-chunk store as a memcpy-speed hot
+tier, and the data-service control-plane discipline (leases, graceful
+drain, admission control with typed refusals, client circuit breaker +
+hedged requests). The disaggregation thesis of the tf.data service
+(arXiv:2210.14826) applied to the serving side, with the cache-tier
+discipline of tf.data (arXiv:2101.12127): trainers and online lookups
+warm ONE shared cache hierarchy.
+
+Modules
+-------
+
+:mod:`petastorm_tpu.serving.row_index`
+    Loads the row-level key index a ``SingleFieldRowIndexer`` pass
+    persisted into ``_common_metadata``: key value -> ``(row-group,
+    row-offset)`` locations.
+
+:mod:`petastorm_tpu.serving.engine`
+    :class:`~petastorm_tpu.serving.engine.LookupEngine` — the local
+    request path: ``lookup(keys)`` / ``query(predicate, selector)``
+    resolved through the index, served from the
+    :class:`~petastorm_tpu.chunk_store.DecodedChunkStore` mmap hot tier
+    (one memcpy on a hit), decode-and-fill on a miss through the same
+    ``tensor_chunk_key`` the training readers use, with per-row-group
+    request coalescing so a hot-key storm decodes once.
+
+:mod:`petastorm_tpu.serving.server` / :mod:`petastorm_tpu.serving.client`
+    The service plane: ``lookup``/``query`` verbs on a ZMQ rpc socket
+    with lease heartbeats, graceful drain, ``max_consumers`` admission
+    (typed refusals), a ``membudget``-registered response pool, and SLO
+    metrics (``pst_lookup_requests_total{verb,outcome}``,
+    ``pst_lookup_latency_seconds``, ``pst_lookup_cache_hits_total{tier}``);
+    the client failovers across endpoints, breaks the circuit on
+    blackholed servers, and hedges slow reads.
+
+Smoke-test without writing code::
+
+    python -m petastorm_tpu.tools.lookup --dataset-url URL \
+        --key id=7 [--build-index] [--store DIR] [--serve]
+"""
+
+from petastorm_tpu.serving.client import LookupClient  # noqa: F401
+from petastorm_tpu.serving.engine import LookupEngine  # noqa: F401
+from petastorm_tpu.serving.row_index import RowLocationIndex  # noqa: F401
+from petastorm_tpu.serving.server import LookupServer  # noqa: F401

@@ -1,0 +1,416 @@
+"""LookupClient: fleet-aware point reads with failover, breaker, hedging.
+
+The consumer half of the lookup tier's control-plane contract
+(:mod:`petastorm_tpu.serving.server`):
+
+* **typed-refusal failover** — a ``{'refused': 'draining'|'overloaded'}``
+  reply is breaker-*success* (the server is alive and answering) but the
+  request immediately moves to the next endpoint; when EVERY endpoint
+  refuses, :class:`~petastorm_tpu.errors.ServerOverloaded` carries the
+  refusal reason out to the caller;
+* **per-endpoint circuit breaker**
+  (:class:`~petastorm_tpu.retry.CircuitBreaker`) — a blackholed server
+  costs the rpc timeout ``failure_threshold`` times, then is skipped
+  instantly until its half-open probe heals;
+* **hedged reads** — when a server sits on a request past
+  ``hedge_after_ms``, the same read is also sent to the next healthy
+  endpoint; first valid reply wins (``pst_lookup_hedges_total``). Safe
+  because lookups are idempotent reads of an immutable dataset;
+* **lease awareness** — the client drains the servers' heartbeat PUB
+  stream between requests; endpoints whose last heartbeat reported
+  ``draining``/``drained``, or that went a full lease silent after
+  heartbeating, sort to the back of the candidate list (the PR-10
+  zero-rpc liveness rule).
+"""
+
+import logging
+import pickle
+import threading
+import time
+import uuid
+
+logger = logging.getLogger(__name__)
+
+_REFUSAL_REASONS = ('draining', 'drained', 'overloaded')
+
+
+class LookupClient(object):
+    """Point reads against one or more :class:`LookupServer` endpoints
+    serving the SAME dataset (replicas — hedging and failover assume any
+    endpoint can answer any read).
+
+    :param endpoints: rpc endpoint list (``tcp://host:port``).
+    :param control_endpoints: matching heartbeat endpoints (optional;
+        enables lease-aware endpoint ordering).
+    :param timeout_ms: whole-request deadline.
+    :param hedge_after_ms: silence before the next endpoint is hedged.
+    :param consumer_id: admission identity (default: a fresh uuid).
+    """
+
+    def __init__(self, endpoints, control_endpoints=None, timeout_ms=5000,
+                 hedge_after_ms=300, consumer_id=None,
+                 breaker_threshold=3, breaker_reset_s=15.0):
+        import zmq
+        self._zmq = zmq
+        self._context = zmq.Context.instance()
+        self._endpoints = list(endpoints)
+        if not self._endpoints:
+            raise ValueError('LookupClient needs at least one endpoint')
+        self._timeout_ms = int(timeout_ms)
+        self._hedge_after_ms = int(hedge_after_ms)
+        self._consumer_id = consumer_id or 'lookup-{}'.format(
+            uuid.uuid4().hex[:12])
+        self._lock = threading.Lock()
+        self._breakers = {}
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_reset_s = float(breaker_reset_s)
+        # Persistent per-endpoint REQ sockets (the "lazy pirate"
+        # optimization): a fresh TCP + ZMTP handshake costs several ms —
+        # more than a warm point read itself — so sockets that completed
+        # a clean round trip are cached and reused. A socket whose
+        # request timed out, got a garbled reply, or was abandoned by a
+        # winning hedge is CLOSED instead (a REQ state machine cannot
+        # take a new request while one is outstanding).
+        self._socks = {}
+        from petastorm_tpu import metrics as metrics_mod
+        self._m_hedges = metrics_mod.counter(
+            'pst_lookup_hedges_total',
+            'Lookup requests where a hedge was sent to another endpoint')
+        self.hedges = 0
+        self._closed = False
+        # Lease watching: SUB to every control endpoint; heartbeats drain
+        # non-blocking at each request. Keyed by the DIALED rpc endpoint:
+        # {endpoint: (state, lease_s, at)}. A heartbeat advertises the
+        # server's own view of its rpc address, which can differ from the
+        # address the client dialed (wildcard binds resolve to a
+        # hostname; the operator dialed an IP) — `_server_ids` maps the
+        # heartbeat's server_id to the dialed endpoint, learned from rpc
+        # replies (every reply carries `server_id`), so the ranking
+        # always looks heartbeats up under the key it ranks by.
+        self._hb = {}
+        self._server_ids = {}
+        self._sub = None
+        if control_endpoints:
+            self._sub = self._context.socket(zmq.SUB)
+            self._sub.setsockopt(zmq.SUBSCRIBE, b'')
+            for ctrl_ep in control_endpoints:
+                self._sub.connect(ctrl_ep)
+
+    # -- endpoint health ---------------------------------------------------
+
+    def _breaker(self, endpoint):
+        from petastorm_tpu.retry import CircuitBreaker
+        with self._lock:
+            breaker = self._breakers.get(endpoint)
+            if breaker is None:
+                breaker = self._breakers[endpoint] = CircuitBreaker(
+                    failure_threshold=self._breaker_threshold,
+                    reset_timeout_s=self._breaker_reset_s)
+            return breaker
+
+    def _socket_for(self, endpoint):
+        """A ready REQ socket for ``endpoint`` — the cached one (idle,
+        clean) or a fresh connect."""
+        zmq = self._zmq
+        with self._lock:
+            sock = self._socks.pop(endpoint, None)
+        if sock is None:
+            sock = self._context.socket(zmq.REQ)
+            sock.setsockopt(zmq.LINGER, 0)
+            sock.connect(endpoint)
+        return sock
+
+    def _release_socket(self, endpoint, sock):
+        """Return a socket whose round trip completed cleanly."""
+        with self._lock:
+            if self._closed or endpoint in self._socks:
+                pass
+            else:
+                self._socks[endpoint] = sock
+                return
+        sock.close(linger=0)
+
+    def breaker_state(self, endpoint):
+        return self._breaker(endpoint).state
+
+    def _note_server_id(self, endpoint, reply):
+        """Bind a reply's server identity to the endpoint we dialed —
+        what lets heartbeats (which advertise the server's OWN address
+        view) resolve back to the dialed key the ranking uses."""
+        sid = reply.get('server_id') if isinstance(reply, dict) else None
+        if sid is not None:
+            self._server_ids[sid] = endpoint
+
+    def _drain_heartbeats(self):
+        """Non-blocking: fold every queued lease heartbeat into the
+        per-endpoint view (SUB sockets are owned by the caller thread —
+        requests are issued from whatever thread calls them, but the
+        client is documented single-caller like RemoteReader)."""
+        if self._sub is None:
+            return
+        from petastorm_tpu.serving.server import CTRL_HB
+        import json
+        zmq = self._zmq
+        while True:
+            try:
+                raw = self._sub.recv(zmq.NOBLOCK)
+            except zmq.Again:
+                return
+            except zmq.ZMQError:
+                return
+            if not raw.startswith(CTRL_HB):
+                continue
+            try:
+                body = json.loads(raw[len(CTRL_HB):].decode('utf-8'))
+            except ValueError:
+                continue
+            # Resolve the heartbeat to the DIALED endpoint the ranking
+            # keys by: via the server-id binding learned from replies,
+            # else the advertised rpc address when it happens to be one
+            # we dialed (the loopback/test case).
+            endpoint = self._server_ids.get(body.get('server_id'))
+            if endpoint is None:
+                rpc = body.get('rpc')
+                endpoint = rpc if rpc in self._endpoints else None
+            if endpoint is not None:
+                self._hb[endpoint] = (body.get('state'),
+                                      float(body.get('lease_s') or 10.0),
+                                      time.monotonic())
+
+    def _candidates(self):
+        """Endpoints to try, healthiest first: breaker-open endpoints
+        last, then lease-draining/expired ones, then everything else in
+        declared order."""
+        from petastorm_tpu.retry import CircuitBreaker
+        self._drain_heartbeats()
+        now = time.monotonic()
+
+        def rank(endpoint):
+            score = 0
+            if self._breaker(endpoint).state == CircuitBreaker.OPEN:
+                score += 4
+            hb = self._hb.get(endpoint)
+            if hb is not None:
+                state, lease_s, at = hb
+                if state in ('draining', 'drained'):
+                    score += 2
+                if now - at > lease_s:
+                    # Heartbeats stopped for a whole lease: presumed dead
+                    # without paying an rpc timeout to find out.
+                    score += 3
+            return score
+        return sorted(self._endpoints, key=rank)
+
+    # -- the request core --------------------------------------------------
+
+    def _request(self, request, hedge=True):
+        """One logical request with failover + hedging. Returns the first
+        non-refusal reply; raises ``ServerOverloaded`` when every
+        endpoint refused, ``RpcUnanswered`` when nobody answered."""
+        from petastorm_tpu.data_service import RpcUnanswered
+        from petastorm_tpu.errors import ServerOverloaded
+        zmq = self._zmq
+        if self._closed:
+            raise RuntimeError('LookupClient is closed')
+        request = dict(request, consumer=self._consumer_id)
+        payload = pickle.dumps(request, protocol=5)
+        candidates = self._candidates()
+        deadline = time.monotonic() + self._timeout_ms / 1000.0
+        poller = zmq.Poller()
+        socks = {}
+        pending = list(candidates)
+        refusal = None
+        try:
+            while True:
+                now = time.monotonic()
+                if now >= deadline:
+                    break
+                if pending and (hedge or not socks):
+                    endpoint = pending.pop(0)
+                    if not self._breaker(endpoint).allow():
+                        # Open circuit: skip instantly (the allow() call
+                        # is also what grants the half-open heal probe
+                        # once the reset timeout elapses).
+                        continue
+                    # A genuine hedge = sending while another endpoint
+                    # still has this request outstanding. A sequential
+                    # failover (prior endpoint already answered a typed
+                    # refusal, nothing in flight) is NOT one — counting
+                    # it would inflate the hedge SLO metric on every
+                    # read of a rolling drain.
+                    is_hedge = bool(socks)
+                    sock = self._socket_for(endpoint)
+                    sock.send(payload)
+                    poller.register(sock, zmq.POLLIN)
+                    socks[sock] = endpoint
+                    if is_hedge:
+                        self._m_hedges.inc()
+                        with self._lock:
+                            self.hedges += 1
+                elif not socks:
+                    break            # everyone answered a refusal/error
+                wait_ms = (deadline - now) * 1000.0
+                if pending and hedge:
+                    wait_ms = min(wait_ms, self._hedge_after_ms)
+                for sock, _ in poller.poll(max(int(wait_ms), 1)):
+                    endpoint = socks[sock]
+                    try:
+                        reply = pickle.loads(sock.recv())
+                    except Exception:  # noqa: BLE001 - garbled: next hedge
+                        self._breaker(endpoint).record_failure()
+                        poller.unregister(sock)
+                        sock.close(linger=0)
+                        del socks[sock]
+                        continue
+                    self._breaker(endpoint).record_success()
+                    self._note_server_id(endpoint, reply)
+                    poller.unregister(sock)
+                    del socks[sock]
+                    self._release_socket(endpoint, sock)
+                    if isinstance(reply, dict) and 'refused' in reply:
+                        # Typed admission refusal: the server is healthy
+                        # but not taking us — remember why, fail over NOW
+                        # (don't wait out the hedge delay).
+                        refusal = (endpoint, reply)
+                        self._hb[endpoint] = (
+                            reply.get('state') or reply.get('refused'),
+                            self._hb.get(endpoint, (None, 10.0, 0))[1],
+                            time.monotonic())
+                        continue
+                    if isinstance(reply, dict) and 'error' in reply:
+                        raise RuntimeError(
+                            'lookup rpc failed on {}: {}'.format(
+                                endpoint, reply['error']))
+                    return reply
+                if not socks and not pending:
+                    break
+            for endpoint in socks.values():
+                # Sat on the request for the whole budget: breaker-visible.
+                self._breaker(endpoint).record_failure()
+            if refusal is not None:
+                endpoint, reply = refusal
+                raise ServerOverloaded(
+                    'every lookup endpoint refused this consumer '
+                    '(last: {} said {!r})'.format(endpoint,
+                                                  reply.get('refused')),
+                    endpoint=endpoint,
+                    reason=reply.get('reason') or reply.get('refused'))
+            raise RpcUnanswered(
+                'no lookup endpoint answered within {}ms (tried {})'.format(
+                    self._timeout_ms, candidates))
+        finally:
+            for sock in socks:
+                sock.close(linger=0)
+
+    # -- public verbs ------------------------------------------------------
+
+    def lookup(self, keys, fields=None):
+        """Point reads: per key, the list of matching rows
+        (``{field: numpy value}`` dicts; empty list = absent key)."""
+        reply = self._request({'cmd': 'lookup', 'keys': list(keys),
+                               'fields': list(fields) if fields else None})
+        return reply['rows']
+
+    def lookup_one(self, key, fields=None):
+        """The single row for ``key``, or ``None`` when absent; raises
+        on a key matching several rows (use :meth:`lookup`)."""
+        rows = self.lookup([key], fields=fields)[0]
+        if len(rows) > 1:
+            raise ValueError('key {!r} matches {} rows'.format(
+                key, len(rows)))
+        return rows[0] if rows else None
+
+    def query(self, predicate, selector=None, limit=None, fields=None):
+        """Server-side predicate scan (``predicates.in_lambda`` etc.,
+        with optional ``selectors`` row-group pruning). The predicate and
+        selector must be picklable — module-level functions, not bare
+        lambdas."""
+        reply = self._request({'cmd': 'query', 'predicate': predicate,
+                               'selector': selector, 'limit': limit,
+                               'fields': list(fields) if fields else None})
+        return reply['rows']
+
+    def attach(self):
+        """Explicit admission handshake (reads attach implicitly)."""
+        return self._request({'cmd': 'attach'}, hedge=False)
+
+    def stats(self):
+        return self._request({'cmd': 'stats'})
+
+    def schema(self):
+        return self._request({'cmd': 'schema'})['schema']
+
+    def fleet_metrics(self, timeout_ms=2000):
+        """Per-server metrics snapshots + the summed fleet aggregate —
+        the same shape as ``RemoteReader.fleet_metrics()`` (deduped on
+        the process registry id so co-located servers fold once)."""
+        from petastorm_tpu import metrics as metrics_mod
+        per_server, unreachable, seen = {}, [], set()
+        for endpoint in self._endpoints:
+            try:
+                reply = self._request_one(endpoint,
+                                          {'cmd': 'metrics'},
+                                          timeout_ms)
+            except Exception as e:  # noqa: BLE001 - fold into unreachable
+                unreachable.append({'endpoint': endpoint,
+                                    'error': repr(e)})
+                continue
+            if not isinstance(reply, dict) or 'metrics' not in reply:
+                unreachable.append({'endpoint': endpoint,
+                                    'error': repr(reply)})
+                continue
+            per_server[endpoint] = reply
+        snapshots = []
+        for reply in per_server.values():
+            rid = reply.get('registry_id')
+            if rid is not None and rid in seen:
+                continue
+            seen.add(rid)
+            snapshots.append(reply['metrics'])
+        return {'servers': per_server,
+                'aggregate': metrics_mod.aggregate_snapshots(snapshots),
+                'unreachable': unreachable}
+
+    def _request_one(self, endpoint, request, timeout_ms):
+        """Single-endpoint rpc (no failover) under the breaker."""
+        from petastorm_tpu.data_service import RpcUnanswered
+        zmq = self._zmq
+        breaker = self._breaker(endpoint)
+        if not breaker.allow():
+            raise RpcUnanswered('{} circuit open'.format(endpoint))
+        sock = self._socket_for(endpoint)
+        clean = False
+        try:
+            sock.send(pickle.dumps(dict(request,
+                                        consumer=self._consumer_id),
+                                   protocol=5))
+            if not sock.poll(timeout_ms):
+                breaker.record_failure()
+                raise RpcUnanswered('{} gave no reply within {}ms'.format(
+                    endpoint, timeout_ms))
+            reply = pickle.loads(sock.recv())
+            breaker.record_success()
+            self._note_server_id(endpoint, reply)
+            clean = True
+            return reply
+        finally:
+            if clean:
+                self._release_socket(endpoint, sock)
+            else:
+                sock.close(linger=0)
+
+    def close(self):
+        self._closed = True
+        with self._lock:
+            cached, self._socks = dict(self._socks), {}
+        for sock in cached.values():
+            sock.close(linger=0)
+        if self._sub is not None:
+            self._sub.close(linger=0)
+            self._sub = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()

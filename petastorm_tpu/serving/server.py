@@ -1,0 +1,470 @@
+"""LookupServer: the lookup tier's ZMQ service plane.
+
+``lookup``/``query`` verbs over a ROUTER socket — served inline on one
+thread by default (the lowest-latency path: a warm point read is one
+engine call between two socket events), or fanned out to a pool of
+inproc REP workers (``rpc_workers > 1``: concurrent heavy queries
+coalesce inside the shared
+:class:`~petastorm_tpu.serving.engine.LookupEngine`) — run under the
+PR-10 control-plane discipline the data service proved out:
+
+* **lease heartbeats** on a PUB socket (``PST_LHB`` + JSON: server id,
+  lease seconds, drain state, rpc endpoint) every third of the lease —
+  a client that saw one heartbeat then silence for a full lease routes
+  around the server with zero rpc round trips;
+* **admission control**: a consumer ledger with 3-lease expiry; past
+  ``max_consumers`` (or under the memory governor's *shed* rung) new
+  consumers get the TYPED refusal (``{'refused': 'overloaded', ...}``)
+  instead of silently degrading everyone;
+* **graceful drain**: :meth:`drain` (or the ``drain`` verb) stops
+  admission, refuses further reads with ``{'refused': 'draining'}``,
+  lets in-flight requests complete, and reports ``drained`` — clients
+  fail over on the typed reply;
+* **SLO observability**: ``pst_lookup_requests_total{verb,outcome}``,
+  ``pst_lookup_latency_seconds{verb}`` (the shared log-spaced buckets,
+  so fleet histograms merge bucket-for-bucket),
+  ``pst_lookup_cache_hits_total{tier}`` (engine-side), all in the
+  process metrics registry — scraped over the ``metrics`` verb, by the
+  HTTP exporter, and dumped by the flight recorder on escalation;
+* chaos surface: the existing ``server-slow`` (delay before the reply)
+  and ``rpc-blackhole`` (swallow the request, reset the REP state
+  machine) fault sites fire inside the worker loop, so the client's
+  circuit breaker and hedging are drill-testable like the data plane's.
+"""
+
+import json
+import logging
+import pickle
+import threading
+import time
+import uuid
+
+logger = logging.getLogger(__name__)
+
+#: Control-plane heartbeat prefix (PUB broadcasts, JSON body).
+CTRL_HB = b'PST_LHB'
+
+DEFAULT_LEASE_S = 10.0
+
+
+class LookupServer(object):
+    """Serve a :class:`~petastorm_tpu.serving.engine.LookupEngine` over zmq.
+
+    :param engine: the shared local request path (thread-safe).
+    :param bind: rpc endpoint, e.g. ``'tcp://127.0.0.1:*'``. Clients
+        dial :attr:`rpc_endpoint`.
+    :param control_bind: lease-heartbeat PUB endpoint (default: rpc
+        port + 1 for tcp binds).
+    :param lease_s: lease duration (default ``PETASTORM_TPU_LEASE_S``
+        or 10); heartbeats go out every third of it.
+    :param max_consumers: admission capacity; ``None`` = unlimited.
+    :param rpc_workers: concurrent request handlers. The default (1)
+        serves the ROUTER inline on one thread — the LOWEST-latency
+        configuration (no inproc hop, no extra thread handoff per
+        request; a warm point read is one engine call between two socket
+        events). Raise it when many clients run heavy ``query`` scans
+        concurrently — point reads then ride the engine's coalescing.
+    :param gc_freeze: on :meth:`start`, freeze the baseline object graph
+        out of the cyclic collector (``gc.freeze()``). A gen-2 pass over
+        a big warm process pauses every thread ~10ms — the exact tail
+        the warm-read SLO forbids — while the serving path's own garbage
+        is acyclic and dies by refcount. The collector stays ENABLED;
+        only startup state stops being re-walked.
+    """
+
+    def __init__(self, engine, bind, control_bind=None, lease_s=None,
+                 max_consumers=None, rpc_workers=1, gc_freeze=True):
+        import zmq
+
+        from petastorm_tpu import membudget
+        from petastorm_tpu import metrics as metrics_mod
+        from petastorm_tpu.data_service import (ENV_LEASE, _connectable,
+                                                _env_float,
+                                                _next_port_endpoint)
+
+        self._engine = engine
+        self._zmq = zmq
+        self._context = zmq.Context.instance()
+        self._server_id = uuid.uuid4().hex
+        self._lease_s = float(lease_s if lease_s is not None
+                              else _env_float(ENV_LEASE, DEFAULT_LEASE_S))
+        self._max_consumers = (None if max_consumers is None
+                               else int(max_consumers))
+        self._rpc_workers = max(1, int(rpc_workers))
+        self._gc_freeze = bool(gc_freeze)
+        self._gc_frozen = False
+
+        self._frontend = self._context.socket(zmq.ROUTER)
+        self._ctrl_sock = None
+        self._backend = None
+        try:
+            self._frontend.bind(bind)
+            actual = self._frontend.getsockopt(zmq.LAST_ENDPOINT).decode()
+            ctrl_endpoint = (control_bind if control_bind is not None
+                             else _next_port_endpoint(actual))
+            self._ctrl_sock = self._context.socket(zmq.PUB)
+            self._ctrl_sock.bind(ctrl_endpoint)
+            if self._rpc_workers > 1:
+                # Worker fan-out: one DEALER bound inproc; each worker
+                # thread connects a REP. inproc requires bind-before-
+                # connect, so the backend binds here, before any worker
+                # thread starts. (rpc_workers=1 serves the ROUTER inline
+                # — no backend at all.)
+                self._backend = self._context.socket(zmq.DEALER)
+                self._inproc = 'inproc://pst-lookup-{}'.format(
+                    self._server_id)
+                self._backend.bind(self._inproc)
+        except Exception:
+            for sock in (self._frontend, self._ctrl_sock, self._backend):
+                if sock is not None:
+                    sock.close(linger=0)
+            raise
+        self.rpc_endpoint = _connectable(actual)
+        self.control_endpoint = _connectable(
+            self._ctrl_sock.getsockopt(zmq.LAST_ENDPOINT).decode())
+
+        self._m_requests = metrics_mod.counter(
+            'pst_lookup_requests_total',
+            'Lookup-tier rpc requests, by verb and outcome',
+            labelnames=('verb', 'outcome'))
+        self._m_latency = metrics_mod.histogram(
+            'pst_lookup_latency_seconds',
+            'Lookup-tier request service latency, by verb',
+            labelnames=('verb',))
+        self._m_rejected = metrics_mod.counter(
+            'pst_consumers_rejected_total',
+            'Consumer attach requests a data-service server refused',
+            labelnames=('reason',))
+
+        self._lock = threading.Lock()
+        self._consumers = {}           # consumer id -> last renew (monotonic)
+        self._draining = threading.Event()
+        self._drained = threading.Event()
+        self._stop = threading.Event()
+        self._inflight = 0             # requests inside worker handlers
+        self._response_bytes = 0       # serialized replies not yet sent
+        self.requests_served = 0
+
+        # Memory-governor wiring: response bytes in flight are accounted,
+        # and the *shed* rung flips this server to typed memory-pressure
+        # refusals for new consumers (existing ones keep reading — load
+        # shedding must not break clients mid-conversation).
+        self._mem_shed = False
+        self._mem_handle = membudget.register_pool(
+            'lookup-responses', self._response_nbytes,
+            shed_fn=self._set_mem_shed)
+
+        self._threads = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._threads:
+            raise RuntimeError('server already started')
+        if self._gc_freeze:
+            import gc
+            gc.collect()
+            gc.freeze()
+            self._gc_frozen = True
+        rpc_target = (self._serve_inline if self._backend is None
+                      else self._proxy_loop)
+        self._threads = [
+            threading.Thread(target=rpc_target, daemon=True,
+                             name='pst-lookup-rpc'),
+            threading.Thread(target=self._control_loop, daemon=True,
+                             name='pst-lookup-lease'),
+        ]
+        if self._backend is not None:
+            self._threads += [
+                threading.Thread(target=self._worker_loop, args=(i,),
+                                 daemon=True,
+                                 name='pst-lookup-worker-{}'.format(i))
+                for i in range(self._rpc_workers)]
+        for thread in self._threads:
+            thread.start()
+        return self
+
+    def stop(self):
+        if self._gc_frozen:
+            # Unpin the start()-time heap snapshot: a process that keeps
+            # running after the server stops (a trainer serving between
+            # epochs, a test session) must get cyclic collection of that
+            # state back, or stop/start cycles grow memory monotonically.
+            import gc
+            gc.unfreeze()
+            self._gc_frozen = False
+        self._mem_handle.close()
+        self._stop.set()
+        joined = True
+        for thread in self._threads:
+            thread.join(timeout=10)
+            joined = joined and not thread.is_alive()
+        if joined:
+            self._frontend.close(linger=0)
+            if self._backend is not None:
+                self._backend.close(linger=0)
+            self._ctrl_sock.close(linger=0)
+        else:  # pragma: no cover - requires a wedged handler
+            logger.warning('lookup rpc thread still running after stop(); '
+                           'leaking zmq sockets rather than closing them '
+                           'from another thread')
+        self._threads = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+
+    # -- drain state machine ----------------------------------------------
+
+    @property
+    def state(self):
+        if self._drained.is_set():
+            return 'drained'
+        if self._draining.is_set():
+            return 'draining'
+        return 'serving'
+
+    def drain(self, timeout_s=30.0, _inflight_floor=0):
+        """Stop admitting, refuse further reads with the typed
+        ``draining`` reply, wait for in-flight requests to finish, and
+        report drained. Idempotent. ``_inflight_floor`` is the ``drain``
+        rpc handler's own request, which is in-flight by definition and
+        must not wait on itself."""
+        self._draining.set()
+        deadline = time.monotonic() + (timeout_s
+                                       if timeout_s is not None else 30.0)
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._inflight <= _inflight_floor:
+                    self._drained.set()
+                    return True
+            time.sleep(0.01)
+        return self._drained.is_set()
+
+    # -- membudget hooks ---------------------------------------------------
+
+    def _response_nbytes(self):
+        with self._lock:
+            return self._response_bytes
+
+    def _set_mem_shed(self, active):
+        self._mem_shed = bool(active)
+
+    # -- control plane -----------------------------------------------------
+
+    def _control_loop(self):
+        """Owns the PUB socket: lease heartbeats every ``lease_s / 3``
+        plus admission-ledger pruning (3 leases without a renew frees a
+        crashed consumer's slot)."""
+        hb_interval = max(self._lease_s / 3.0, 0.05)
+        while not self._stop.is_set():
+            body = json.dumps({'server_id': self._server_id,
+                               'lease_s': self._lease_s,
+                               'state': self.state,
+                               'rpc': self.rpc_endpoint}).encode('utf-8')
+            self._ctrl_sock.send(CTRL_HB + body)
+            now = time.monotonic()
+            expiry = 3 * self._lease_s
+            with self._lock:
+                for cid in [c for c, t in self._consumers.items()
+                            if now - t > expiry]:
+                    del self._consumers[cid]
+                    logger.warning('lookup server %s: consumer %s admission '
+                                   'lease expired', self.rpc_endpoint, cid)
+            self._stop.wait(hb_interval)
+
+    # -- rpc plane ---------------------------------------------------------
+
+    def _proxy_loop(self):
+        """The ROUTER <-> inproc DEALER shuttle. Poll-driven so stop()
+        can interrupt it; messages route the moment they arrive."""
+        zmq = self._zmq
+        poller = zmq.Poller()
+        poller.register(self._frontend, zmq.POLLIN)
+        poller.register(self._backend, zmq.POLLIN)
+        while not self._stop.is_set():
+            events = dict(poller.poll(100))
+            if self._frontend in events:
+                self._backend.send_multipart(
+                    self._frontend.recv_multipart())
+            if self._backend in events:
+                self._frontend.send_multipart(
+                    self._backend.recv_multipart())
+
+    def _serve_request(self, raw):
+        """Decode one request, answer it through the engine under the
+        admission/drain rules, time it. Returns the serialized reply, or
+        ``None`` when the ``rpc-blackhole`` fault swallowed the request
+        (the caller resets its transport state accordingly)."""
+        from petastorm_tpu import faults
+        if faults.get_injector().should_fire('rpc-blackhole'):
+            logger.warning('fault injection: rpc-blackhole dropping '
+                           'lookup request without reply')
+            return None
+        with self._lock:
+            self._inflight += 1
+        t0 = time.perf_counter()
+        verb = 'unknown'
+        try:
+            try:
+                request = pickle.loads(raw)
+                verb = str(request.get('cmd') or 'unknown')
+                reply = self._handle(request)
+            except Exception as e:  # noqa: BLE001 - reply, don't die
+                logger.exception('lookup rpc failed')
+                reply = {'error': repr(e)}
+            outcome = ('refused' if isinstance(reply, dict)
+                       and 'refused' in reply
+                       else 'error' if isinstance(reply, dict)
+                       and 'error' in reply else 'ok')
+            self._m_requests.labels(verb, outcome).inc()
+            self._m_latency.labels(verb).observe(time.perf_counter() - t0)
+            faults.maybe_inject('server-slow')
+            try:
+                payload = pickle.dumps(reply, protocol=5)
+            except Exception as e:  # noqa: BLE001 - degrade typed
+                payload = pickle.dumps({'error': repr(e)}, protocol=5)
+            with self._lock:
+                self.requests_served += 1
+            return payload
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def _serve_inline(self):
+        """rpc_workers=1: handle requests ON the ROUTER thread. One
+        thread, no inproc hop — each warm read is recv, engine call,
+        send. A blackholed request is simply not replied to (ROUTER has
+        no REP state machine to reset)."""
+        while not self._stop.is_set():
+            if not self._frontend.poll(100):
+                continue
+            frames = self._frontend.recv_multipart()
+            payload = self._serve_request(frames[-1])
+            if payload is None:
+                continue
+            with self._lock:
+                self._response_bytes += len(payload)
+            try:
+                self._frontend.send_multipart(frames[:-1] + [payload])
+            finally:
+                with self._lock:
+                    self._response_bytes -= len(payload)
+
+    def _worker_loop(self, worker_id):
+        """One inproc REP handler behind the proxy (rpc_workers > 1)."""
+        zmq = self._zmq
+        sock = self._context.socket(zmq.REP)
+        sock.connect(self._inproc)
+        try:
+            while not self._stop.is_set():
+                if not sock.poll(100):
+                    continue
+                raw = sock.recv()
+                payload = self._serve_request(raw)
+                if payload is None:
+                    # Swallowed by the blackhole drill: REP requires
+                    # send-before-recv — reset the state machine with a
+                    # fresh socket (inproc reconnect is cheap).
+                    sock.close(linger=0)
+                    sock = self._context.socket(zmq.REP)
+                    sock.connect(self._inproc)
+                    continue
+                with self._lock:
+                    self._response_bytes += len(payload)
+                try:
+                    sock.send(payload)
+                finally:
+                    with self._lock:
+                        self._response_bytes -= len(payload)
+        finally:
+            sock.close(linger=0)
+
+    def _admit(self, request):
+        """Admission/drain gate for one request; a dict = typed refusal
+        reply, ``None`` = admitted (and the consumer's lease renewed)."""
+        consumer = request.get('consumer') or 'anonymous'
+        now = time.monotonic()
+        with self._lock:
+            known = consumer in self._consumers
+            state = self.state
+            if state in ('draining', 'drained'):
+                # Unlike the data plane (which finishes feeding admitted
+                # streams), a drained lookup tier refuses EVERY read: each
+                # request is standalone, and the typed reply is what makes
+                # the client fail over instead of waiting out a corpse.
+                self._m_rejected.labels('draining').inc()
+                return {'server_id': self._server_id, 'refused': state,
+                        'state': state}
+            if not known:
+                if self._max_consumers is not None \
+                        and len(self._consumers) >= self._max_consumers:
+                    self._m_rejected.labels('overloaded').inc()
+                    return {'server_id': self._server_id,
+                            'refused': 'overloaded',
+                            'max_consumers': self._max_consumers,
+                            'state': state}
+                if self._mem_shed:
+                    self._m_rejected.labels('memory-pressure').inc()
+                    return {'server_id': self._server_id,
+                            'refused': 'overloaded',
+                            'reason': 'memory-pressure',
+                            'state': state}
+            self._consumers[consumer] = now
+        return None
+
+    def _handle(self, request):
+        cmd = request.get('cmd')
+        if cmd == 'attach':
+            refusal = self._admit(request)
+            if refusal is not None:
+                return refusal
+            return {'server_id': self._server_id, 'state': self.state,
+                    'lease_s': self._lease_s}
+        if cmd == 'detach':
+            with self._lock:
+                self._consumers.pop(request.get('consumer'), None)
+            return {'ok': True}
+        if cmd == 'lookup':
+            refusal = self._admit(request)
+            if refusal is not None:
+                return refusal
+            rows = self._engine.lookup(request.get('keys') or (),
+                                       fields=request.get('fields'))
+            return {'server_id': self._server_id, 'rows': rows}
+        if cmd == 'query':
+            refusal = self._admit(request)
+            if refusal is not None:
+                return refusal
+            rows = self._engine.query(request['predicate'],
+                                      selector=request.get('selector'),
+                                      limit=request.get('limit'),
+                                      fields=request.get('fields'))
+            return {'server_id': self._server_id, 'rows': rows}
+        if cmd == 'drain':
+            drained = self.drain(float(request.get('timeout_s', 30.0)),
+                                 _inflight_floor=1)
+            return {'server_id': self._server_id, 'state': self.state,
+                    'drained': bool(drained)}
+        if cmd == 'stats':
+            with self._lock:
+                n_consumers = len(self._consumers)
+                served = self.requests_served
+            return {'server_id': self._server_id, 'state': self.state,
+                    'lease_s': self._lease_s,
+                    'consumers': n_consumers,
+                    'max_consumers': self._max_consumers,
+                    'requests_served': served,
+                    'engine': self._engine.stats()}
+        if cmd == 'metrics':
+            from petastorm_tpu import metrics as metrics_mod
+            return {'server_id': self._server_id,
+                    'registry_id': metrics_mod.REGISTRY_INSTANCE_ID,
+                    'metrics': metrics_mod.get_registry().collect()}
+        if cmd == 'schema':
+            return {'schema': self._engine.schema,
+                    'index': self._engine.index.name,
+                    'index_field': self._engine.index.field}
+        raise ValueError('unknown rpc command {!r}'.format(cmd))

@@ -141,10 +141,61 @@ def test_resequencer_surfaces_lost_seq_instead_of_reordering():
                 raise EmptyResultError()
             return self.chunks.pop(0)
 
-    reseq = Resequencer()
+    reseq = Resequencer(end_grace_s=0.05)
     pool = _FakePool([{'det': {'seq': 1, 'epoch': 1, 'pos': 1}}])
     with pytest.raises(RuntimeError, match='missing ventilation seq 0'):
         reseq.next_chunk(pool)
+
+
+def test_resequencer_end_verdict_is_consume_until():
+    """The lost-chunk verdict must survive a TRANSIENT end-of-data
+    sample (the PR-12 full-suite load flake): a pool that momentarily
+    reports exhausted while the hole's chunk is still crossing the
+    handoff gets re-polled within the grace, and the stream completes
+    in order instead of raising."""
+    from petastorm_tpu.workers import EmptyResultError
+
+    class _FlickerPool:
+        """seq 1 arrives first; then one spurious end-of-data; then the
+        'lost' seq 0 lands after all."""
+
+        def __init__(self):
+            self.sequence = [
+                {'det': {'seq': 1, 'epoch': 1, 'pos': 1}},
+                EmptyResultError(),
+                EmptyResultError(),
+                {'det': {'seq': 0, 'epoch': 1, 'pos': 0}},
+            ]
+
+        def get_results(self):
+            item = self.sequence.pop(0)
+            if isinstance(item, Exception):
+                raise item
+            return item
+
+    reseq = Resequencer(end_grace_s=2.0)
+    pool = _FlickerPool()
+    assert reseq.next_chunk(pool)['det']['seq'] == 0
+    assert reseq.next_chunk(pool)['det']['seq'] == 1
+
+    # A hole that STAYS missing for the whole grace still raises —
+    # the deflake must not convert real accounting bugs into hangs
+    # or silent reordering.
+    class _ExhaustedPool:
+        def __init__(self):
+            self.chunks = [{'det': {'seq': 2, 'epoch': 1, 'pos': 2}}]
+
+        def get_results(self):
+            if not self.chunks:
+                raise EmptyResultError()
+            return self.chunks.pop(0)
+
+    import time as time_mod
+    reseq = Resequencer(end_grace_s=0.05)
+    t0 = time_mod.monotonic()
+    with pytest.raises(RuntimeError, match='missing ventilation seq 0'):
+        reseq.next_chunk(_ExhaustedPool())
+    assert time_mod.monotonic() - t0 >= 0.05
 
 
 def test_cursor_tracks_frontier_and_roundtrips():

@@ -6,7 +6,8 @@ import pytest
 
 from petastorm_tpu import make_reader
 from petastorm_tpu.etl.rowgroup_indexers import (FieldNotNullIndexer,
-                                                 SingleFieldIndexer)
+                                                 SingleFieldIndexer,
+                                                 SingleFieldRowIndexer)
 from petastorm_tpu.etl.rowgroup_indexing import (build_rowgroup_index,
                                                  get_row_group_indexes)
 from petastorm_tpu.selectors import (IntersectIndexSelector,
@@ -26,6 +27,7 @@ def indexed_dataset(tmp_path_factory):
         SingleFieldIndexer('sensor_ix', 'sensor_name'),
         SingleFieldIndexer('id2_ix', 'id2'),
         FieldNotNullIndexer('nullable_ix', 'nullable_field'),
+        SingleFieldRowIndexer('id_row_ix', 'id'),
     ])
 
     class _Dataset:
@@ -39,7 +41,8 @@ def indexed_dataset(tmp_path_factory):
 
 def test_index_payload_round_trip(indexed_dataset):
     payload = get_row_group_indexes(indexed_dataset.url)
-    assert set(payload) == {'sensor_ix', 'id2_ix', 'nullable_ix'}
+    assert set(payload) == {'sensor_ix', 'id2_ix', 'nullable_ix',
+                            'id_row_ix'}
     assert payload['sensor_ix']['field'] == 'sensor_name'
     # sensor_0 appears in every row-group (every 3rd row of 10-row groups)
     assert payload['sensor_ix']['values']['sensor_0'] == [0, 1, 2, 3, 4]
@@ -82,6 +85,51 @@ def test_not_null_indexer(indexed_dataset):
     payload = get_row_group_indexes(indexed_dataset.url)
     # Every 10-row group has some non-null nullable_field values
     assert payload['nullable_ix']['values']['not_null'] == [0, 1, 2, 3, 4]
+
+
+def test_intersect_and_union_over_row_level_index(indexed_dataset):
+    """The serving tier's row-level index composes with the classic
+    combinators: ``[piece, offset]`` entries normalize to row-group
+    ordinals (``selectors.entry_row_groups``), so intersect/union work
+    across index granularities in one expression."""
+    payload = get_row_group_indexes(indexed_dataset.url)
+    assert payload['id_row_ix']['type'] == 'single_field_rows'
+    a = SingleIndexSelector('id_row_ix', [5])        # row-group 0
+    b = SingleIndexSelector('id_row_ix', [5, 17])    # row-groups 0, 1
+    assert IntersectIndexSelector([a, b]).select_row_groups(payload) == {0}
+    assert UnionIndexSelector([a, b]).select_row_groups(payload) == {0, 1}
+    # mixed granularity: row-level ∩ row-group-level
+    sensors = SingleIndexSelector('sensor_ix', ['sensor_1'])
+    mixed = IntersectIndexSelector([b, sensors]).select_row_groups(payload)
+    assert mixed == ({0, 1} & sensors.select_row_groups(payload))
+
+
+def test_row_level_selector_through_reader(indexed_dataset):
+    """A reader built with a row-level-index selector reads exactly the
+    selected row-groups (the rowgroup_selector contract is granularity-
+    blind)."""
+    selector = SingleIndexSelector('id_row_ix', [5, 17])
+    with make_reader(indexed_dataset.url, reader_pool_type='dummy',
+                     rowgroup_selector=selector,
+                     shuffle_row_groups=False) as reader:
+        ids = sorted(r.id for r in reader)
+    assert ids == list(range(20))    # row-groups 0 and 1, 10 rows each
+
+
+def test_in_lambda_state_arg_with_selector(indexed_dataset):
+    """``in_lambda(state_arg=)`` predicates compose with selector
+    pruning on the epoch path — the same predicate objects the serving
+    tier's query path evaluates."""
+    from petastorm_tpu.predicates import in_lambda
+    predicate = in_lambda(['id'],
+                          lambda id, threshold: id >= threshold,
+                          state_arg=15)
+    selector = SingleIndexSelector('id_row_ix', [5, 17])
+    with make_reader(indexed_dataset.url, reader_pool_type='dummy',
+                     rowgroup_selector=selector,
+                     predicate=predicate) as reader:
+        ids = sorted(r.id for r in reader)
+    assert ids == [15, 16, 17, 18, 19]
 
 
 def test_unknown_index_raises(indexed_dataset):

@@ -1,0 +1,503 @@
+"""Online lookup tier (ISSUE 15): row-level index, LookupEngine cache
+tiers, and the lookup rpc plane (admission, drain, breaker, hedging).
+
+ACCEPTANCE (mirrors the issue):
+* rows served by ``LookupClient`` are byte-identical to the same rows
+  delivered by the epoch ``Reader`` path (per-field CRC32 via
+  ``lineage._digest_array``);
+* a draining / over-capacity server refuses with the PR-10 typed
+  refusal and the client fails over / breaks the circuit, chaos-tested
+  with the existing fault sites (``rpc-blackhole``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_tensor_reader
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.rowgroup_indexers import (SingleFieldIndexer,
+                                                 SingleFieldRowIndexer)
+from petastorm_tpu.etl.rowgroup_indexing import (build_rowgroup_index,
+                                                 get_row_group_indexes)
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.lineage import _digest_array
+from petastorm_tpu.serving import (LookupClient, LookupEngine,
+                                   LookupServer, RowLocationIndex)
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+pytestmark = pytest.mark.serving
+
+ROWS = 48
+ROWS_PER_GROUP = 8
+
+ServeSchema = Unischema('ServeSchema', [
+    UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField('bucket', np.int32, (), ScalarCodec(np.int32), False),
+    UnischemaField('vec', np.float32, (4,), NdarrayCodec(), False),
+])
+
+
+@pytest.fixture(scope='module')
+def serve_dataset_url(tmp_path_factory):
+    path = tmp_path_factory.mktemp('serving') / 'dataset'
+    url = 'file://' + str(path)
+    rng = np.random.default_rng(5)
+    rows = [{'id': i, 'bucket': i % 4,
+             'vec': rng.random(4, dtype=np.float32)}
+            for i in range(ROWS)]
+    write_dataset(url, ServeSchema, rows, rows_per_row_group=ROWS_PER_GROUP)
+    build_rowgroup_index(url, [
+        SingleFieldRowIndexer('id_row_ix', 'id'),
+        SingleFieldRowIndexer('bucket_row_ix', 'bucket'),
+        SingleFieldIndexer('bucket_rg_ix', 'bucket'),
+    ])
+
+    class _Dataset:
+        pass
+
+    ds = _Dataset()
+    ds.url = url
+    ds.rows = rows
+    return ds
+
+
+# ---------------------------------------------------------------------------
+# row-level index
+# ---------------------------------------------------------------------------
+
+def test_row_indexer_payload_round_trip(serve_dataset_url):
+    payload = get_row_group_indexes(serve_dataset_url.url)
+    ix = payload['id_row_ix']
+    assert ix['type'] == 'single_field_rows'
+    # id 13 lives at row 5 of row-group 1 (8 rows per group).
+    assert ix['values']['13'] == [[1, 5]]
+    # every id maps to exactly one (group, offset) pair at its position
+    for i in (0, 7, 8, ROWS - 1):
+        assert ix['values'][str(i)] == [[i // ROWS_PER_GROUP,
+                                         i % ROWS_PER_GROUP]]
+
+
+def test_row_indexer_merge_and_rowgroup_contract():
+    a = SingleFieldRowIndexer('ix', 'k')
+    a.build_index([{'k': 'x'}, {'k': 'y'}], 0)
+    b = SingleFieldRowIndexer('ix', 'k')
+    b.build_index([{'k': 'x'}], 3)
+    a += b
+    assert a.get_row_locations('x') == [(0, 0), (3, 0)]
+    # base-class contract: get_row_group_indexes stays ordinal-valued
+    assert a.get_row_group_indexes('x') == [0, 3]
+    assert a.get_row_group_indexes('y') == [0]
+
+
+def test_row_location_index_load_and_autoselect(serve_dataset_url):
+    by_name = RowLocationIndex.load(serve_dataset_url.url,
+                                    index_name='id_row_ix')
+    assert by_name.field == 'id'
+    assert by_name.locations(13) == [(1, 5)]
+    assert by_name.locations('13') == [(1, 5)]
+    assert by_name.locations(9999) == []
+    assert 13 in by_name and 9999 not in by_name
+    # auto-select is ambiguous here (two row-level indexes stored)
+    with pytest.raises(ValueError, match='exactly one row-level'):
+        RowLocationIndex.load(serve_dataset_url.url)
+    # a row-group-level index is not a row-level index
+    with pytest.raises(ValueError, match='not a row-level index'):
+        RowLocationIndex.load(serve_dataset_url.url,
+                              index_name='bucket_rg_ix')
+
+
+def test_selectors_compose_over_row_level_index(serve_dataset_url):
+    from petastorm_tpu.selectors import (IntersectIndexSelector,
+                                         SingleIndexSelector,
+                                         UnionIndexSelector)
+    payload = get_row_group_indexes(serve_dataset_url.url)
+    # bucket b appears in every row-group (i % 4 cycles inside each)
+    row_level = SingleIndexSelector('bucket_row_ix', [1])
+    rg_level = SingleIndexSelector('bucket_rg_ix', [1])
+    assert row_level.select_row_groups(payload) == \
+        rg_level.select_row_groups(payload)
+    # id-keyed selection narrows to single groups; combinators compose
+    # across granularities
+    a = SingleIndexSelector('id_row_ix', [3])       # group 0
+    b = SingleIndexSelector('id_row_ix', [3, 20])   # groups 0, 2
+    inter = IntersectIndexSelector([a, b]).select_row_groups(payload)
+    union = UnionIndexSelector([a, b]).select_row_groups(payload)
+    assert inter == {0}
+    assert union == {0, 2}
+    mixed = IntersectIndexSelector([b, rg_level]).select_row_groups(payload)
+    assert mixed == {0, 2}
+
+
+# ---------------------------------------------------------------------------
+# engine: tiers, coalescing, shared cache
+# ---------------------------------------------------------------------------
+
+def test_engine_lookup_and_missing_keys(serve_dataset_url):
+    with LookupEngine(serve_dataset_url.url, index_name='id_row_ix') as eng:
+        got = eng.lookup([13, 7, 9999])
+        assert [len(r) for r in got] == [1, 1, 0]
+        for key, rows in zip((13, 7), got):
+            assert int(rows[0]['id']) == key
+            np.testing.assert_array_equal(
+                rows[0]['vec'], serve_dataset_url.rows[key]['vec'])
+        # one block fetch per distinct row-group (13 -> g1, 7 -> g0)
+        assert eng.stats()['tiers'] == {'decode': 2}
+
+
+def test_engine_multi_match_key(serve_dataset_url):
+    with LookupEngine(serve_dataset_url.url,
+                      index_name='bucket_row_ix') as eng:
+        rows = eng.lookup([2])[0]
+        assert sorted(int(r['id']) for r in rows) == \
+            [i for i in range(ROWS) if i % 4 == 2]
+
+
+def test_engine_tier_ladder_memory_store_decode(serve_dataset_url,
+                                                tmp_path):
+    store_dir = str(tmp_path / 'store')
+    with LookupEngine(serve_dataset_url.url, index_name='id_row_ix',
+                      cache=store_dir) as eng:
+        eng.lookup([13])
+        assert eng.stats()['tiers'] == {'decode': 1}
+        # resident block: memory tier
+        eng.lookup([13])
+        assert eng.stats()['tiers'] == {'decode': 1, 'memory': 1}
+        # flush write-behind, drop the LRU: the store's mmap tier serves
+        assert eng.flush()
+        with eng._lock:
+            eng._blocks.clear()
+        eng.lookup([13])
+        assert eng.stats()['tiers'] == {'decode': 1, 'memory': 1,
+                                        'chunk-store': 1}
+
+
+def test_engine_coalesces_concurrent_cold_fetches(serve_dataset_url):
+    with LookupEngine(serve_dataset_url.url, index_name='id_row_ix') as eng:
+        barrier = threading.Barrier(6)
+        results, errors = [], []
+
+        def read():
+            barrier.wait()
+            try:
+                results.append(eng.lookup([13])[0][0])
+            except Exception as e:  # noqa: BLE001 - asserted below
+                errors.append(e)
+
+        threads = [threading.Thread(target=read) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert len(results) == 6
+        tiers = eng.stats()['tiers']
+        # exactly ONE decode; everyone else coalesced onto it (or found
+        # the block already resident)
+        assert tiers['decode'] == 1
+        assert tiers.get('coalesced', 0) + tiers.get('memory', 0) == 5
+
+
+def test_engine_shares_training_chunk_store(serve_dataset_url, tmp_path):
+    """The tier ACCEPTANCE: an epoch of training through the chunk store
+    makes every point read warm — one cache hierarchy, two consumers."""
+    store_dir = str(tmp_path / 'shared-store')
+    with make_tensor_reader(serve_dataset_url.url,
+                            reader_pool_type='dummy',
+                            shuffle_row_groups=False,
+                            cache_type='chunk-store',
+                            cache_location=store_dir) as reader:
+        for _ in reader:
+            pass
+        assert reader.chunk_store.flush()
+    with LookupEngine(serve_dataset_url.url, index_name='id_row_ix',
+                      cache=store_dir) as eng:
+        eng.lookup(list(range(0, ROWS, 5)))
+        tiers = eng.stats()['tiers']
+        assert tiers.get('chunk-store', 0) > 0
+        assert tiers.get('decode', 0) == 0, \
+            'a training-warmed store must serve every lookup block'
+
+
+def test_engine_query_in_lambda_state_arg_and_limit(serve_dataset_url):
+    from petastorm_tpu.predicates import in_lambda
+    from petastorm_tpu.selectors import SingleIndexSelector
+    with LookupEngine(serve_dataset_url.url, index_name='id_row_ix') as eng:
+        predicate = in_lambda(['id', 'bucket'],
+                              lambda id, bucket, state: bucket == state,
+                              state_arg=3)
+        got = eng.query(predicate)
+        assert sorted(int(r['id']) for r in got) == \
+            [i for i in range(ROWS) if i % 4 == 3]
+        # selector pruning composes: restrict to id 20's row-group
+        sel = SingleIndexSelector('id_row_ix', [20])
+        got = eng.query(predicate, selector=sel)
+        assert sorted(int(r['id']) for r in got) == [19, 23]
+        # limit short-circuits; limit=0 serves nothing (and fetches
+        # nothing)
+        assert len(eng.query(predicate, limit=2)) == 2
+        assert eng.query(predicate, limit=0) == []
+
+
+# ---------------------------------------------------------------------------
+# byte identity vs the epoch Reader path (ACCEPTANCE)
+# ---------------------------------------------------------------------------
+
+def test_served_rows_byte_identical_to_reader_path(serve_dataset_url):
+    reader_digests = {}
+    with make_tensor_reader(serve_dataset_url.url,
+                            reader_pool_type='dummy',
+                            shuffle_row_groups=False) as reader:
+        row_id = 0
+        for chunk in reader:
+            for i in range(len(chunk.id)):
+                reader_digests[int(chunk.id[i])] = {
+                    'id': _digest_array(chunk.id[i]),
+                    'bucket': _digest_array(chunk.bucket[i]),
+                    'vec': _digest_array(chunk.vec[i])}
+                row_id += 1
+    assert row_id == ROWS
+    with LookupEngine(serve_dataset_url.url, index_name='id_row_ix') as eng:
+        with LookupServer(eng, 'tcp://127.0.0.1:*').start() as server:
+            with LookupClient([server.rpc_endpoint]) as client:
+                for key in range(ROWS):
+                    row = client.lookup_one(key)
+                    assert row is not None
+                    for field, want in reader_digests[key].items():
+                        assert _digest_array(row[field]) == want, \
+                            'field {!r} of key {} diverged'.format(field,
+                                                                   key)
+
+
+# ---------------------------------------------------------------------------
+# service plane: verbs, admission, drain, failover, breaker
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def lookup_fleet(serve_dataset_url):
+    """Two servers over one dataset + a client dialing both."""
+    engines = [LookupEngine(serve_dataset_url.url, index_name='id_row_ix')
+               for _ in range(2)]
+    servers = [LookupServer(eng, 'tcp://127.0.0.1:*', lease_s=1.0).start()
+               for eng in engines]
+    client = LookupClient([s.rpc_endpoint for s in servers],
+                          control_endpoints=[s.control_endpoint
+                                             for s in servers],
+                          timeout_ms=5000, hedge_after_ms=150)
+    try:
+        yield servers, client
+    finally:
+        client.close()
+        for server in servers:
+            server.stop()
+        for eng in engines:
+            eng.close()
+
+
+def test_rpc_verbs_and_fleet_metrics(lookup_fleet):
+    servers, client = lookup_fleet
+    from petastorm_tpu.predicates import in_lambda
+    assert int(client.lookup([7])[0][0]['id']) == 7
+    rows = client.query(in_lambda(['bucket'], _bucket_is, state_arg=1),
+                        limit=3)
+    assert len(rows) == 3 and all(int(r['bucket']) == 1 for r in rows)
+    stats = client.stats()
+    assert stats['state'] == 'serving'
+    assert stats['engine']['index'] == 'id_row_ix'
+    assert client.schema() is not None
+    fleet = client.fleet_metrics()
+    assert not fleet['unreachable']
+    agg = fleet['aggregate']
+    assert 'pst_lookup_requests_total' in agg
+    assert 'pst_lookup_latency_seconds' in agg
+    assert 'pst_lookup_cache_hits_total' in agg
+
+
+def _bucket_is(bucket, state):
+    return bucket == state
+
+
+def test_admission_capacity_typed_refusal(serve_dataset_url):
+    from petastorm_tpu.errors import ServerOverloaded
+    with LookupEngine(serve_dataset_url.url, index_name='id_row_ix') as eng:
+        with LookupServer(eng, 'tcp://127.0.0.1:*', lease_s=1.0,
+                          max_consumers=1).start() as server:
+            with LookupClient([server.rpc_endpoint]) as first:
+                assert first.lookup([1])[0]
+                with LookupClient([server.rpc_endpoint]) as second:
+                    with pytest.raises(ServerOverloaded) as exc_info:
+                        second.lookup([1])
+                    assert exc_info.value.reason == 'overloaded'
+                # the admitted consumer keeps reading
+                assert first.lookup([2])[0]
+
+
+def test_drain_refusal_fails_over_to_surviving_server(lookup_fleet):
+    servers, client = lookup_fleet
+    assert client.lookup([3])[0]
+    # drain the fleet one server at a time: the typed refusal must push
+    # the read to the survivor, transparently
+    reply = client._request_one(servers[0].rpc_endpoint,
+                                {'cmd': 'drain'}, 5000)
+    assert reply['state'] == 'drained'
+    for key in range(6):
+        assert int(client.lookup([key])[0][0]['id']) == key
+    # both drained -> typed ServerOverloaded with the drain reason
+    client._request_one(servers[1].rpc_endpoint, {'cmd': 'drain'}, 5000)
+    from petastorm_tpu.errors import ServerOverloaded
+    with pytest.raises(ServerOverloaded) as exc_info:
+        client.lookup([3])
+    assert exc_info.value.reason in ('draining', 'drained')
+
+
+def test_lease_heartbeats_deprioritize_draining_server(lookup_fleet):
+    servers, client = lookup_fleet
+    client.lookup([1])
+    servers[0].drain()
+    # wait for a draining heartbeat to arrive, then the candidate order
+    # must put the survivor first (zero rpc round-trips wasted)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        client._drain_heartbeats()
+        hb = client._hb.get(servers[0].rpc_endpoint)
+        if hb is not None and hb[0] in ('draining', 'drained'):
+            break
+        time.sleep(0.05)
+    assert client._candidates()[0] == servers[1].rpc_endpoint
+
+
+@pytest.mark.chaos
+def test_blackholed_server_opens_breaker_then_heals(serve_dataset_url,
+                                                    monkeypatch):
+    """The PR-10 partition drill on the lookup plane: a server that
+    swallows requests costs the timeout breaker_threshold times, then is
+    skipped INSTANTLY; after the reset window the half-open probe heals
+    the circuit and reads flow again."""
+    from petastorm_tpu import faults
+    from petastorm_tpu.data_service import RpcUnanswered
+    from petastorm_tpu.retry import CircuitBreaker
+    with LookupEngine(serve_dataset_url.url, index_name='id_row_ix') as eng:
+        with LookupServer(eng, 'tcp://127.0.0.1:*', lease_s=1.0,
+                          rpc_workers=1).start() as server:
+            with LookupClient([server.rpc_endpoint], timeout_ms=300,
+                              breaker_threshold=2,
+                              breaker_reset_s=1.0) as client:
+                assert client.lookup([1])[0]
+                monkeypatch.setenv(faults.ENV_VAR, 'rpc-blackhole:max=10')
+                for _ in range(2):
+                    with pytest.raises(RpcUnanswered):
+                        client.lookup([1])
+                assert client.breaker_state(server.rpc_endpoint) == \
+                    CircuitBreaker.OPEN
+                # open circuit: the refusal is instant, not a timeout
+                t0 = time.perf_counter()
+                with pytest.raises(RpcUnanswered):
+                    client.lookup([1])
+                assert time.perf_counter() - t0 < 0.25
+                # heal: disarm the fault, wait out the reset window, the
+                # half-open probe closes the circuit
+                monkeypatch.delenv(faults.ENV_VAR)
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    try:
+                        if client.lookup([1])[0]:
+                            break
+                    except RpcUnanswered:
+                        time.sleep(0.2)
+                else:
+                    pytest.fail('circuit never healed')
+                assert client.breaker_state(server.rpc_endpoint) == \
+                    CircuitBreaker.CLOSED
+
+
+def test_hedged_read_wins_past_a_silent_endpoint(serve_dataset_url):
+    """First endpoint never answers (nothing listens there): after
+    hedge_after_ms the read is hedged to the live server and wins."""
+    import zmq
+    ctx = zmq.Context.instance()
+    parking = ctx.socket(zmq.ROUTER)   # binds, never replies
+    parking.bind('tcp://127.0.0.1:*')
+    dead = parking.getsockopt(zmq.LAST_ENDPOINT).decode()
+    try:
+        with LookupEngine(serve_dataset_url.url,
+                          index_name='id_row_ix') as eng:
+            with LookupServer(eng, 'tcp://127.0.0.1:*').start() as server:
+                with LookupClient([dead, server.rpc_endpoint],
+                                  timeout_ms=5000,
+                                  hedge_after_ms=100) as client:
+                    t0 = time.perf_counter()
+                    assert int(client.lookup([5])[0][0]['id']) == 5
+                    # won via the hedge, well before the full timeout
+                    assert time.perf_counter() - t0 < 3.0
+                    assert client.hedges >= 1
+    finally:
+        parking.close(linger=0)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_lookup_cli_build_index_and_point_read(tmp_path):
+    url = 'file://' + str(tmp_path / 'clids')
+    rng = np.random.default_rng(3)
+    rows = [{'id': i, 'bucket': i % 4,
+             'vec': rng.random(4, dtype=np.float32)}
+            for i in range(16)]
+    write_dataset(url, ServeSchema, rows, rows_per_row_group=4)
+    proc = subprocess.run(
+        [sys.executable, '-m', 'petastorm_tpu.tools.lookup',
+         '--dataset-url', url, '--key', 'id=6', '--build-index'],
+        capture_output=True, text=True, timeout=180,
+        env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    assert proc.returncode == 0, proc.stderr
+    lines = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    assert lines[0]['action'] == 'build-index' and lines[0]['keys'] == 16
+    result = lines[1]
+    assert result['action'] == 'lookup' and result['matches'] == 1
+    row = result['rows'][0]
+    assert row['id']['value'] == 6
+    # the printed digest is the lineage digest of the actual row bytes
+    assert row['vec']['crc32'] == '{:#010x}'.format(
+        _digest_array(rows[6]['vec']))
+    # absent key exits 3 with a zero-match result line
+    proc = subprocess.run(
+        [sys.executable, '-m', 'petastorm_tpu.tools.lookup',
+         '--dataset-url', url, '--key', 'id=999'],
+        capture_output=True, text=True, timeout=180,
+        env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    assert proc.returncode == 3
+    assert json.loads(proc.stdout.splitlines()[-1])['matches'] == 0
+
+
+def test_lookup_cli_serve_mode(serve_dataset_url):
+    import signal as signal_mod
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'petastorm_tpu.tools.lookup',
+         '--dataset-url', serve_dataset_url.url, '--key', 'id=3',
+         '--index', 'id_row_ix', '--serve'],
+        stdout=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    try:
+        lookup_line = json.loads(proc.stdout.readline())
+        assert lookup_line['matches'] == 1
+        serve_line = json.loads(proc.stdout.readline())
+        assert serve_line['action'] == 'serve'
+        with LookupClient([serve_line['rpc_endpoint']]) as client:
+            assert int(client.lookup([11])[0][0]['id']) == 11
+        proc.send_signal(signal_mod.SIGTERM)
+        out, _ = proc.communicate(timeout=30)
+        final = json.loads(out.splitlines()[-1])
+        assert final['state'] == 'drained'
+        assert final['requests_served'] >= 1
+        assert proc.returncode == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
