@@ -133,6 +133,13 @@ THREAD_GUARDS = (
         'the sweep fails a server leaked past its test.',
         marker='serving', action='fail'),
     ThreadGuard(
+        'pst-fleet-scatter', 'petastorm_tpu.serving.client',
+        'Per-partition scatter-gather workers of LookupClient — '
+        'daemons joined before the scattering call returns, so one '
+        'alive after a test means a wedged partition request escaped '
+        'its deadline.',
+        marker='fleet', action='fail'),
+    ThreadGuard(
         'pst-pool-worker', 'petastorm_tpu.workers.thread_pool',
         'Daemon pool workers joined by ThreadPool.join(); retirement '
         'between items is the resize contract, tested in '

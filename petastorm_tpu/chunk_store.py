@@ -596,6 +596,27 @@ class DecodedChunkStore(CacheBase):
                 self._m['unstorable'].inc()
         return value
 
+    def has(self, key):
+        """True when ``key`` is already persisted (no mmap is opened —
+        an existence probe, not a read)."""
+        return os.path.exists(self._entry_path(key))
+
+    def put(self, key, cols):
+        """Synchronous fill: persist ``{field: ndarray}`` under ``key``
+        NOW (fsync + atomic rename), bypassing the write-behind queue.
+        The warm-join protocol uses this — a joining replica pre-filling
+        from a peer needs durability it can assert, not best-effort
+        spill that may have been shed under pressure. Returns True when
+        the entry is on disk (already present counts), False when the
+        value does not conform to the dense-chunk layout."""
+        if not conforms_tensor_chunk(cols):
+            with self._lock:
+                self.unstorable += 1
+                self._m['unstorable'].inc()
+            return False
+        self._write_entry(key, cols)
+        return True
+
     # -- write-behind ------------------------------------------------------
 
     def _enqueue_write(self, key, cols):

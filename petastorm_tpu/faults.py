@@ -79,6 +79,24 @@ Sites and their effects when they fire:
                      is what lets a test park the ladder on one rung
                      (advisory / degrade / shed / breach) deterministically
                      without allocating a single real byte.
+``partition-lost``   swallow partition-tagged lookup requests at the
+                     request boundary without replying (``serving/
+                     server.py``) — keyed ``p<partition>``, so with
+                     ``match=p0`` EVERY replica of partition 0 goes dark
+                     at once: the "whole key range lost" drill. The fleet
+                     client must surface a typed failure for the lost
+                     partition instead of returning silently truncated
+                     scatter-gather results, and keys of surviving
+                     partitions must keep serving. Consumed via
+                     ``should_fire``.
+``hb-flap``          suppress individual lease heartbeats in the lookup
+                     server's control loop, so the PUB stream flaps
+                     between alive and silent: the client's
+                     lease-freshness ranking wobbles (the server sorts
+                     toward the back as leases lapse, forward again on
+                     the next heartbeat) but no read may fail — flapping
+                     liveness signals are a routing hint, never an
+                     error. Consumed via ``should_fire``.
 ==================== ======================================================
 
 Params (all optional):
@@ -135,6 +153,8 @@ KNOWN_SITES = (
     'server-slow',
     'rpc-blackhole',
     'mem-pressure',
+    'partition-lost',
+    'hb-flap',
 )
 
 #: Sites whose effect is a sleep rather than an error.

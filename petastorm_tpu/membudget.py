@@ -72,6 +72,7 @@ last one stops the sampler thread. Pools register regardless of arming,
 so ``probe()``/``stats()`` always have the inventory.
 """
 
+import contextlib
 import logging
 import os
 import sys
@@ -844,6 +845,24 @@ def register_pool(name, nbytes_fn, degrade_fn=None, degrade_release_fn=None,
                                         degrade_release_fn=degrade_release_fn,
                                         shed_fn=shed_fn,
                                         advisory_fn=advisory_fn)
+
+
+@contextlib.contextmanager
+def transient_pool(name, nbytes_fn, degrade_fn=None, shed_fn=None,
+                   advisory_fn=None):
+    """Register an accountable pool for the duration of a ``with``
+    block — the bounded-lifetime version of :func:`register_pool` for
+    phases that hold real bytes but outlive no scope (a warm-joining
+    lookup replica buffering peer chunk blobs, a transcode pass holding
+    a batch in flight). Guarantees the handle closes on the way out, so
+    an aborted phase can never leave a dangling pool inflating the
+    governor's accounting forever."""
+    handle = register_pool(name, nbytes_fn, degrade_fn=degrade_fn,
+                           shed_fn=shed_fn, advisory_fn=advisory_fn)
+    try:
+        yield handle
+    finally:
+        handle.close()
 
 
 def validate_env_budget():

@@ -269,3 +269,63 @@ class CircuitBreaker(object):
             raise
         self.record_success()
         return result
+
+
+class BreakerSet(object):
+    """A keyed family of :class:`CircuitBreaker` with one construction
+    policy — the fleet-client pattern (one breaker per endpoint, or per
+    ``(partition, endpoint)``) without every caller re-growing the same
+    lock + dict-of-breakers boilerplate. Breakers are created lazily on
+    first :meth:`get` and never expire: the key space is the candidate
+    set, which the owner bounds (a lookup client prunes endpoints that
+    leave the partition map).
+
+    Thread-safe: the dict is lock-guarded; the breakers themselves are
+    already thread-safe.
+    """
+
+    def __init__(self, failure_threshold=3, reset_timeout_s=30.0,
+                 clock=time.monotonic):
+        self._failure_threshold = int(failure_threshold)
+        self._reset_timeout_s = float(reset_timeout_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._breakers = {}
+
+    def get(self, key):
+        """The breaker guarding ``key`` (created closed on first use)."""
+        with self._lock:
+            breaker = self._breakers.get(key)
+            if breaker is None:
+                breaker = self._breakers[key] = CircuitBreaker(
+                    failure_threshold=self._failure_threshold,
+                    reset_timeout_s=self._reset_timeout_s,
+                    clock=self._clock)
+            return breaker
+
+    def discard(self, key):
+        """Drop ``key``'s breaker (the endpoint left the fleet)."""
+        with self._lock:
+            self._breakers.pop(key, None)
+
+    def keys(self):
+        with self._lock:
+            return list(self._breakers)
+
+    def states(self):
+        """``{key: state}`` snapshot for routing tables/diagnostics."""
+        with self._lock:
+            items = list(self._breakers.items())
+        return {key: breaker.state for key, breaker in items}
+
+    def open_count(self):
+        return sum(1 for state in self.states().values()
+                   if state == CircuitBreaker.OPEN)
+
+    def __contains__(self, key):
+        with self._lock:
+            return key in self._breakers
+
+    def __len__(self):
+        with self._lock:
+            return len(self._breakers)

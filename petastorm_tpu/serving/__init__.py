@@ -29,6 +29,15 @@ Modules
     ``tensor_chunk_key`` the training readers use, with per-row-group
     request coalescing so a hot-key storm decodes once.
 
+:mod:`petastorm_tpu.serving.placement`
+    :class:`~petastorm_tpu.serving.placement.PartitionMap` — versioned
+    consistent-hash placement of the key space over a replicated server
+    fleet: partitions -> ranked replicas, a pure function of the
+    membership set (every party computes the identical map), published
+    in lease heartbeats so clients converge. Drain reassigns the
+    drained member's key range live; a joining replica warm-fills its
+    chunk store from a peer instead of cold-decoding.
+
 :mod:`petastorm_tpu.serving.server` / :mod:`petastorm_tpu.serving.client`
     The service plane: ``lookup``/``query`` verbs on a ZMQ rpc socket
     with lease heartbeats, graceful drain, ``max_consumers`` admission
@@ -46,5 +55,7 @@ Smoke-test without writing code::
 
 from petastorm_tpu.serving.client import LookupClient  # noqa: F401
 from petastorm_tpu.serving.engine import LookupEngine  # noqa: F401
+from petastorm_tpu.serving.placement import (  # noqa: F401
+    PartitionMap, build_partition_map)
 from petastorm_tpu.serving.row_index import RowLocationIndex  # noqa: F401
 from petastorm_tpu.serving.server import LookupServer  # noqa: F401

@@ -20,7 +20,28 @@ The consumer half of the lookup tier's control-plane contract
   stream between requests; endpoints whose last heartbeat reported
   ``draining``/``drained``, or that went a full lease silent after
   heartbeating, sort to the back of the candidate list (the PR-10
-  zero-rpc liveness rule).
+  zero-rpc liveness rule);
+* **partition routing** — once a
+  :class:`~petastorm_tpu.serving.placement.PartitionMap` is known
+  (constructor, heartbeat stream, or :meth:`refresh_partition_map`),
+  every key routes key -> partition -> ranked replicas: a partition's
+  own replicas head the candidate list in placement order (healthiest
+  first), every other fleet endpoint forms the fallback tail — so
+  failover past a dead replica set is still possible (all replicas
+  serve the same immutable dataset) and a read is never silently
+  dropped;
+* **scatter-gather** — multi-key lookups group keys by partition and
+  fan out one request per partition on short-lived ``pst-fleet-scatter``
+  threads; predicate queries scatter each partition its disjoint
+  modular share of the row groups and merge replies back into
+  single-engine dataset order, applying ``limit`` across partitions
+  (per-partition limits are a superset of each partition's contribution
+  to the global cut). A partition whose replicas all fail raises the
+  typed error — **partial results are never returned silently**;
+* **bounded endpoint state** — heartbeat and server-id entries for
+  endpoints that left the candidate set (fleet churn) expire one lease
+  window after their last update, so a long-lived client watching a
+  churning fleet holds O(live fleet) state, not O(history).
 """
 
 import logging
@@ -42,15 +63,24 @@ class LookupClient(object):
     :param endpoints: rpc endpoint list (``tcp://host:port``).
     :param control_endpoints: matching heartbeat endpoints (optional;
         enables lease-aware endpoint ordering).
-    :param timeout_ms: whole-request deadline.
+    :param timeout_ms: whole-request deadline (scatter-gather runs its
+        per-partition requests concurrently, each under this same
+        deadline — the per-partition deadline).
     :param hedge_after_ms: silence before the next endpoint is hedged.
     :param consumer_id: admission identity (default: a fresh uuid).
+    :param partition_map: optional
+        :class:`~petastorm_tpu.serving.placement.PartitionMap` (or its
+        wire dict) to route by immediately; newer versions learned from
+        heartbeats or ``pmap`` replies supersede it.
     """
 
     def __init__(self, endpoints, control_endpoints=None, timeout_ms=5000,
                  hedge_after_ms=300, consumer_id=None,
-                 breaker_threshold=3, breaker_reset_s=15.0):
+                 breaker_threshold=3, breaker_reset_s=15.0,
+                 partition_map=None):
         import zmq
+
+        from petastorm_tpu.retry import BreakerSet
         self._zmq = zmq
         self._context = zmq.Context.instance()
         self._endpoints = list(endpoints)
@@ -61,9 +91,8 @@ class LookupClient(object):
         self._consumer_id = consumer_id or 'lookup-{}'.format(
             uuid.uuid4().hex[:12])
         self._lock = threading.Lock()
-        self._breakers = {}
-        self._breaker_threshold = int(breaker_threshold)
-        self._breaker_reset_s = float(breaker_reset_s)
+        self._breakers = BreakerSet(failure_threshold=breaker_threshold,
+                                    reset_timeout_s=breaker_reset_s)
         # Persistent per-endpoint REQ sockets (the "lazy pirate"
         # optimization): a fresh TCP + ZMTP handshake costs several ms —
         # more than a warm point read itself — so sockets that completed
@@ -76,7 +105,17 @@ class LookupClient(object):
         self._m_hedges = metrics_mod.counter(
             'pst_lookup_hedges_total',
             'Lookup requests where a hedge was sent to another endpoint')
+        self._m_map_updates = metrics_mod.counter(
+            'pst_partition_map_updates_total',
+            'Partition-map versions this process\'s lookup clients '
+            'adopted')
+        self._m_part_retries = metrics_mod.counter(
+            'pst_partition_retries_total',
+            'Partition-routed reads retried on a sibling replica '
+            '(failover past the ranked head, or a hedge that fired)')
         self.hedges = 0
+        self.scatters = 0
+        self.partition_retries = 0
         self._closed = False
         # Lease watching: SUB to every control endpoint; heartbeats drain
         # non-blocking at each request. Keyed by the DIALED rpc endpoint:
@@ -88,25 +127,22 @@ class LookupClient(object):
         # replies (every reply carries `server_id`), so the ranking
         # always looks heartbeats up under the key it ranks by.
         self._hb = {}
-        self._server_ids = {}
+        self._server_ids = {}        # server_id -> (endpoint, noted_at)
         self._sub = None
+        self._sub_endpoints = set()
         if control_endpoints:
-            self._sub = self._context.socket(zmq.SUB)
-            self._sub.setsockopt(zmq.SUBSCRIBE, b'')
+            self._ensure_sub()
             for ctrl_ep in control_endpoints:
                 self._sub.connect(ctrl_ep)
+                self._sub_endpoints.add(ctrl_ep)
+        self._pmap = None
+        if partition_map is not None:
+            self._adopt_pmap(partition_map)
 
     # -- endpoint health ---------------------------------------------------
 
     def _breaker(self, endpoint):
-        from petastorm_tpu.retry import CircuitBreaker
-        with self._lock:
-            breaker = self._breakers.get(endpoint)
-            if breaker is None:
-                breaker = self._breakers[endpoint] = CircuitBreaker(
-                    failure_threshold=self._breaker_threshold,
-                    reset_timeout_s=self._breaker_reset_s)
-            return breaker
+        return self._breakers.get(endpoint)
 
     def _socket_for(self, endpoint):
         """A ready REQ socket for ``endpoint`` — the cached one (idle,
@@ -139,14 +175,86 @@ class LookupClient(object):
         view) resolve back to the dialed key the ranking uses."""
         sid = reply.get('server_id') if isinstance(reply, dict) else None
         if sid is not None:
-            self._server_ids[sid] = endpoint
+            self._server_ids[sid] = (endpoint, time.monotonic())
+
+    def _ensure_sub(self):
+        if self._sub is None:
+            self._sub = self._context.socket(self._zmq.SUB)
+            self._sub.setsockopt(self._zmq.SUBSCRIBE, b'')
+
+    # -- partition map -----------------------------------------------------
+
+    @property
+    def partition_map(self):
+        return self._pmap
+
+    def _adopt_pmap(self, pmap):
+        """Converge on a newer map version; subscribing to any member
+        control endpoints not yet watched (a joining replica's
+        heartbeats start mattering the moment the map names it)."""
+        from petastorm_tpu.serving.placement import PartitionMap
+        if not isinstance(pmap, PartitionMap):
+            pmap = PartitionMap.from_wire(pmap)
+        if self._pmap is not None and pmap.version <= self._pmap.version:
+            return False
+        self._pmap = pmap
+        self._m_map_updates.inc()
+        ctrl_eps = [info.get('control')
+                    for info in pmap.members.values() if info.get('control')]
+        if ctrl_eps:
+            self._ensure_sub()
+            for ctrl_ep in ctrl_eps:
+                if ctrl_ep not in self._sub_endpoints:
+                    self._sub.connect(ctrl_ep)
+                    self._sub_endpoints.add(ctrl_ep)
+        return True
+
+    def refresh_partition_map(self):
+        """Pull the fleet's current map over the ``pmap`` verb (the
+        deterministic bootstrap — heartbeats converge eventually, this
+        converges now). Returns the held map (possibly None when no
+        server carries one)."""
+        reply = self._request({'cmd': 'pmap'}, hedge=False)
+        wire = reply.get('pmap') if isinstance(reply, dict) else None
+        if wire is not None:
+            self._adopt_pmap(wire)
+        return self._pmap
+
+    def _endpoints_all(self):
+        """Declared endpoints plus every map member's rpc endpoint —
+        the live candidate set."""
+        endpoints = list(self._endpoints)
+        if self._pmap is not None:
+            for info in self._pmap.members.values():
+                rpc = info.get('rpc')
+                if rpc and rpc not in endpoints:
+                    endpoints.append(rpc)
+        return endpoints
+
+    def _prune_endpoint_state(self):
+        """Bound `_hb`/`_server_ids` against fleet churn: an endpoint no
+        longer in the candidate set keeps its entries for one lease
+        window (it may be mid-rejoin), then they expire."""
+        now = time.monotonic()
+        live = set(self._endpoints_all())
+        for endpoint, (_, lease_s, at) in list(self._hb.items()):
+            if endpoint not in live and now - at > lease_s:
+                del self._hb[endpoint]
+        for sid, (endpoint, at) in list(self._server_ids.items()):
+            if endpoint in live:
+                continue
+            lease_s = self._hb.get(endpoint, (None, 10.0, 0.0))[1]
+            if now - at > lease_s:
+                del self._server_ids[sid]
 
     def _drain_heartbeats(self):
         """Non-blocking: fold every queued lease heartbeat into the
-        per-endpoint view (SUB sockets are owned by the caller thread —
-        requests are issued from whatever thread calls them, but the
-        client is documented single-caller like RemoteReader)."""
+        per-endpoint view — and adopt any newer partition map riding in
+        a heartbeat body. (SUB sockets are owned by the caller thread —
+        the client is documented single-caller like RemoteReader; the
+        scatter worker threads never touch the SUB.)"""
         if self._sub is None:
+            self._prune_endpoint_state()
             return
         from petastorm_tpu.serving.server import CTRL_HB
         import json
@@ -155,32 +263,46 @@ class LookupClient(object):
             try:
                 raw = self._sub.recv(zmq.NOBLOCK)
             except zmq.Again:
-                return
+                break
             except zmq.ZMQError:
-                return
+                break
             if not raw.startswith(CTRL_HB):
                 continue
             try:
                 body = json.loads(raw[len(CTRL_HB):].decode('utf-8'))
             except ValueError:
                 continue
+            pmap_wire = body.get('pmap')
+            if pmap_wire is not None:
+                try:
+                    self._adopt_pmap(pmap_wire)
+                except ValueError:
+                    logger.warning('ignoring malformed partition map in '
+                                   'heartbeat from %r',
+                                   body.get('server_id'))
             # Resolve the heartbeat to the DIALED endpoint the ranking
             # keys by: via the server-id binding learned from replies,
             # else the advertised rpc address when it happens to be one
-            # we dialed (the loopback/test case).
-            endpoint = self._server_ids.get(body.get('server_id'))
+            # we dial (declared or learned from the map).
+            bound = self._server_ids.get(body.get('server_id'))
+            endpoint = bound[0] if bound is not None else None
             if endpoint is None:
                 rpc = body.get('rpc')
-                endpoint = rpc if rpc in self._endpoints else None
+                endpoint = rpc if rpc in self._endpoints_all() else None
             if endpoint is not None:
                 self._hb[endpoint] = (body.get('state'),
                                       float(body.get('lease_s') or 10.0),
                                       time.monotonic())
+        self._prune_endpoint_state()
 
-    def _candidates(self):
+    def _candidates(self, partition=None):
         """Endpoints to try, healthiest first: breaker-open endpoints
         last, then lease-draining/expired ones, then everything else in
-        declared order."""
+        declared order. With a routed ``partition``, that partition's
+        replicas (placement order, health-sorted stably) head the list
+        and every other fleet endpoint forms the failover tail — any
+        replica can serve any key, so a partition whose owners all died
+        still gets answered rather than silently dropped."""
         from petastorm_tpu.retry import CircuitBreaker
         self._drain_heartbeats()
         now = time.monotonic()
@@ -199,14 +321,26 @@ class LookupClient(object):
                     # without paying an rpc timeout to find out.
                     score += 3
             return score
-        return sorted(self._endpoints, key=rank)
+        ranked = sorted(self._endpoints_all(), key=rank)
+        if partition is None or self._pmap is None:
+            return ranked
+        head = [endpoint
+                for endpoint in self._pmap.endpoints(partition)
+                if endpoint in set(ranked)]
+        head.sort(key=rank)   # stable: replica rank breaks health ties
+        return head + [e for e in ranked if e not in set(head)]
 
     # -- the request core --------------------------------------------------
 
-    def _request(self, request, hedge=True):
+    def _request(self, request, hedge=True, candidates=None,
+                 partition=None):
         """One logical request with failover + hedging. Returns the first
         non-refusal reply; raises ``ServerOverloaded`` when every
-        endpoint refused, ``RpcUnanswered`` when nobody answered."""
+        endpoint refused, ``RpcUnanswered`` when nobody answered.
+        ``candidates`` overrides the endpoint ordering (scatter workers
+        get theirs precomputed on the caller thread — they must not
+        touch the single-owner SUB socket); ``partition`` marks a
+        partition-routed read so sibling-replica retries are counted."""
         from petastorm_tpu.data_service import RpcUnanswered
         from petastorm_tpu.errors import ServerOverloaded
         zmq = self._zmq
@@ -214,12 +348,14 @@ class LookupClient(object):
             raise RuntimeError('LookupClient is closed')
         request = dict(request, consumer=self._consumer_id)
         payload = pickle.dumps(request, protocol=5)
-        candidates = self._candidates()
+        if candidates is None:
+            candidates = self._candidates(partition=partition)
         deadline = time.monotonic() + self._timeout_ms / 1000.0
         poller = zmq.Poller()
         socks = {}
         pending = list(candidates)
         refusal = None
+        sent = 0
         try:
             while True:
                 now = time.monotonic()
@@ -241,6 +377,14 @@ class LookupClient(object):
                     is_hedge = bool(socks)
                     sock = self._socket_for(endpoint)
                     sock.send(payload)
+                    sent += 1
+                    if partition is not None and sent > 1:
+                        # Any send past the ranked head — refusal
+                        # failover or a hedge — is a sibling-replica
+                        # retry of this partition's read.
+                        self._m_part_retries.inc()
+                        with self._lock:
+                            self.partition_retries += 1
                     poller.register(sock, zmq.POLLIN)
                     socks[sock] = endpoint
                     if is_hedge:
@@ -302,14 +446,76 @@ class LookupClient(object):
             for sock in socks:
                 sock.close(linger=0)
 
+    def _scatter(self, jobs):
+        """Fan ``[(partition, request)]`` out, one request per
+        partition, each under the full request deadline (the
+        per-partition deadline — partitions run concurrently). Candidate
+        lists are computed HERE, on the calling thread (the SUB socket
+        is single-owner); the short-lived scatter workers only run
+        ``_request``, whose shared state (breakers, socket cache,
+        counters) is lock-guarded. Partial failure is loud: when any
+        partition exhausts its replicas AND the failover tail, the first
+        error is raised — a scatter never returns a silently truncated
+        result set."""
+        plans = [(pid, request, self._candidates(partition=pid))
+                 for pid, request in jobs]
+        with self._lock:
+            self.scatters += 1
+        if len(plans) == 1:
+            pid, request, candidates = plans[0]
+            return {pid: self._request(request, candidates=candidates,
+                                       partition=pid)}
+        replies, errors = {}, {}
+
+        def serve_one(pid, request, candidates):
+            try:
+                replies[pid] = self._request(request,
+                                             candidates=candidates,
+                                             partition=pid)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errors[pid] = e
+
+        threads = [threading.Thread(
+            target=serve_one, args=plan, daemon=True,
+            name='pst-fleet-scatter-{}'.format(plan[0]))
+            for plan in plans]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        if errors:
+            raise errors[sorted(errors)[0]]
+        return replies
+
     # -- public verbs ------------------------------------------------------
 
     def lookup(self, keys, fields=None):
         """Point reads: per key, the list of matching rows
-        (``{field: numpy value}`` dicts; empty list = absent key)."""
-        reply = self._request({'cmd': 'lookup', 'keys': list(keys),
-                               'fields': list(fields) if fields else None})
-        return reply['rows']
+        (``{field: numpy value}`` dicts; empty list = absent key).
+        With a partition map, keys group by partition and scatter to
+        each partition's ranked replicas; duplicate keys in one request
+        are fetched once and answered at every position."""
+        keys = list(keys)
+        fields = list(fields) if fields else None
+        pmap = self._pmap
+        if pmap is None or not keys:
+            reply = self._request({'cmd': 'lookup', 'keys': keys,
+                                   'fields': fields})
+            return reply['rows']
+        groups = {}        # partition -> unique keys, first-seen order
+        for key in keys:
+            bucket = groups.setdefault(pmap.partition_of_key(key), [])
+            if not any(str(key) == str(seen) for seen in bucket):
+                bucket.append(key)
+        replies = self._scatter(
+            [(pid, {'cmd': 'lookup', 'keys': bucket, 'fields': fields,
+                    'partition': pid})
+             for pid, bucket in sorted(groups.items())])
+        rows_by_key = {}
+        for pid, bucket in groups.items():
+            for key, rows in zip(bucket, replies[pid]['rows']):
+                rows_by_key[str(key)] = rows
+        return [rows_by_key[str(key)] for key in keys]
 
     def lookup_one(self, key, fields=None):
         """The single row for ``key``, or ``None`` when absent; raises
@@ -324,11 +530,33 @@ class LookupClient(object):
         """Server-side predicate scan (``predicates.in_lambda`` etc.,
         with optional ``selectors`` row-group pruning). The predicate and
         selector must be picklable — module-level functions, not bare
-        lambdas."""
-        reply = self._request({'cmd': 'query', 'predicate': predicate,
-                               'selector': selector, 'limit': limit,
-                               'fields': list(fields) if fields else None})
-        return reply['rows']
+        lambdas.
+
+        With a partition map, the scan scatters: each partition serves
+        its disjoint modular share of the row groups (tagged with row
+        locations), and the gather merges every partial back into
+        single-engine dataset order before applying ``limit`` ACROSS
+        partitions — each partition's own ``limit``-cut is a superset of
+        its contribution to the global cut, so the merge is exact, and
+        an empty partition simply contributes nothing."""
+        fields = list(fields) if fields else None
+        base = {'cmd': 'query', 'predicate': predicate,
+                'selector': selector, 'limit': limit, 'fields': fields}
+        pmap = self._pmap
+        if pmap is None:
+            return self._request(base)['rows']
+        replies = self._scatter(
+            [(pid, dict(base, partition=pid,
+                        pieces_mod=[pid, pmap.n_partitions],
+                        with_locations=True))
+             for pid in range(pmap.n_partitions)])
+        tagged = []
+        for pid in sorted(replies):
+            tagged.extend(replies[pid]['rows'])
+        tagged.sort(key=lambda item: (item['piece'], item['offset']))
+        if limit is not None:
+            tagged = tagged[:max(int(limit), 0)]
+        return [item['row'] for item in tagged]
 
     def attach(self):
         """Explicit admission handshake (reads attach implicitly)."""
@@ -340,13 +568,52 @@ class LookupClient(object):
     def schema(self):
         return self._request({'cmd': 'schema'})['schema']
 
+    def routing_table(self):
+        """The client's current fleet view, JSON-safe: map version,
+        per-partition replica ranking with each replica's breaker state
+        and lease freshness. Empty partitions dict when no map is
+        known."""
+        self._drain_heartbeats()
+        pmap = self._pmap
+        if pmap is None:
+            return {'version': None, 'n_partitions': None,
+                    'replication': None, 'members': {}, 'partitions': {}}
+        now = time.monotonic()
+        partitions = {}
+        for pid in range(pmap.n_partitions):
+            entries = []
+            for rank, name in enumerate(pmap.replicas(pid)):
+                endpoint = (pmap.members.get(name) or {}).get('rpc')
+                hb = self._hb.get(endpoint)
+                entries.append({
+                    'rank': rank, 'name': name, 'endpoint': endpoint,
+                    'breaker': self._breaker(endpoint).state
+                    if endpoint else None,
+                    'hb_state': hb[0] if hb else None,
+                    'lease_fresh': (now - hb[2] <= hb[1])
+                    if hb else None})
+            partitions[str(pid)] = entries
+        return {'version': pmap.version,
+                'n_partitions': pmap.n_partitions,
+                'replication': pmap.replication,
+                'members': {name: dict(info)
+                            for name, info in pmap.members.items()},
+                'partitions': partitions}
+
+    def scatter_stats(self):
+        """Counters for the scatter-gather path of THIS client."""
+        with self._lock:
+            return {'scatters': self.scatters,
+                    'partition_retries': self.partition_retries,
+                    'hedges': self.hedges}
+
     def fleet_metrics(self, timeout_ms=2000):
         """Per-server metrics snapshots + the summed fleet aggregate —
         the same shape as ``RemoteReader.fleet_metrics()`` (deduped on
         the process registry id so co-located servers fold once)."""
         from petastorm_tpu import metrics as metrics_mod
         per_server, unreachable, seen = {}, [], set()
-        for endpoint in self._endpoints:
+        for endpoint in self._endpoints_all():
             try:
                 reply = self._request_one(endpoint,
                                           {'cmd': 'metrics'},

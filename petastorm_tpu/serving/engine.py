@@ -128,6 +128,10 @@ class LookupEngine(object):
             'pst_lookup_cache_hits_total',
             'Lookup-path block fetches, by serving tier',
             labelnames=('tier',))
+        self._m_warm_fills = metrics_mod.counter(
+            'pst_partition_warm_fill_chunks_total',
+            'Chunk-store entries pre-filled from a peer replica at '
+            'warm join')
         # Open-mmap / block accounting rides the memory governor like
         # every other byte-holding pool: the LRU sheds on degrade, and an
         # engine-owned chunk store registers its mmap residency too.
@@ -251,6 +255,73 @@ class LookupEngine(object):
                 else getattr(self._cache, 'lineage_tier', 'cache'))
         return cols, tier
 
+    # -- fleet support -----------------------------------------------------
+
+    @property
+    def piece_count(self):
+        return len(self._pieces)
+
+    def chunk_key(self, piece_index):
+        """The chunk-store cache key of one row-group piece — identical
+        across replicas serving the same dataset url/schema, which is
+        what makes peer-to-peer cache warming sound."""
+        return self._chunk_key(self._pieces[piece_index])
+
+    def has_cached(self, piece_index):
+        """True when the hot tier already holds this piece (warm join
+        skips it without touching the peer)."""
+        has = getattr(self._cache, 'has', None)
+        if not callable(has):
+            return False
+        return bool(has(self.chunk_key(piece_index)))
+
+    def packed_chunk(self, piece_index):
+        """One piece's decoded block serialized in the chunk-store
+        layout (CRC-protected) — the peer side of the warm-join
+        protocol. Fetches through the normal tier ladder, so exporting
+        warms the exporter too."""
+        from petastorm_tpu.chunk_store import pack_tensor_chunk
+        return pack_tensor_chunk(self._fetch_block(piece_index))
+
+    def warm_fill(self, piece_index, blob):
+        """The joining side: validate a peer's packed chunk and persist
+        it straight into this engine's :class:`DecodedChunkStore` under
+        the piece's own ``tensor_chunk_key`` — the piece's first real
+        read then hits the chunk-store tier instead of cold-decoding.
+        Raises ``CorruptChunkError`` on a torn/bit-rotted blob and
+        ``ValueError`` when the peer served a different field set."""
+        from petastorm_tpu.chunk_store import read_tensor_chunk
+        put = getattr(self._cache, 'put', None)
+        if not callable(put):
+            raise ValueError(
+                'warm_fill needs a DecodedChunkStore hot tier (engine '
+                'cache is {!r})'.format(type(self._cache).__name__))
+        cols = read_tensor_chunk(bytes(blob),
+                                 source='warm-fill:{}'.format(piece_index))
+        missing = set(self.schema.fields) - set(cols)
+        if missing:
+            raise ValueError('peer chunk for piece {} lacks served '
+                             'fields {}'.format(piece_index,
+                                                sorted(missing)))
+        accepted = bool(put(self.chunk_key(piece_index), cols))
+        if accepted:
+            self._m_warm_fills.inc()
+        return accepted
+
+    def pieces_for_partitions(self, pmap, partitions):
+        """Row-group piece ordinals a replica owning ``partitions``
+        should hold warm: every piece the modular query cover assigns it
+        plus every piece holding a key that hashes into one of its
+        partitions (resolved through the row index)."""
+        wanted = set(int(p) for p in partitions)
+        pieces = set()
+        for pid in wanted:
+            pieces.update(pmap.pieces_of_partition(pid, len(self._pieces)))
+        for key in self.index.keys():
+            if pmap.partition_of_key(key) in wanted:
+                pieces.update(p for p, _ in self.index.locations(key))
+        return sorted(pieces)
+
     # -- request path ------------------------------------------------------
 
     def _slice_row(self, cols, offset, fields):
@@ -287,12 +358,20 @@ class LookupEngine(object):
                  for piece, offset in locs]
                 for locs in locations]
 
-    def query(self, predicate, selector=None, limit=None, fields=None):
+    def query(self, predicate, selector=None, limit=None, fields=None,
+              pieces=None, with_locations=False):
         """Predicate scan with index pruning: evaluate ``predicate`` (a
         ``predicates.PredicateBase``, e.g. ``in_lambda``) over every row
         of the candidate row-groups — all of them, or the set a
         ``selectors``-module selector picks from the stored indexes —
-        serving matches until ``limit``."""
+        serving matches until ``limit``.
+
+        ``pieces`` restricts the scan to those row-group ordinals (the
+        fleet's scatter-gather sends each partition its modular share of
+        the dataset, so the union over partitions covers every piece
+        exactly once). ``with_locations=True`` wraps each match as
+        ``{'piece', 'offset', 'row'}`` so a gatherer can merge partial
+        results back into single-engine dataset order."""
         fields = self._resolve_fields(fields)
         if limit is not None and limit <= 0:
             return []
@@ -311,6 +390,9 @@ class LookupEngine(object):
                 if 0 <= p < len(self._pieces))
         else:
             candidates = range(len(self._pieces))
+        if pieces is not None:
+            allowed = set(int(p) for p in pieces)
+            candidates = [p for p in candidates if p in allowed]
         rows = []
         for piece_index in candidates:
             cols = self._fetch_block(piece_index)
@@ -318,7 +400,9 @@ class LookupEngine(object):
             for i in range(n):
                 values = {f: cols[f][i] for f in predicate_fields}
                 if predicate.do_include(values):
-                    rows.append(self._slice_row(cols, i, fields))
+                    row = self._slice_row(cols, i, fields)
+                    rows.append({'piece': piece_index, 'offset': i,
+                                 'row': row} if with_locations else row)
                     if limit is not None and len(rows) >= limit:
                         return rows
         return rows

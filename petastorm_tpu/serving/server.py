@@ -29,7 +29,21 @@ PR-10 control-plane discipline the data service proved out:
 * chaos surface: the existing ``server-slow`` (delay before the reply)
   and ``rpc-blackhole`` (swallow the request, reset the REP state
   machine) fault sites fire inside the worker loop, so the client's
-  circuit breaker and hedging are drill-testable like the data plane's.
+  circuit breaker and hedging are drill-testable like the data plane's;
+  the fleet adds ``partition-lost`` (swallow one partition's requests on
+  every replica at once) and ``hb-flap`` (suppress individual lease
+  heartbeats);
+* **fleet membership**: servers carrying a
+  :class:`~petastorm_tpu.serving.placement.PartitionMap` publish it in
+  every heartbeat and answer the ``pmap``/``pmap_update`` verbs, so
+  clients and peers converge on the highest version with no
+  coordinator. :meth:`drain` recomputes placement without the draining
+  member and pushes it to the survivors (live reassignment of the
+  drained key range); :meth:`join_fleet` adds this server to a peer's
+  map and **warm-joins** — pre-filling its ``DecodedChunkStore`` from
+  the peer's chunk files over the ``chunk`` verb (byte-validated, same
+  ``tensor_chunk_key``) so its first reads hit the chunk-store tier
+  instead of cold-decoding.
 """
 
 import json
@@ -45,6 +59,27 @@ logger = logging.getLogger(__name__)
 CTRL_HB = b'PST_LHB'
 
 DEFAULT_LEASE_S = 10.0
+
+
+def _one_shot(context, endpoint, request, timeout_ms):
+    """Fleet-internal rpc: one REQ round trip, fresh socket, hard
+    deadline. Used where a server talks to a PEER (map push, warm-join
+    chunk pulls) — peers are not clients, so none of the client-side
+    breaker/hedge state applies. Raises ``RpcUnanswered`` on silence."""
+    import zmq
+
+    from petastorm_tpu.data_service import RpcUnanswered
+    sock = context.socket(zmq.REQ)
+    sock.setsockopt(zmq.LINGER, 0)
+    try:
+        sock.connect(endpoint)
+        sock.send(pickle.dumps(request, protocol=5))
+        if not sock.poll(int(timeout_ms)):
+            raise RpcUnanswered('{} gave no reply within {}ms'.format(
+                endpoint, timeout_ms))
+        return pickle.loads(sock.recv())
+    finally:
+        sock.close(linger=0)
 
 
 class LookupServer(object):
@@ -73,7 +108,8 @@ class LookupServer(object):
     """
 
     def __init__(self, engine, bind, control_bind=None, lease_s=None,
-                 max_consumers=None, rpc_workers=1, gc_freeze=True):
+                 max_consumers=None, rpc_workers=1, gc_freeze=True,
+                 server_name=None):
         import zmq
 
         from petastorm_tpu import membudget
@@ -86,6 +122,11 @@ class LookupServer(object):
         self._zmq = zmq
         self._context = zmq.Context.instance()
         self._server_id = uuid.uuid4().hex
+        #: Fleet identity: the name placement assigns partitions to.
+        #: Operator-chosen for durable fleets; defaults to a fresh one.
+        self.server_name = server_name or 'ls-{}'.format(
+            self._server_id[:8])
+        self._pmap = None
         self._lease_s = float(lease_s if lease_s is not None
                               else _env_float(ENV_LEASE, DEFAULT_LEASE_S))
         self._max_consumers = (None if max_consumers is None
@@ -134,6 +175,15 @@ class LookupServer(object):
         self._m_rejected = metrics_mod.counter(
             'pst_consumers_rejected_total',
             'Consumer attach requests a data-service server refused',
+            labelnames=('reason',))
+        self._m_map_version = metrics_mod.gauge(
+            'pst_partition_map_version',
+            'Partition-map version this actor currently holds',
+            labelnames=('actor',))
+        self._m_reassign = metrics_mod.counter(
+            'pst_partition_reassignments_total',
+            'Partition-map recomputations this server initiated, '
+            'by reason',
             labelnames=('reason',))
 
         self._lock = threading.Lock()
@@ -229,10 +279,18 @@ class LookupServer(object):
     def drain(self, timeout_s=30.0, _inflight_floor=0):
         """Stop admitting, refuse further reads with the typed
         ``draining`` reply, wait for in-flight requests to finish, and
-        report drained. Idempotent. ``_inflight_floor`` is the ``drain``
+        report drained. Idempotent. When this server is a fleet member,
+        draining FIRST reassigns its key range: placement is recomputed
+        without it (version + 1), adopted locally (the remaining
+        heartbeats advertise the new map) and pushed to the surviving
+        peers — clients converge and route around the drain while
+        in-flight requests finish. ``_inflight_floor`` is the ``drain``
         rpc handler's own request, which is in-flight by definition and
         must not wait on itself."""
+        first = not self._draining.is_set()
         self._draining.set()
+        if first:
+            self._reassign_on_drain()
         deadline = time.monotonic() + (timeout_s
                                        if timeout_s is not None else 30.0)
         while time.monotonic() < deadline:
@@ -242,6 +300,160 @@ class LookupServer(object):
                     return True
             time.sleep(0.01)
         return self._drained.is_set()
+
+    # -- fleet membership --------------------------------------------------
+
+    @property
+    def partition_map(self):
+        with self._lock:
+            return self._pmap
+
+    def init_fleet(self, n_partitions=None, replication=2):
+        """Bootstrap a one-member fleet: this server owns every
+        partition of a fresh map (version 1). Further replicas
+        :meth:`join_fleet` against it."""
+        from petastorm_tpu.serving import placement
+        pmap = placement.build_partition_map(
+            {self.server_name: {'rpc': self.rpc_endpoint,
+                                'control': self.control_endpoint}},
+            n_partitions=(placement.DEFAULT_PARTITIONS
+                          if n_partitions is None else n_partitions),
+            replication=replication)
+        self.adopt_partition_map(pmap)
+        return pmap
+
+    def adopt_partition_map(self, pmap, reason=None):
+        """Converge on ``pmap`` (a :class:`PartitionMap` or its wire
+        dict) when its version is newer than the held one. Returns True
+        when adopted. ``reason`` marks a reassignment THIS server
+        initiated (``pst_partition_reassignments_total{reason}``)."""
+        from petastorm_tpu.serving.placement import PartitionMap
+        if not isinstance(pmap, PartitionMap):
+            pmap = PartitionMap.from_wire(pmap)
+        with self._lock:
+            if self._pmap is not None \
+                    and pmap.version <= self._pmap.version:
+                return False
+            self._pmap = pmap
+        self._m_map_version.labels(self.server_name).set(pmap.version)
+        if reason is not None:
+            self._m_reassign.labels(reason).inc()
+        logger.info('lookup server %s adopted partition map v%d '
+                    '(members: %s)', self.server_name, pmap.version,
+                    sorted(pmap.members))
+        return True
+
+    def _push_map_to_peers(self, pmap, timeout_ms=1000):
+        """Best-effort ``pmap_update`` to every other member — the
+        heartbeat stream converges everyone anyway; the push just makes
+        reassignment visible within an rpc round trip instead of a
+        heartbeat interval."""
+        pushed = 0
+        for name, info in sorted(pmap.members.items()):
+            if name == self.server_name or not info.get('rpc'):
+                continue
+            try:
+                _one_shot(self._context, info['rpc'],
+                          {'cmd': 'pmap_update', 'pmap': pmap.to_wire(),
+                           'consumer': 'fleet-{}'.format(self.server_name)},
+                          timeout_ms)
+                pushed += 1
+            except Exception as e:  # noqa: BLE001 - heartbeats converge it
+                logger.warning('map push to %s (%s) failed: %r', name,
+                               info['rpc'], e)
+        return pushed
+
+    def _reassign_on_drain(self):
+        from petastorm_tpu.serving import placement
+        with self._lock:
+            pmap = self._pmap
+        if pmap is None or self.server_name not in pmap.members \
+                or len(pmap.members) <= 1:
+            return
+        new_map = placement.remove_member(pmap, self.server_name)
+        self.adopt_partition_map(new_map, reason='drain')
+        self._push_map_to_peers(new_map)
+
+    def join_fleet(self, peer_endpoint, warm=True, timeout_ms=5000):
+        """Join the fleet a peer serves: fetch its map, recompute with
+        this server as a member (version + 1), adopt, push to every
+        peer — and when ``warm`` (and the engine's hot tier is a
+        ``DecodedChunkStore``), pre-fill the owned key range's chunks
+        from the peer over the ``chunk`` verb instead of cold-decoding,
+        then flush the store so the fills are durable before the first
+        client read lands. Returns a summary dict."""
+        from petastorm_tpu.serving import placement
+        from petastorm_tpu.serving.placement import PartitionMap
+        reply = _one_shot(self._context, peer_endpoint,
+                          {'cmd': 'pmap',
+                           'consumer': 'fleet-{}'.format(self.server_name)},
+                          timeout_ms)
+        wire = reply.get('pmap') if isinstance(reply, dict) else None
+        if wire is None:
+            raise ValueError('peer {} holds no partition map — '
+                             'init_fleet() it first'.format(peer_endpoint))
+        new_map = placement.add_member(PartitionMap.from_wire(wire),
+                                       self.server_name,
+                                       rpc=self.rpc_endpoint,
+                                       control=self.control_endpoint)
+        self.adopt_partition_map(new_map, reason='join')
+        self._push_map_to_peers(new_map)
+        summary = {'version': new_map.version,
+                   'partitions': new_map.partitions_of(self.server_name),
+                   'warmed_chunks': 0, 'warm_skipped': 0, 'warm_failed': 0}
+        if warm:
+            summary.update(self._warm_from_peer(peer_endpoint, new_map,
+                                                timeout_ms))
+        return summary
+
+    def _warm_from_peer(self, peer_endpoint, pmap, timeout_ms):
+        """The cache-warming protocol, joining side: for every owned
+        piece not already in the hot tier, pull the peer's packed chunk
+        and persist it under the shared ``tensor_chunk_key``. Blob bytes
+        in flight ride the memory governor like every other pool."""
+        from petastorm_tpu import membudget
+        engine = self._engine
+        if not callable(getattr(engine, 'warm_fill', None)) \
+                or not callable(getattr(engine, 'has_cached', None)):
+            return {}
+        owned = pmap.partitions_of(self.server_name)
+        pieces = engine.pieces_for_partitions(pmap, owned)
+        warmed = skipped = failed = 0
+        inflight = [0]
+        with membudget.transient_pool('lookup-warm',
+                                      lambda: inflight[0]):
+            for piece_index in pieces:
+                if engine.has_cached(piece_index):
+                    skipped += 1
+                    continue
+                try:
+                    reply = _one_shot(
+                        self._context, peer_endpoint,
+                        {'cmd': 'chunk', 'piece': piece_index,
+                         'consumer': 'warm-{}'.format(self.server_name)},
+                        timeout_ms)
+                    blob = (reply.get('chunk')
+                            if isinstance(reply, dict) else None)
+                    if not blob:
+                        raise ValueError('peer sent no chunk: {!r}'
+                                         .format(reply))
+                    inflight[0] = len(blob)
+                    if not engine.warm_fill(piece_index, blob):
+                        failed += 1
+                    else:
+                        warmed += 1
+                except Exception as e:  # noqa: BLE001 - warm is best-effort
+                    # A piece that fails to warm is NOT an error for the
+                    # join: it cold-decodes on first read like any miss.
+                    logger.warning('warm-join: piece %d pull from %s '
+                                   'failed: %r', piece_index,
+                                   peer_endpoint, e)
+                    failed += 1
+                finally:
+                    inflight[0] = 0
+        engine.flush(timeout_s=30.0)
+        return {'warmed_chunks': warmed, 'warm_skipped': skipped,
+                'warm_failed': failed}
 
     # -- membudget hooks ---------------------------------------------------
 
@@ -258,13 +470,24 @@ class LookupServer(object):
         """Owns the PUB socket: lease heartbeats every ``lease_s / 3``
         plus admission-ledger pruning (3 leases without a renew frees a
         crashed consumer's slot)."""
+        from petastorm_tpu import faults
         hb_interval = max(self._lease_s / 3.0, 0.05)
         while not self._stop.is_set():
-            body = json.dumps({'server_id': self._server_id,
-                               'lease_s': self._lease_s,
-                               'state': self.state,
-                               'rpc': self.rpc_endpoint}).encode('utf-8')
-            self._ctrl_sock.send(CTRL_HB + body)
+            with self._lock:
+                pmap = self._pmap
+            hb = {'server_id': self._server_id,
+                  'name': self.server_name,
+                  'lease_s': self._lease_s,
+                  'state': self.state,
+                  'rpc': self.rpc_endpoint}
+            if pmap is not None:
+                hb['pmap'] = pmap.to_wire()
+            body = json.dumps(hb).encode('utf-8')
+            if faults.get_injector().should_fire('hb-flap'):
+                logger.warning('fault injection: hb-flap suppressing '
+                               'lease heartbeat of %s', self.server_name)
+            else:
+                self._ctrl_sock.send(CTRL_HB + body)
             now = time.monotonic()
             expiry = 3 * self._lease_s
             with self._lock:
@@ -311,6 +534,20 @@ class LookupServer(object):
             try:
                 request = pickle.loads(raw)
                 verb = str(request.get('cmd') or 'unknown')
+                partition = (request.get('partition')
+                             if isinstance(request, dict) else None)
+                if partition is not None and faults.get_injector() \
+                        .should_fire('partition-lost',
+                                     key='p{}'.format(partition)):
+                    # The "whole key range went dark" drill: every
+                    # replica swallows this partition's requests (the
+                    # keyed selection fires identically fleet-wide), so
+                    # the client must surface a typed failure for the
+                    # lost range, never a truncated result.
+                    logger.warning('fault injection: partition-lost '
+                                   'dropping partition %s request',
+                                   partition)
+                    return None
                 reply = self._handle(request)
             except Exception as e:  # noqa: BLE001 - reply, don't die
                 logger.exception('lookup rpc failed')
@@ -412,6 +649,24 @@ class LookupServer(object):
                             'refused': 'overloaded',
                             'reason': 'memory-pressure',
                             'state': state}
+            partition = request.get('partition')
+            if self._mem_shed and partition is not None \
+                    and self._pmap is not None \
+                    and not self._pmap.is_primary(self.server_name,
+                                                  partition):
+                # Governor-shed, partition-aware: under the shed rung a
+                # replica keeps serving the partitions it is PRIMARY for
+                # (its working set — the reads only it can serve warmest)
+                # and sheds secondary-partition traffic back to each
+                # partition's own primary via the typed refusal. Known
+                # consumers included: shedding must move load, not just
+                # refuse strangers.
+                self._m_rejected.labels('memory-pressure').inc()
+                return {'server_id': self._server_id,
+                        'refused': 'overloaded',
+                        'reason': 'memory-pressure',
+                        'partition': partition,
+                        'state': state}
             self._consumers[consumer] = now
         return None
 
@@ -421,7 +676,8 @@ class LookupServer(object):
             refusal = self._admit(request)
             if refusal is not None:
                 return refusal
-            return {'server_id': self._server_id, 'state': self.state,
+            return {'server_id': self._server_id,
+                    'name': self.server_name, 'state': self.state,
                     'lease_s': self._lease_s}
         if cmd == 'detach':
             with self._lock:
@@ -438,11 +694,45 @@ class LookupServer(object):
             refusal = self._admit(request)
             if refusal is not None:
                 return refusal
-            rows = self._engine.query(request['predicate'],
-                                      selector=request.get('selector'),
-                                      limit=request.get('limit'),
-                                      fields=request.get('fields'))
+            pieces = request.get('pieces')
+            pieces_mod = request.get('pieces_mod')
+            if pieces_mod is not None:
+                # Scatter-gather's modular cover: [pid, n_partitions]
+                # names this server's disjoint share of the row groups.
+                pid, n_partitions = (int(pieces_mod[0]),
+                                     int(pieces_mod[1]))
+                pieces = range(pid, self._engine.piece_count,
+                               n_partitions)
+            rows = self._engine.query(
+                request['predicate'],
+                selector=request.get('selector'),
+                limit=request.get('limit'),
+                fields=request.get('fields'),
+                pieces=pieces,
+                with_locations=bool(request.get('with_locations')))
             return {'server_id': self._server_id, 'rows': rows}
+        if cmd == 'pmap':
+            with self._lock:
+                pmap = self._pmap
+            return {'server_id': self._server_id,
+                    'name': self.server_name,
+                    'pmap': None if pmap is None else pmap.to_wire()}
+        if cmd == 'pmap_update':
+            adopted = self.adopt_partition_map(request['pmap'])
+            with self._lock:
+                version = (None if self._pmap is None
+                           else self._pmap.version)
+            return {'server_id': self._server_id, 'adopted': adopted,
+                    'version': version}
+        if cmd == 'chunk':
+            # Warm-join export: serve one piece's packed chunk to a
+            # joining peer. Deliberately NOT behind _admit — a draining
+            # replica is exactly who a reassigned partition's new owner
+            # evacuates the cache from, and peers are not consumers.
+            blob = self._engine.packed_chunk(int(request['piece']))
+            return {'server_id': self._server_id,
+                    'name': self.server_name,
+                    'chunk': blob}
         if cmd == 'drain':
             drained = self.drain(float(request.get('timeout_s', 30.0)),
                                  _inflight_floor=1)
@@ -452,11 +742,15 @@ class LookupServer(object):
             with self._lock:
                 n_consumers = len(self._consumers)
                 served = self.requests_served
-            return {'server_id': self._server_id, 'state': self.state,
+                pmap = self._pmap
+            return {'server_id': self._server_id,
+                    'name': self.server_name, 'state': self.state,
                     'lease_s': self._lease_s,
                     'consumers': n_consumers,
                     'max_consumers': self._max_consumers,
                     'requests_served': served,
+                    'partition_map_version': (None if pmap is None
+                                              else pmap.version),
                     'engine': self._engine.stats()}
         if cmd == 'metrics':
             from petastorm_tpu import metrics as metrics_mod

@@ -17,6 +17,20 @@ status), so orchestration scripts can parse it. The lookup result
 carries per-field CRC32 digests (``lineage._digest_array`` — the same
 digest the provenance ledger records), which is how an operator proves a
 served row is byte-identical to the training feed's.
+
+Fleet operations ride the same command. Client side, ``--fleet`` dials
+a running fleet instead of opening the dataset::
+
+    # routing table, per-partition replica health, the scatter-gather
+    # read, and scatter stats — one JSON line each
+    python -m petastorm_tpu.tools.lookup --fleet tcp://h1:7000 \\
+        tcp://h2:7000 --key id=7
+
+Server side, ``--serve`` grows fleet membership: ``--partitions N``
+bootstraps a one-member fleet owning every partition, ``--join PEER``
+joins a running fleet (warm-filling the chunk store from the peer
+unless ``--no-warm``). The drain-on-SIGTERM discipline is unchanged —
+draining a fleet member also reassigns its key range live.
 """
 
 import argparse
@@ -40,14 +54,75 @@ def _field_summary(name, value):
     return out
 
 
+def _fleet_client(args, field, value):
+    """``--fleet`` mode: routing table, per-partition replica health,
+    the scatter-gather read, and scatter stats — one JSON line each."""
+    from petastorm_tpu.serving import LookupClient
+    client = LookupClient(args.fleet,
+                          control_endpoints=args.control,
+                          timeout_ms=args.timeout_ms)
+    try:
+        try:
+            client.refresh_partition_map()
+        except Exception as e:  # noqa: BLE001 - a CLI prints, not dies
+            print(json.dumps({'action': 'pmap-refresh',
+                              'error': repr(e)}), flush=True)
+        table = client.routing_table()
+        print(json.dumps({'action': 'routing-table', 'table': table}),
+              flush=True)
+        health = {pid: [{'name': e['name'],
+                         'endpoint': e['endpoint'],
+                         'breaker': e['breaker'],
+                         'hb_state': e['hb_state'],
+                         'lease_fresh': e['lease_fresh']}
+                        for e in entries]
+                  for pid, entries in table['partitions'].items()}
+        print(json.dumps({'action': 'partition-health',
+                          'version': table['version'],
+                          'partitions': health}), flush=True)
+        try:
+            rows = client.lookup([value])[0]
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({'action': 'lookup', 'key': args.key,
+                              'error': repr(e)}), flush=True)
+            return 1
+        print(json.dumps({'action': 'lookup', 'key': args.key,
+                          'matches': len(rows),
+                          'rows': [{name: _field_summary(name, val)
+                                    for name, val in row.items()}
+                                   for row in rows]}), flush=True)
+        print(json.dumps({'action': 'scatter-stats',
+                          'stats': client.scatter_stats()}), flush=True)
+        return 0 if rows else 3
+    finally:
+        client.close()
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description='Point reads over a petastorm_tpu dataset: build the '
                     'row-level index, look keys up, optionally serve rpc')
-    parser.add_argument('--dataset-url', required=True)
+    parser.add_argument('--dataset-url', default=None,
+                        help='the dataset to open (required unless '
+                             '--fleet dials running servers instead)')
     parser.add_argument('--key', required=True, metavar='FIELD=VALUE',
                         help='the point read, e.g. id=7; FIELD names the '
                              'indexed key field')
+    parser.add_argument('--fleet', nargs='+', default=None,
+                        metavar='ENDPOINT',
+                        help='client mode: dial these lookup rpc '
+                             'endpoints, print the routing table, '
+                             'per-partition replica health, the '
+                             'scatter-gather read, and scatter stats '
+                             'as JSON lines')
+    parser.add_argument('--control', nargs='*', default=None,
+                        metavar='ENDPOINT',
+                        help='heartbeat endpoints for --fleet (lease-'
+                             'aware ranking; the partition map also '
+                             'arrives here)')
+    parser.add_argument('--timeout-ms', type=int, default=5000,
+                        help='--fleet whole-request (per-partition) '
+                             'deadline')
     parser.add_argument('--build-index', action='store_true',
                         help='run the SingleFieldRowIndexer pass over the '
                              'key field first (persists alongside any '
@@ -71,12 +146,32 @@ def main(argv=None):
     parser.add_argument('--max-consumers', type=int, default=None)
     parser.add_argument('--lease-s', type=float, default=None)
     parser.add_argument('--rpc-workers', type=int, default=2)
+    parser.add_argument('--name', default=None,
+                        help='fleet identity of a --serve server '
+                             '(placement assigns partitions to it)')
+    parser.add_argument('--partitions', type=int, default=None,
+                        help='--serve: bootstrap a one-member fleet '
+                             'with this many hash partitions')
+    parser.add_argument('--replication', type=int, default=2,
+                        help='replica target R for --partitions')
+    parser.add_argument('--join', default=None, metavar='PEER_ENDPOINT',
+                        help='--serve: join the fleet this peer serves')
+    parser.add_argument('--no-warm', action='store_true',
+                        help='with --join: skip the peer cache '
+                             'warm-fill (cold-decode on first reads)')
     args = parser.parse_args(argv)
 
     field, sep, value = args.key.partition('=')
     if not sep or not field:
         print(json.dumps({'error': '--key must be FIELD=VALUE, got {!r}'
                           .format(args.key)}), flush=True)
+        return 2
+
+    if args.fleet:
+        return _fleet_client(args, field, value)
+    if not args.dataset_url:
+        print(json.dumps({'error': '--dataset-url is required without '
+                                   '--fleet'}), flush=True)
         return 2
 
     from petastorm_tpu.serving import LookupEngine, LookupServer
@@ -133,7 +228,29 @@ def main(argv=None):
     server = LookupServer(engine, args.bind,
                           lease_s=args.lease_s,
                           max_consumers=args.max_consumers,
-                          rpc_workers=args.rpc_workers).start()
+                          rpc_workers=args.rpc_workers,
+                          server_name=args.name).start()
+    if args.partitions:
+        pmap = server.init_fleet(n_partitions=args.partitions,
+                                 replication=args.replication)
+        print(json.dumps({'action': 'init-fleet',
+                          'name': server.server_name,
+                          'version': pmap.version,
+                          'n_partitions': pmap.n_partitions,
+                          'replication': pmap.replication}), flush=True)
+    elif args.join:
+        try:
+            summary = server.join_fleet(args.join,
+                                        warm=not args.no_warm)
+        except Exception as e:  # noqa: BLE001 - a CLI prints, not dies
+            print(json.dumps({'action': 'join-fleet',
+                              'error': repr(e)}), flush=True)
+            server.stop()
+            engine.close()
+            return 1
+        print(json.dumps(dict({'action': 'join-fleet',
+                               'name': server.server_name}, **summary)),
+              flush=True)
     print(json.dumps({'action': 'serve',
                       'rpc_endpoint': server.rpc_endpoint,
                       'control_endpoint': server.control_endpoint,

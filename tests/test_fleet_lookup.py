@@ -1,0 +1,571 @@
+"""Partitioned, replicated lookup fleet (ISSUE 16): consistent-hash
+placement, scatter-gather routing, replica failover, live reassignment
+on drain, and the peer cache warm-join.
+
+ACCEPTANCE (mirrors the issue):
+* placement is a pure function of membership — every party computes the
+  identical versioned map, and a single join/drain moves only the
+  partitions that must move;
+* scatter-gather NEVER silently truncates: a partition whose replicas
+  all fail either answers via the failover tail or raises its typed
+  error; ``query(limit=)`` is global across partitions;
+* a SIGKILLed replica fails over with zero failed lookups and served
+  bytes identical to the Reader path (per-field CRC32 digests);
+* a joining replica warm-fills its chunk store from a peer and serves
+  its first reads from the chunk-store tier — no cold decodes.
+"""
+
+import json
+import os
+import signal as signal_mod
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_tensor_reader
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.rowgroup_indexers import SingleFieldRowIndexer
+from petastorm_tpu.etl.rowgroup_indexing import build_rowgroup_index
+from petastorm_tpu.etl.writer import write_dataset
+from petastorm_tpu.lineage import _digest_array
+from petastorm_tpu.serving import (LookupClient, LookupEngine,
+                                   LookupServer, PartitionMap,
+                                   build_partition_map)
+from petastorm_tpu.serving import placement
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+pytestmark = [pytest.mark.serving, pytest.mark.fleet]
+
+ROWS = 48
+ROWS_PER_GROUP = 8
+N_PIECES = ROWS // ROWS_PER_GROUP
+
+FleetSchema = Unischema('FleetSchema', [
+    UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False),
+    UnischemaField('bucket', np.int32, (), ScalarCodec(np.int32), False),
+    UnischemaField('vec', np.float32, (4,), NdarrayCodec(), False),
+])
+
+
+@pytest.fixture(scope='module')
+def fleet_dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('fleet') / 'dataset'
+    url = 'file://' + str(path)
+    rng = np.random.default_rng(16)
+    rows = [{'id': i, 'bucket': i % 4,
+             'vec': rng.random(4, dtype=np.float32)}
+            for i in range(ROWS)]
+    write_dataset(url, FleetSchema, rows, rows_per_row_group=ROWS_PER_GROUP)
+    build_rowgroup_index(url, [SingleFieldRowIndexer('id_row_ix', 'id')])
+
+    class _Dataset:
+        pass
+
+    ds = _Dataset()
+    ds.url = url
+    ds.rows = rows
+    return ds
+
+
+def _members(*names):
+    return {name: {'rpc': 'tcp://10.0.0.{}:7000'.format(i + 1),
+                   'control': 'tcp://10.0.0.{}:7001'.format(i + 1)}
+            for i, name in enumerate(names)}
+
+
+# ---------------------------------------------------------------------------
+# placement: determinism, stability, wire format
+# ---------------------------------------------------------------------------
+
+def test_placement_deterministic_and_replicated():
+    members = _members('a', 'b', 'c')
+    pmap = build_partition_map(members, n_partitions=8, replication=2)
+    # pure function of membership: any party recomputes the same map,
+    # whatever the dict iteration order
+    shuffled = {name: members[name] for name in ('c', 'a', 'b')}
+    again = build_partition_map(shuffled, n_partitions=8, replication=2)
+    assert pmap == again
+    for pid in range(8):
+        reps = pmap.replicas(pid)
+        assert len(reps) == 2 and len(set(reps)) == 2
+        assert set(reps) <= set(members)
+    # every member carries some partitions (64 vnodes keep 3 servers
+    # from starving anyone across 8 partitions x 2 replicas)
+    assert all(pmap.partitions_of(name) for name in members)
+
+
+def test_placement_stable_under_join():
+    pmap = build_partition_map(_members('a', 'b', 'c'),
+                               n_partitions=16, replication=2)
+    grown = placement.add_member(pmap, 'd', rpc='tcp://10.0.0.9:7000',
+                                 control='tcp://10.0.0.9:7001')
+    assert grown.version == pmap.version + 1
+    moved = 0
+    for pid in range(16):
+        if 'd' in grown.replicas(pid):
+            moved += 1
+        else:
+            # consistent hashing: a partition the joiner did not adopt
+            # keeps its replica list BYTE-identical — no churn beyond
+            # the ring points the new member intercepts
+            assert grown.replicas(pid) == pmap.replicas(pid)
+    assert 0 < moved < 16
+
+
+def test_placement_wire_round_trip_and_membership_edges():
+    pmap = build_partition_map(_members('a'), n_partitions=4,
+                               replication=3)
+    # effective R is clamped to the membership size
+    assert all(pmap.replicas(pid) == ['a'] for pid in range(4))
+    wire = json.loads(json.dumps(pmap.to_wire()))   # a real JSON trip
+    assert PartitionMap.from_wire(wire) == pmap
+    grown = placement.add_member(pmap, 'b', rpc='tcp://10.0.0.2:7000')
+    assert grown.version == 2 and grown.replication == 3
+    # R=3 over two members: both replicate everything
+    assert all(len(grown.replicas(pid)) == 2 for pid in range(4))
+    shrunk = placement.remove_member(grown, 'a')
+    assert shrunk.version == 3 and list(shrunk.members) == ['b']
+    with pytest.raises(ValueError):
+        placement.remove_member(shrunk, 'b')
+
+
+def test_partition_of_key_string_form_and_piece_cover():
+    pmap = build_partition_map(_members('a', 'b'), n_partitions=4)
+    for key in (0, 7, 13, ROWS - 1):
+        # keys route by STRING form, same as the row-level index — int
+        # and str spellings of one key land on one partition
+        assert pmap.partition_of_key(key) == pmap.partition_of_key(str(key))
+        assert pmap.partition_of_key(key) == placement.partition_of_key(
+            key, 4)
+    # modular piece cover: disjoint and exact over the ordinals
+    covered = []
+    for pid in range(4):
+        covered.extend(pmap.pieces_of_partition(pid, N_PIECES))
+    assert sorted(covered) == list(range(N_PIECES))
+    assert len(covered) == len(set(covered))
+
+
+# ---------------------------------------------------------------------------
+# fleet integration: routing, scatter-gather, reassignment
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def fleet(fleet_dataset):
+    """Two named replicas over one dataset: srv-a bootstraps the map,
+    srv-b joins (cold), the client dials + watches both."""
+    engines = [LookupEngine(fleet_dataset.url, index_name='id_row_ix')
+               for _ in range(2)]
+    servers = [
+        LookupServer(eng, 'tcp://127.0.0.1:*', lease_s=1.0,
+                     server_name=name).start()
+        for eng, name in zip(engines, ('srv-a', 'srv-b'))]
+    servers[0].init_fleet(n_partitions=4, replication=2)
+    servers[1].join_fleet(servers[0].rpc_endpoint, warm=False)
+    client = LookupClient([s.rpc_endpoint for s in servers],
+                          control_endpoints=[s.control_endpoint
+                                             for s in servers],
+                          timeout_ms=5000, hedge_after_ms=150)
+    client.refresh_partition_map()
+    try:
+        yield servers, client
+    finally:
+        client.close()
+        for server in servers:
+            server.stop()
+        for eng in engines:
+            eng.close()
+
+
+def test_fleet_join_converges_map_and_routing(fleet):
+    servers, client = fleet
+    pmap = client.partition_map
+    assert pmap is not None and pmap.version == 2
+    assert sorted(pmap.members) == ['srv-a', 'srv-b']
+    assert servers[0].partition_map.version == 2    # pushed on join
+    for pid in range(pmap.n_partitions):
+        candidates = client._candidates(partition=pid)
+        # the partition's ranked replicas head the candidate list...
+        assert candidates[0] == pmap.endpoints(pid)[0]
+        # ...and EVERY fleet endpoint is in it (the failover tail)
+        assert set(candidates) == {s.rpc_endpoint for s in servers}
+    table = client.routing_table()
+    assert table['version'] == 2
+    assert set(table['partitions']) == {str(p)
+                                        for p in range(pmap.n_partitions)}
+    assert all(entry['breaker'] == 'closed'
+               for entries in table['partitions'].values()
+               for entry in entries)
+
+
+def test_scatter_lookup_multi_key_duplicates_and_absent(fleet):
+    servers, client = fleet
+    keys = [7, 3, 7, '7', 44, 9999, 3]
+    results = client.lookup(keys)
+    assert len(results) == len(keys)
+    for key, rows in zip(keys, results):
+        if key == 9999:
+            assert rows == []
+        else:
+            # duplicates (and the str spelling) answered at EVERY
+            # position, fetched once per partition
+            assert len(rows) == 1
+            assert int(rows[0]['id']) == int(key)
+    assert client.scatter_stats()['scatters'] >= 1
+    # both replicas served work (keys spread over partitions and the
+    # partitions spread over the two members)
+    assert all(s.requests_served > 0 for s in servers)
+
+
+def _bucket_is(bucket, state):
+    return bucket == state
+
+
+def test_query_scatter_matches_engine_order_and_global_limit(
+        fleet, fleet_dataset):
+    from petastorm_tpu.predicates import in_lambda
+    servers, client = fleet
+    predicate = in_lambda(['bucket'], _bucket_is, state_arg=1)
+    with LookupEngine(fleet_dataset.url, index_name='id_row_ix') as ref:
+        want = [int(r['id']) for r in ref.query(predicate)]
+    assert want == [i for i in range(ROWS) if i % 4 == 1]
+    rows = client.query(predicate)
+    assert [int(r['id']) for r in rows] == want
+    # ``limit`` is GLOBAL across partitions: the merged cut equals the
+    # single-engine prefix, not one prefix per partition
+    limited = client.query(predicate, limit=5)
+    assert [int(r['id']) for r in limited] == want[:5]
+    assert client.query(predicate, limit=0) == []
+
+
+def test_query_empty_partitions_contribute_nothing(fleet_dataset):
+    """More partitions than row-group pieces: the empty partitions'
+    scatter legs answer zero rows and the merge order is unharmed."""
+    from petastorm_tpu.predicates import in_lambda
+    with LookupEngine(fleet_dataset.url, index_name='id_row_ix') as eng:
+        with LookupServer(eng, 'tcp://127.0.0.1:*', lease_s=1.0,
+                          server_name='solo').start() as server:
+            pmap = server.init_fleet(n_partitions=16, replication=2)
+            assert pmap.n_partitions > N_PIECES
+            with LookupClient([server.rpc_endpoint],
+                              partition_map=pmap) as client:
+                rows = client.query(
+                    in_lambda(['bucket'], _bucket_is, state_arg=2))
+                assert [int(r['id'])
+                        for r in rows] == [i for i in range(ROWS)
+                                           if i % 4 == 2]
+                assert client.scatter_stats()['scatters'] == 1
+
+
+def test_drain_reassigns_live_and_client_converges(fleet):
+    servers, client = fleet
+    assert client.lookup([5])[0]
+    servers[0].drain()
+    # the drain recomputed placement without srv-a (version 3), adopted
+    # it locally and pushed it to the survivor
+    assert servers[0].partition_map.version == 3
+    assert servers[1].partition_map.version == 3
+    survivor_map = servers[1].partition_map
+    assert list(survivor_map.members) == ['srv-b']
+    for pid in range(survivor_map.n_partitions):
+        assert survivor_map.replicas(pid) == ['srv-b']
+    # ZERO failed lookups across the reassignment: the drained member's
+    # typed refusal fails each read over to the survivor
+    for key in range(ROWS):
+        rows = client.lookup([key])[0]
+        assert len(rows) == 1 and int(rows[0]['id']) == key
+    # and the client converged on the reassigned map (rpc push landed
+    # on the survivor; the client picks it up over pmap/heartbeats)
+    client.refresh_partition_map()
+    assert client.partition_map.version == 3
+
+
+def test_warm_join_serves_first_reads_from_chunk_store(fleet_dataset,
+                                                       tmp_path):
+    from petastorm_tpu.serving.engine import TIER_DECODE
+    eng_a = LookupEngine(fleet_dataset.url, index_name='id_row_ix',
+                         cache=str(tmp_path / 'store-a'))
+    eng_b = LookupEngine(fleet_dataset.url, index_name='id_row_ix',
+                         cache=str(tmp_path / 'store-b'))
+    srv_a = srv_b = None
+    try:
+        # warm the donor: every piece decodes once into its store
+        for key in range(ROWS):
+            assert eng_a.lookup([key])[0]
+        assert eng_a.flush(30.0)
+        srv_a = LookupServer(eng_a, 'tcp://127.0.0.1:*', lease_s=1.0,
+                             server_name='srv-a').start()
+        srv_a.init_fleet(n_partitions=4, replication=2)
+        srv_b = LookupServer(eng_b, 'tcp://127.0.0.1:*', lease_s=1.0,
+                             server_name='srv-b').start()
+        summary = srv_b.join_fleet(srv_a.rpc_endpoint, warm=True)
+        # R=2 over 2 members: the joiner replicates every partition, so
+        # the warm-fill pulls every piece — and none fail
+        assert summary['partitions'] == [0, 1, 2, 3]
+        assert summary['warmed_chunks'] == N_PIECES
+        assert summary['warm_failed'] == 0
+        assert all(eng_b.has_cached(piece) for piece in range(N_PIECES))
+        # the joiner's FIRST reads come off the chunk-store tier: zero
+        # cold decodes anywhere in the serve path
+        with LookupClient([srv_b.rpc_endpoint]) as client:
+            for key in range(ROWS):
+                assert int(client.lookup([key])[0][0]['id']) == key
+        tiers = eng_b.stats()['tiers']
+        assert tiers.get(TIER_DECODE, 0) == 0
+        assert tiers.get('chunk-store', 0) >= N_PIECES
+    finally:
+        for srv in (srv_b, srv_a):
+            if srv is not None:
+                srv.stop()
+        eng_b.close()
+        eng_a.close()
+
+
+def test_warm_fill_rejects_torn_blob(fleet_dataset, tmp_path):
+    from petastorm_tpu.chunk_store import CorruptChunkError
+    with LookupEngine(fleet_dataset.url, index_name='id_row_ix',
+                      cache=str(tmp_path / 'store')) as eng:
+        blob = bytearray(eng.packed_chunk(0))
+        blob[len(blob) // 2] ^= 0xFF
+        with pytest.raises(CorruptChunkError):
+            eng.warm_fill(0, bytes(blob))
+        assert not eng.has_cached(0)
+
+
+# ---------------------------------------------------------------------------
+# bounded client state under churn
+# ---------------------------------------------------------------------------
+
+def test_client_endpoint_state_bounded_under_churn(fleet):
+    servers, client = fleet
+    live = servers[0].rpc_endpoint
+    long_ago = time.monotonic() - 120.0
+    # a departed member's heartbeat + server-id entries age out after
+    # one lease window; a live endpoint's survive any amount of churn
+    client._hb['tcp://10.9.9.9:7000'] = ('serving', 1.0, long_ago)
+    client._hb[live] = ('serving', 1.0, time.monotonic())
+    client._server_ids['ghost-sid'] = ('tcp://10.9.9.9:7000', long_ago)
+    client._prune_endpoint_state()
+    assert 'tcp://10.9.9.9:7000' not in client._hb
+    assert 'ghost-sid' not in client._server_ids
+    assert live in client._hb
+    # still inside its lease window: a rejoining member's state is kept
+    client._hb['tcp://10.9.9.8:7000'] = ('serving', 30.0,
+                                         time.monotonic() - 1.0)
+    client._prune_endpoint_state()
+    assert 'tcp://10.9.9.8:7000' in client._hb
+
+
+# ---------------------------------------------------------------------------
+# chaos: partition-lost, hb-flap, per-partition shed, SIGKILL failover
+# ---------------------------------------------------------------------------
+
+def _key_in_partition(pmap, pid, avoid=()):
+    for key in range(ROWS):
+        if pmap.partition_of_key(key) == pid and key not in avoid:
+            return key
+    pytest.skip('no indexed key hashes into partition {}'.format(pid))
+
+
+@pytest.mark.chaos
+def test_partition_lost_raises_typed_never_truncates(fleet, monkeypatch):
+    from petastorm_tpu import faults
+    from petastorm_tpu.data_service import RpcUnanswered
+    servers, client = fleet
+    pmap = client.partition_map
+    lost_key = _key_in_partition(pmap, 0)
+    safe_key = next(k for k in range(ROWS)
+                    if pmap.partition_of_key(k) != 0)
+    storm = LookupClient([s.rpc_endpoint for s in servers],
+                         timeout_ms=700, hedge_after_ms=100,
+                         breaker_threshold=50, partition_map=pmap)
+    try:
+        assert storm.lookup([lost_key])[0]
+        monkeypatch.setenv(faults.ENV_VAR, 'partition-lost:match=p0')
+        # the keyed drill fires identically on EVERY replica: partition
+        # 0 went dark fleet-wide, sibling partitions keep serving
+        assert int(storm.lookup([safe_key])[0][0]['id']) == safe_key
+        with pytest.raises(RpcUnanswered):
+            storm.lookup([lost_key])
+        # partial scatter is loud, never truncated: a mixed-key read
+        # raises the lost partition's error instead of returning a
+        # result set missing its keys
+        with pytest.raises(RpcUnanswered):
+            storm.lookup([lost_key, safe_key])
+        monkeypatch.delenv(faults.ENV_VAR)
+        assert int(storm.lookup([lost_key])[0][0]['id']) == lost_key
+    finally:
+        storm.close()
+
+
+@pytest.mark.chaos
+def test_hb_flap_wobbles_ranking_not_reads(fleet, monkeypatch):
+    from petastorm_tpu import faults
+    servers, client = fleet
+    monkeypatch.setenv(faults.ENV_VAR, 'hb-flap:p=1')
+    time.sleep(0.5)            # a heartbeat interval passes unsent
+    for key in (1, 9, 17, 33):
+        rows = client.lookup([key])[0]
+        assert len(rows) == 1 and int(rows[0]['id']) == key
+    # a flapping routing hint is never an error: candidates still rank
+    assert set(client._candidates()) == {s.rpc_endpoint for s in servers}
+
+
+def test_mem_shed_keeps_primary_partitions_sheds_secondary(fleet):
+    servers, client = fleet
+    srv_b = servers[1]
+    pmap = srv_b.partition_map
+    primary = [pid for pid in range(pmap.n_partitions)
+               if pmap.is_primary('srv-b', pid)]
+    secondary = [pid for pid in range(pmap.n_partitions)
+                 if not pmap.is_primary('srv-b', pid)]
+    if not primary or not secondary:
+        pytest.skip('placement gave srv-b a one-sided rank split')
+    assert srv_b._admit({'cmd': 'lookup', 'consumer': 'c1'}) is None
+    srv_b._set_mem_shed(True)
+    try:
+        # shed rung: a KNOWN consumer keeps its primary partitions...
+        assert srv_b._admit({'cmd': 'lookup', 'consumer': 'c1',
+                             'partition': primary[0]}) is None
+        # ...and secondary-partition traffic gets the typed refusal
+        # that routes it back to that partition's own primary
+        refusal = srv_b._admit({'cmd': 'lookup', 'consumer': 'c1',
+                                'partition': secondary[0]})
+        assert refusal is not None
+        assert refusal['reason'] == 'memory-pressure'
+        assert refusal['partition'] == secondary[0]
+    finally:
+        srv_b._set_mem_shed(False)
+
+
+def _serve_cli(dataset_url, name, extra, tmp=None):
+    cmd = [sys.executable, '-m', 'petastorm_tpu.tools.lookup',
+           '--dataset-url', dataset_url, '--key', 'id=3',
+           '--index', 'id_row_ix', '--serve', '--name', name] + extra
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                            env=dict(os.environ, JAX_PLATFORMS='cpu'))
+
+
+def _read_until(proc, action, timeout_s=120):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        body = json.loads(line)
+        if body.get('action') == action:
+            return body
+        assert 'error' not in body, body
+    pytest.fail('server never printed {!r}'.format(action))
+
+
+@pytest.mark.chaos
+def test_sigkill_failover_within_lease_zero_failed_lookups(fleet_dataset):
+    """The headline chaos drill: SIGKILL one replica of a live 2-member
+    fleet under a multi-threaded key storm — zero lookups fail (each
+    read fails over inside its own deadline) and every served row is
+    byte-identical to the Reader path."""
+    reader_digests = {}
+    with make_tensor_reader(fleet_dataset.url, reader_pool_type='dummy',
+                            shuffle_row_groups=False) as reader:
+        for chunk in reader:
+            for i in range(len(chunk.id)):
+                reader_digests[int(chunk.id[i])] = {
+                    'id': _digest_array(chunk.id[i]),
+                    'bucket': _digest_array(chunk.bucket[i]),
+                    'vec': _digest_array(chunk.vec[i])}
+    assert len(reader_digests) == ROWS
+
+    victim = _serve_cli(fleet_dataset.url, 'srv-victim',
+                        ['--partitions', '4', '--lease-s', '2'])
+    survivor = None
+    try:
+        victim_serve = _read_until(victim, 'serve')
+        survivor = _serve_cli(
+            fleet_dataset.url, 'srv-survivor',
+            ['--join', victim_serve['rpc_endpoint'], '--no-warm',
+             '--lease-s', '2'])
+        survivor_serve = _read_until(survivor, 'serve')
+        endpoints = [victim_serve['rpc_endpoint'],
+                     survivor_serve['rpc_endpoint']]
+        controls = [victim_serve['control_endpoint'],
+                    survivor_serve['control_endpoint']]
+        failures, checked = [], [0]
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def storm(worker_id):
+            client = LookupClient(endpoints, control_endpoints=controls,
+                                  timeout_ms=10000, hedge_after_ms=150,
+                                  breaker_threshold=2, breaker_reset_s=1.0)
+            try:
+                client.refresh_partition_map()
+                rng = np.random.default_rng(worker_id)
+                while not stop.is_set():
+                    key = int(rng.integers(0, ROWS))
+                    try:
+                        rows = client.lookup([key])[0]
+                        assert len(rows) == 1
+                        row = rows[0]
+                        for field, want in reader_digests[key].items():
+                            assert _digest_array(row[field]) == want
+                        with lock:
+                            checked[0] += 1
+                    except Exception as e:  # noqa: BLE001 - collected
+                        with lock:
+                            failures.append((key, repr(e)))
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=storm, args=(i,), daemon=True)
+                   for i in range(3)]
+        for thread in threads:
+            thread.start()
+        time.sleep(1.0)                     # storm is flowing
+        victim.kill()                       # SIGKILL, not a drain
+        victim.wait(timeout=30)
+        time.sleep(4.0)                     # > one lease of storming on
+        stop.set()
+        for thread in threads:
+            thread.join(timeout=60)
+        assert failures == [], failures[:5]
+        with lock:
+            assert checked[0] > 50
+    finally:
+        for proc in (survivor, victim):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+
+
+# ---------------------------------------------------------------------------
+# CLI --fleet mode
+# ---------------------------------------------------------------------------
+
+def test_lookup_cli_fleet_mode_prints_routing_and_stats(fleet,
+                                                        fleet_dataset):
+    servers, _ = fleet
+    proc = subprocess.run(
+        [sys.executable, '-m', 'petastorm_tpu.tools.lookup',
+         '--key', 'id=7',
+         '--fleet'] + [s.rpc_endpoint for s in servers] +
+        ['--control'] + [s.control_endpoint for s in servers],
+        capture_output=True, text=True, timeout=180,
+        env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    assert proc.returncode == 0, proc.stderr
+    lines = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    by_action = {line['action']: line for line in lines}
+    table = by_action['routing-table']['table']
+    assert table['version'] == 2
+    assert sorted(table['members']) == ['srv-a', 'srv-b']
+    health = by_action['partition-health']
+    assert set(health['partitions']) == {'0', '1', '2', '3'}
+    result = by_action['lookup']
+    assert result['matches'] == 1
+    assert result['rows'][0]['id']['value'] == 7
+    assert result['rows'][0]['vec']['crc32'] == '{:#010x}'.format(
+        _digest_array(fleet_dataset.rows[7]['vec']))
+    assert by_action['scatter-stats']['stats']['scatters'] >= 1
