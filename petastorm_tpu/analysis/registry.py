@@ -169,6 +169,13 @@ DIR_GUARDS = (
         'Trace sidecar dirs, bare sidecar files from PETASTORM_TPU_'
         'TRACE_DIR pointed at the tempdir, and flight-recorder dump '
         'dirs.', marker='observability'),
+    DirGuard(
+        ('pst-bench-probe-*',), 'bench',
+        'Opportunistic-prober flock files (bench._probe_lock_path) live '
+        'under the tempdir — previously next to the committed artifact, '
+        'where one got checked in. Zero-byte, but the sweep keeps the '
+        'shared tempdir from accreting one per checkout hash.',
+        marker=None),
 )
 
 

@@ -111,6 +111,7 @@ class AutotuneConfig(object):
                  min_prefetch=1, max_prefetch=8,
                  min_inflight=1, max_inflight=8,
                  min_device_inflight=1, max_device_inflight=8,
+                 min_device_stream_mb=1, max_device_stream_mb=64,
                  min_arena_depth=2, max_arena_depth=16,
                  min_watermark=4,
                  min_decode_threads=1, max_decode_threads=None,
@@ -135,6 +136,9 @@ class AutotuneConfig(object):
         self.min_device_inflight = max(1, int(min_device_inflight))
         self.max_device_inflight = max(self.min_device_inflight,
                                        int(max_device_inflight))
+        self.min_device_stream_mb = max(0, int(min_device_stream_mb))
+        self.max_device_stream_mb = max(self.min_device_stream_mb,
+                                        int(max_device_stream_mb))
         self.min_arena_depth = max(1, int(min_arena_depth))
         self.max_arena_depth = max(self.min_arena_depth, int(max_arena_depth))
         self.min_watermark = max(2, int(min_watermark))
@@ -258,10 +262,15 @@ _GROW_ACTIONS = {
     # dispatch-bound steps the PER-DEVICE in-flight window first (the
     # per-device sharded staging path, ISSUE 14): transfer backpressure
     # forms per device stream, so widening every stream's window attacks
-    # it directly; the batch-level window and prefetch depth remain the
-    # fallbacks once the per-device clamp is hit (and the only levers on
-    # single-device pipelines, which have no device_inflight knob).
-    DISPATCH_BOUND: (('device_inflight', 1), ('inflight', 1),
+    # it directly. Next come the dispatch-cost levers: pinned arenas
+    # (DMA-friendly host slabs make each transfer cheaper) and the
+    # inline/batched threshold (growing it routes more fields through
+    # the single C++ batched transfer per wave); the batch-level window
+    # and prefetch depth remain the fallbacks once those clamp (and the
+    # only levers on single-device pipelines, which have none of the
+    # per-device knobs).
+    DISPATCH_BOUND: (('device_inflight', 1), ('arena_pinned', 1),
+                     ('device_stream_min_mb', 8), ('inflight', 1),
                      ('prefetch', 1)),
     ARENA_BOUND: (('arena_depth', 2),),
 }
@@ -274,6 +283,7 @@ _GROW_ACTIONS = {
 # business saturating the host's cores either.
 _SHRINK_STEPS = (('workers', 1), ('prefetch', 1), ('inflight', 1),
                  ('device_inflight', 1), ('arena_depth', 2),
+                 ('arena_pinned', 1),
                  ('decode_threads', 2), ('results_watermark', 8))
 
 # Cumulative telemetry counters (everything else is a gauge).

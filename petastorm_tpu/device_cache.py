@@ -1,4 +1,4 @@
-"""Device-resident dataset cache: decode once, train epochs out of HBM.
+"""Device-resident dataset tier: decode once, train epochs out of HBM.
 
 The reference caches *encoded row-groups on local disk*
 (``local_disk_cache.py:22-63``) — every epoch still pays decode, collation,
@@ -9,18 +9,32 @@ fill pass), keep the staged rows, and from epoch 1 on iterate entirely
 on-device — zero host I/O, zero decode, zero h2d traffic, input stall
 identically 0.
 
-Epoch reshuffling happens **on the accelerator**: the cache holds one
-contiguous ``[N, ...]`` ``jax.Array`` per field, draws a fresh permutation
-per epoch, and regathers each batch with a jitted ``take``. For
-mesh-sharded data XLA lowers the gather to collectives over ICI; batch
-shapes (and therefore the downstream train step's compiled program) never
-change. Host-side shuffle state disappears entirely — the permutation is
-``fold_in(key, epoch)``, reproducible across job restarts by construction.
+Storage is **incremental superbatches**: every ``superbatch_batches``
+cached batches are consolidated into one contiguous ``[k*rows, ...]``
+array per field as they stream, so the fill's transient double-hold is
+one superbatch — not the whole dataset (the old single-consolidation
+design held the dataset twice at epoch end). Superbatches are also the
+**eviction unit**: the cache registers a ``device-cache`` pool with the
+memory governor (``membudget``), and in partial mode the degrade rung
+evicts the coldest superbatch while the advisory rung pauses further
+fill.
 
-Fit-in-HBM is the user's call, but guarded: the cache tracks staged bytes
-and raises :class:`DeviceCacheOverflow` once they exceed ``max_bytes``
-(default 40% of the device's reported HBM — consolidation transiently
-holds the dataset twice) rather than letting the runtime OOM mid-epoch.
+**Partial-dataset mode** (``partial=True``) turns the budget from a hard
+wall into a watermark: the hottest (earliest-streamed) superbatches stay
+resident and the remainder streams through the source pipeline each
+epoch (``loader_factory`` supplies a fresh deterministic pass; batches
+whose indices are HBM-resident are served from the cache and the
+source's copy of them is dropped — the streamed pass keeps the epoch
+complete and bit-identical under live eviction). ``DeviceCacheOverflow``
+is never raised in partial mode.
+
+Epoch reshuffling happens **on the accelerator**: the cache draws a
+fresh two-level permutation per epoch — superbatch visit order plus
+row order within each superbatch, both from ``fold_in(key, epoch)`` —
+and regathers each batch with a jitted ``take``. For mesh-sharded data
+XLA lowers the gather to collectives over ICI; batch shapes (and
+therefore the downstream train step's compiled program) never change,
+and the sequence is reproducible across job restarts by construction.
 
 Usage::
 
@@ -31,80 +45,198 @@ Usage::
                 for batch in cache.epoch(epoch):
                     state, metrics = train_step(state, batch.image, batch.label)
 
-The source loader must be finite (``num_epochs=1``); the cache materializes
-exactly one pass.
+The source loader must be finite (``num_epochs=1``); the cache
+materializes exactly one pass.
 """
 
 import logging
+import threading
 
 logger = logging.getLogger(__name__)
 
 _DEFAULT_HBM_FRACTION = 0.4
+_DEFAULT_SUPERBATCH_BATCHES = 8
 
 
 class DeviceCacheOverflow(RuntimeError):
-    """Staged bytes exceeded the cache budget."""
+    """Staged bytes exceeded the cache budget (full mode only)."""
+
+
+class _Superbatch(object):
+    """One consolidated run of cached batches: ``columns[name]`` is a
+    ``[n_batches * rows, ...]`` device array; ``start`` is the first
+    source batch index the run covers. ``last_hit`` feeds coldest-first
+    eviction."""
+
+    __slots__ = ('columns', 'start', 'n_batches', 'rows', 'nbytes',
+                 'last_hit', 'hits')
+
+    def __init__(self, columns, start, n_batches, rows, nbytes):
+        self.columns = columns
+        self.start = start
+        self.n_batches = n_batches
+        self.rows = rows
+        self.nbytes = nbytes
+        self.last_hit = 0
+        self.hits = 0
+
+    def covers(self, batch_index):
+        return self.start <= batch_index < self.start + self.n_batches
 
 
 class DeviceDatasetCache(object):
-    """Caches a finite loader's batches on device; reshuffles epochs with a
-    jitted on-device gather.
+    """Caches a finite loader's batches on device in superbatch units;
+    reshuffles epochs with a jitted on-device gather.
 
     :param loader: a :class:`~petastorm_tpu.jax_loader.JaxLoader` over a
         finite reader (``num_epochs=1``). Consumed lazily during epoch 0;
-        the loader can be closed afterwards.
-    :param shuffle: reshuffle rows across the whole cached set each epoch.
+        the loader can be closed afterwards. The cache attaches itself to
+        the loader so ``loader.stats['device_cache']`` reports the tier.
+    :param shuffle: reshuffle rows each epoch — two-level (superbatch
+        visit order + rows within each superbatch), entirely on device.
         ``False`` replays cache order (batch boundaries preserved).
-    :param seed: base of the per-epoch permutation key (the epoch index is
-        folded in: every epoch differs, the permutation sequence is
-        reproducible). Note the permutation acts on *cache order* — for
-        bit-identical epoch streams across job restarts the source pipeline
-        must also be deterministic (``workers_count=1`` or a seeded
-        single-reader setup; multi-worker pools interleave chunk arrival).
-    :param max_bytes: **per-device** staging budget (sharded global bytes are
-        normalized by the batch's device count); ``None`` = 40% of the first
-        device's reported HBM (no limit when the backend reports no stats).
+    :param seed: base of the per-epoch permutation key (the epoch index
+        is folded in: every epoch differs, the sequence is reproducible).
+        The permutation acts on *cache order* — for bit-identical epoch
+        streams across job restarts the source pipeline must also be
+        deterministic (``workers_count=1`` or a seeded single-reader
+        setup; multi-worker pools interleave chunk arrival).
+    :param max_bytes: **per-device** staging budget (sharded global bytes
+        are normalized by the batch's addressable-shard size); ``None`` =
+        40% of the first device's reported HBM (no limit when the backend
+        reports no stats). Full mode raises :class:`DeviceCacheOverflow`
+        past it; partial mode stops filling instead.
+    :param partial: keep only the superbatches that fit and stream the
+        remainder each epoch. Requires ``loader_factory`` for epochs past
+        the fill pass unless everything fit after all.
+    :param superbatch_batches: batches consolidated per superbatch — the
+        fill's transient double-hold and the eviction granularity.
+    :param loader_factory: zero-arg callable returning a fresh iterable
+        over the SAME deterministic batch stream (a new reader + loader).
+        Partial epochs walk it for the uncached indices; resident indices
+        are served from HBM and the source's copy is dropped.
     """
 
-    def __init__(self, loader, shuffle=True, seed=0, max_bytes=None):
+    def __init__(self, loader, shuffle=True, seed=0, max_bytes=None,
+                 partial=False, superbatch_batches=None, loader_factory=None):
         import jax
+
+        from petastorm_tpu import membudget as membudget_mod
+        from petastorm_tpu import metrics as metrics_mod
 
         self._jax = jax
         self._loader = loader
         self._shuffle = shuffle
         self._seed = seed
-        self._columns = None     # dict name -> [N, ...] jax.Array
+        self._partial = bool(partial)
+        self._loader_factory = loader_factory
+        self._superbatch_batches = max(1, int(
+            superbatch_batches if superbatch_batches is not None
+            else _DEFAULT_SUPERBATCH_BATCHES))
+        self._lock = threading.Lock()   # governor thread vs consumer
+        self._superbatches = []
         self._nt_type = None
         self._batch_rows = None
-        self._n_batches = None
+        self._total_batches = None
         self._bytes = 0
+        self._per_dev_bytes = 0
         self._max_bytes = (max_bytes if max_bytes is not None
                            else _default_budget(jax))
         self._take = None
         self._streaming = False
+        self._materialized = False
         self._overflow_msg = None
         self._cleared = False
+        self._fill_paused = False
+        self._fill_stopped = False
+        self._evictions = 0
+        self._hits = 0
+        self._hit_clock = 0
+        self._m_bytes = metrics_mod.gauge(
+            'pst_device_cache_bytes',
+            'Global logical bytes resident in the device dataset cache '
+            'across all caches (inc/dec per superbatch lifetime)')
+        self._m_hits = metrics_mod.counter(
+            'pst_device_cache_hits_total',
+            'Batches served from the HBM-resident dataset tier')
+        # Governor pool: accounting always; the degrade (evict coldest
+        # superbatch) and advisory (pause fill) rungs only in partial
+        # mode — acting on a full-mode cache would silently break the
+        # "every epoch is the whole dataset" contract. On zero-copy CPU
+        # backends these are genuine host bytes; on accelerators the
+        # pool is the governor's leverage over the largest reclaimable
+        # allocation the input pipeline owns.
+        self._mem_handle = membudget_mod.register_pool(
+            'device-cache', lambda: self._bytes,
+            degrade_fn=self._evict_coldest if self._partial else None,
+            advisory_fn=self._set_fill_paused if self._partial else None)
+        try:
+            loader._device_cache = self
+        except Exception:  # noqa: BLE001 - duck-typed loaders in tests
+            pass
 
     # -- introspection -----------------------------------------------------
 
     @property
     def materialized(self):
-        return self._columns is not None
+        return self._materialized
 
     @property
     def nbytes(self):
-        """Bytes staged so far (cached rows, excluding consolidation peak)."""
+        """Global logical bytes resident (summed over superbatches)."""
         return self._bytes
+
+    def stats(self):
+        with self._lock:
+            return {
+                'materialized': self._materialized,
+                'partial': self._partial,
+                'superbatches': len(self._superbatches),
+                'cached_batches': sum(sb.n_batches
+                                      for sb in self._superbatches),
+                'total_batches': self._total_batches,
+                'nbytes': self._bytes,
+                'hits': self._hits,
+                'evictions': self._evictions,
+                'fill_paused': self._fill_paused,
+                'fill_stopped': self._fill_stopped,
+            }
+
+    # -- governor hooks (partial mode) -------------------------------------
+
+    def _set_fill_paused(self, active):
+        with self._lock:
+            self._fill_paused = bool(active)
+
+    def _evict_coldest(self):
+        """Degrade rung: drop the coldest superbatch (least-recently hit,
+        earliest on ties). Idempotent per tick; the evicted run's batch
+        indices fall back to the streamed remainder from the next epoch
+        (and mid-epoch: coverage is re-read per batch)."""
+        with self._lock:
+            if not self._superbatches:
+                return False
+            coldest = min(self._superbatches,
+                          key=lambda sb: (sb.last_hit, sb.start))
+            self._superbatches.remove(coldest)
+            self._bytes -= coldest.nbytes
+            self._evictions += 1
+        self._m_bytes.inc(-coldest.nbytes)
+        logger.info('device cache evicted superbatch [%d, %d) under memory '
+                    'pressure (%.2f GB freed)', coldest.start,
+                    coldest.start + coldest.n_batches, coldest.nbytes / 1e9)
+        return True
 
     # -- iteration ---------------------------------------------------------
 
     def epoch(self, epoch_index=0):
-        """Iterate one epoch. Epoch 0 streams through the host pipeline while
-        caching; later epochs run from HBM."""
+        """Iterate one epoch. The first call streams through the host
+        pipeline while caching; later epochs run from HBM (plus the
+        streamed remainder in partial mode)."""
         if self._cleared:
             raise RuntimeError('DeviceDatasetCache was cleared; construct a '
                                'new cache over a fresh loader')
-        if self._columns is None:
+        if not self._materialized:
             if self._overflow_msg is not None:
                 # The caching epoch overflowed the budget — the "abandoned
                 # mid-stream" message below would misleadingly suggest the
@@ -129,38 +261,88 @@ class DeviceDatasetCache(object):
     def _first_epoch(self):
         self._streaming = True
         self._bytes = 0
-        per_dev_bytes = 0
-        batches = []
+        self._per_dev_bytes = 0
+        pending = []          # batches awaiting consolidation
+        pending_start = 0
+        n = 0
         for batch in self._loader:
-            self._bytes += sum(getattr(batch, f).nbytes for f in batch._fields)
-            per_dev_bytes += _per_device_nbytes(batch)
-            if self._max_bytes and per_dev_bytes > self._max_bytes:
-                self._overflow_msg = (
-                    'device cache exceeded {:.2f} GB per-device budget after '
-                    '{} batches ({:.2f} GB/device staged); raise max_bytes or '
-                    'drop the cache for this dataset'.format(
-                        self._max_bytes / 1e9, len(batches) + 1,
-                        per_dev_bytes / 1e9))
-                raise DeviceCacheOverflow(self._overflow_msg)
-            batches.append(batch)
+            rows = len(getattr(batch, batch._fields[0]))
+            if self._batch_rows is None:
+                self._batch_rows = rows
+            elif rows != self._batch_rows:
+                # A short tail (last_batch='partial') would make the
+                # permutation index past the real row count — jnp.take
+                # clamps silently and the final rows would train
+                # duplicated every epoch.
+                raise ValueError(
+                    'device cache requires equal-size batches, but batch '
+                    '{} has {} rows (expected {}); build the JaxLoader '
+                    "with last_batch='drop' or 'pad'".format(
+                        n, rows, self._batch_rows))
             self._nt_type = type(batch)
+            if not self._cache_batch(batch, n, pending, pending_start):
+                if not pending:
+                    pending_start = n
+                pending.append(batch)
+                if len(pending) >= self._superbatch_batches:
+                    self._consolidate(pending, pending_start)
+                    del pending[:]
+            n += 1
             yield batch
-        if not batches:
+        if n == 0:
             raise ValueError('source loader yielded no batches to cache')
-        self._consolidate(batches)
-        # Free the per-batch device arrays now — the generator frame would
-        # otherwise pin them (alongside the consolidated columns) until the
-        # consumer drops the generator.
-        batches.clear()
+        if pending:
+            self._consolidate(pending, pending_start)
+            pending = []
+        self._total_batches = n
+        self._materialized = True
         self._streaming = False
+        with self._lock:
+            cached = sum(sb.n_batches for sb in self._superbatches)
+        logger.info(
+            'device cache materialized: %d/%d batches x %d rows in %d '
+            'superbatch(es), %.2f GB%s', cached, n, self._batch_rows,
+            len(self._superbatches), self._bytes / 1e9,
+            ' (partial)' if cached < n else '')
 
-    def _consolidate(self, batches):
-        """Per-field concat of all cached batches into one [N, ...] array.
+    def _cache_batch(self, batch, index, pending, pending_start):
+        """Budget/pause gate for one streamed batch. Returns True when
+        the batch must NOT be cached (stream-only); flushes the pending
+        run first so cached coverage stays contiguous per superbatch."""
+        with self._lock:
+            paused = self._fill_paused or self._fill_stopped
+        if paused and self._partial:
+            if pending:
+                self._consolidate(pending, pending_start)
+                del pending[:]
+            return True
+        per_dev = _per_device_nbytes(batch)
+        if self._max_bytes and self._per_dev_bytes + per_dev > self._max_bytes:
+            msg = ('device cache exceeded {:.2f} GB per-device budget after '
+                   '{} batches ({:.2f} GB/device staged); raise max_bytes or '
+                   'drop the cache for this dataset'.format(
+                       self._max_bytes / 1e9, index + 1,
+                       (self._per_dev_bytes + per_dev) / 1e9))
+            if not self._partial:
+                self._overflow_msg = msg
+                self._drop_all()
+                raise DeviceCacheOverflow(msg)
+            with self._lock:
+                if not self._fill_stopped:
+                    self._fill_stopped = True
+                    logger.info('device cache budget reached; streaming the '
+                                'remainder (partial mode): %s', msg)
+            if pending:
+                self._consolidate(pending, pending_start)
+                del pending[:]
+            return True
+        self._per_dev_bytes += per_dev
+        return False
 
-        Transiently holds the dataset twice (inputs + output) — the reason
-        the default budget is 40% of HBM, not 80%. The caller clears its
-        batch list right after this returns to release the inputs.
-        """
+    def _consolidate(self, batches, start):
+        """Per-field concat of one pending run into a superbatch. The
+        transient double-hold is this run only — the per-batch arrays
+        free as soon as the caller drops its list."""
         # NOT jnp.concatenate: this jaxlib's SPMD concat lowering sums
         # replicas on partially-replicated meshes (see
         # parallel.mesh.replica_safe_concat); equal-size batches are
@@ -168,59 +350,148 @@ class DeviceDatasetCache(object):
         # always applies.
         from petastorm_tpu.parallel.mesh import replica_safe_concat
         jit_concat = self._jax.jit(lambda *xs: replica_safe_concat(xs))
-        self._batch_rows = len(getattr(batches[0], batches[0]._fields[0]))
-        self._n_batches = len(batches)
-        ragged = [i for i, b in enumerate(batches)
-                  if len(getattr(b, b._fields[0])) != self._batch_rows]
-        if ragged:
-            # A short tail (last_batch='partial') would make the permutation
-            # index past the real row count — jnp.take clamps silently and
-            # the final rows would train duplicated every epoch.
-            raise ValueError(
-                'device cache requires equal-size batches, but batch(es) {} '
-                "differ; build the JaxLoader with last_batch='drop' or "
-                "'pad'".format(ragged))
-        self._columns = {
+        columns = {
             name: jit_concat(*[getattr(b, name) for b in batches])
             for name in self._nt_type._fields}
-        del batches
-        logger.info('device cache materialized: %d batches x %d rows, %.2f GB',
-                    self._n_batches, self._batch_rows, self._bytes / 1e9)
+        nbytes = sum(col.nbytes for col in columns.values())
+        sb = _Superbatch(columns, start, len(batches), self._batch_rows,
+                         nbytes)
+        with self._lock:
+            self._superbatches.append(sb)
+            self._superbatches.sort(key=lambda s: s.start)
+            self._bytes += nbytes
+        self._m_bytes.inc(nbytes)
 
-    def _cached_epoch(self, epoch_index):
+    def _covering(self, batch_index):
+        with self._lock:
+            for sb in self._superbatches:
+                if sb.covers(batch_index):
+                    self._hit_clock += 1
+                    sb.last_hit = self._hit_clock
+                    sb.hits += 1
+                    self._hits += 1
+                    return sb
+        return None
+
+    def _sb_batch(self, sb, batch_index, perm):
+        """One batch out of a resident superbatch — a plain slice in
+        replay order, a jitted gather under the epoch permutation."""
         jax = self._jax
-        import jax.numpy as jnp
-
-        rows = self._batch_rows
-        if not self._shuffle:
-            # Identity replay: plain slices of the resident columns — no
-            # permutation, no gather work.
-            for out in range(self._n_batches):
-                yield self._nt_type(
-                    **{name: col[out * rows:(out + 1) * rows]
-                       for name, col in self._columns.items()})
-            return
-
+        rows = sb.rows
+        local = batch_index - sb.start
+        if perm is None:
+            return self._nt_type(
+                **{name: col[local * rows:(local + 1) * rows]
+                   for name, col in sb.columns.items()})
         if self._take is None:
             # Donation off: the column arrays are reused every epoch. The
-            # gather keeps the column's sharding layout for the output batch.
+            # gather keeps the column's sharding layout for the output
+            # batch.
+            import jax.numpy as jnp
             self._take = jax.jit(lambda col, idx: jnp.take(col, idx, axis=0))
+        idx = jax.lax.dynamic_slice_in_dim(perm, local * rows, rows)
+        return self._nt_type(**{name: self._take(col, idx)
+                                for name, col in sb.columns.items()})
 
-        total = self._n_batches * rows
+    def _epoch_perms(self, epoch_index):
+        """Per-superbatch row permutations for one epoch (None each when
+        shuffle is off), keyed by the superbatch's start index so live
+        eviction never shifts another run's draw."""
+        if not self._shuffle:
+            return {}
+        jax = self._jax
         key = jax.random.fold_in(jax.random.PRNGKey(self._seed), epoch_index)
-        perm = jax.random.permutation(key, total)
-        for out in range(self._n_batches):
-            idx = jax.lax.dynamic_slice_in_dim(perm, out * rows, rows)
-            yield self._nt_type(**{name: self._take(col, idx)
-                                   for name, col in self._columns.items()})
+        with self._lock:
+            runs = [(sb.start, sb.n_batches * sb.rows)
+                    for sb in self._superbatches]
+        return {start: jax.random.permutation(
+                    jax.random.fold_in(key, start), total)
+                for start, total in runs}
+
+    def _cached_epoch(self, epoch_index):
+        import numpy as np
+
+        jax = self._jax
+        perms = self._epoch_perms(epoch_index)
+        with self._lock:
+            fully_cached = (sum(sb.n_batches for sb in self._superbatches)
+                            == self._total_batches)
+        if fully_cached:
+            # Pure-HBM epoch: visit superbatches in a per-epoch permuted
+            # order (shuffle's coarse level), batches within each run in
+            # row-permuted order (the fine level). No host I/O at all.
+            with self._lock:
+                sbs = list(self._superbatches)
+            order = range(len(sbs))
+            if self._shuffle:
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(self._seed), epoch_index)
+                # 0xffffffff cannot collide with a superbatch start (the
+                # per-run row keys) — fold_in data must be uint32.
+                order = np.asarray(jax.random.permutation(
+                    jax.random.fold_in(key, 0xffffffff), len(sbs)))
+            for sb_i in order:
+                sb = sbs[int(sb_i)]
+                perm = perms.get(sb.start)
+                for local in range(sb.n_batches):
+                    batch_index = sb.start + local
+                    self._covering(batch_index)   # hit accounting
+                    self._m_hits.inc()
+                    yield self._sb_batch(sb, batch_index, perm)
+            return
+        # Partial epoch: merge HBM-resident runs with the streamed
+        # remainder by batch index — the epoch stays complete (and, with
+        # shuffle off, bit-identical to the streamed path) even when the
+        # governor evicts mid-epoch. The source pass still PRODUCES the
+        # resident indices; their streamed copies are dropped (a
+        # skip-ahead source is future work — the chunk-store hot tier
+        # makes the redundant pass cheap).
+        if self._loader_factory is None:
+            raise RuntimeError(
+                'partial device cache needs loader_factory= to stream the '
+                'uncached remainder (cached {}/{} batches)'.format(
+                    sum(sb.n_batches for sb in self._superbatches),
+                    self._total_batches))
+        source = iter(self._loader_factory())
+        for batch_index in range(self._total_batches):
+            streamed = next(source, None)
+            sb = self._covering(batch_index)
+            if sb is not None:
+                self._m_hits.inc()
+                yield self._sb_batch(sb, batch_index,
+                                     perms.get(sb.start))
+            elif streamed is not None:
+                yield streamed
+            else:
+                raise RuntimeError(
+                    'loader_factory stream ended at batch {} of {} — the '
+                    'remainder source must replay the full deterministic '
+                    'pass'.format(batch_index, self._total_batches))
+        close = getattr(source, 'close', None)
+        if close is not None:
+            close()
+
+    # -- teardown ----------------------------------------------------------
+
+    def _drop_all(self):
+        with self._lock:
+            freed = self._bytes
+            self._superbatches = []
+            self._bytes = 0
+        if freed:
+            self._m_bytes.inc(-freed)
 
     def clear(self):
-        """Drop the cached device arrays (frees HBM). The cache is finished
-        afterwards — ``epoch()`` raises; build a new cache to train on."""
-        self._columns = None
-        self._bytes = 0
+        """Drop the cached device arrays (frees HBM) and unregister the
+        governor pool. The cache is finished afterwards — ``epoch()``
+        raises; build a new cache to train on."""
+        self._drop_all()
         self._take = None
+        self._materialized = False
         self._cleared = True
+        if self._mem_handle is not None:
+            self._mem_handle.close()
+            self._mem_handle = None
 
 
 def _per_device_nbytes(batch):

@@ -26,6 +26,7 @@ SURVEY.md §7.6:
     batch N and host->HBM transfer of batch N+1 hides under XLA step N.
 """
 
+import contextlib
 import logging
 import queue
 import threading
@@ -677,6 +678,30 @@ def _stack_column(values, name, shape_policies, x64, out=None):
 # device staging + prefetch
 # --------------------------------------------------------------------------
 
+class _BatchedShardWave(object):
+    """One field's whole per-device wave, submitted as a SINGLE stream
+    item: the stream-side put issues one C++ batched transfer over every
+    shard view and returns the stitched global array, so DMA-scale fields
+    get the cheap dispatch of the inline tier AND land against the
+    per-device in-flight windows (fence pipelining) instead of blocking
+    the dispatch thread. ``pst_self_accounting`` tells the stream loop
+    the put_fn records the true per-device byte/shard breakdown itself
+    (``record_inline_wave``) — the submitting stream must not claim the
+    whole wave's bytes as its own."""
+
+    __slots__ = ('sharding', 'plan', 'streams', 'views', 'from_arena',
+                 'nbytes')
+    pst_self_accounting = True
+
+    def __init__(self, sharding, plan, streams, views, from_arena):
+        self.sharding = sharding
+        self.plan = plan
+        self.streams = streams
+        self.views = views
+        self.from_arena = from_arena
+        self.nbytes = sum(v.nbytes for v in views)
+
+
 class JaxLoader(object):
     """Iterates mesh-sharded ``jax.Array`` batches off a Reader.
 
@@ -762,7 +787,18 @@ class JaxLoader(object):
         path because the shard layout is never recomputed per batch);
         both tiers produce the identical per-device-sharded global
         array. Default 8MB; ``0`` forces every shard through the
-        streams.
+        streams. DMA-scale fields above the threshold still go out as
+        one batched transfer when the API is available — issued FROM a
+        stream thread as a single wave item so the transfer lands
+        against the per-device in-flight window instead of blocking
+        dispatch (the streamed-batched tier).
+    :param pinned_arenas: allocate the host staging arenas as
+        DMA-friendly pinned slabs (page-aligned, pre-faulted,
+        best-effort ``mlock`` — see ``native/pinned.py``); falls back
+        to plain buffers when no pinned tier is available. ``None``
+        defers to ``PETASTORM_TPU_PINNED_ARENAS=1``; the autotuner's
+        ``arena_pinned`` knob and the memory governor's advisory rung
+        can flip it at runtime.
     :param watchdog: enable the pipeline health supervisor
         (``petastorm_tpu.health``): every stage beats a heartbeat and a
         watchdog thread classifies stalls (reader-starved / assemble-stuck
@@ -822,7 +858,7 @@ class JaxLoader(object):
                  watchdog=None, stall_timeout_s=None, autotune=None,
                  lineage=None, resume_state=None, on_device_augment=None,
                  per_device_dispatch=None, device_inflight=2,
-                 device_stream_min_bytes=None):
+                 device_stream_min_bytes=None, pinned_arenas=None):
         import jax
 
         # Fail a typo'd memory budget before any staging thread starts or
@@ -1083,6 +1119,9 @@ class JaxLoader(object):
         self._stager = None
         self._stager_devices = ()
         self._shard_plans = {}
+        # Device-resident dataset tier (device_cache.DeviceDatasetCache
+        # attaches itself here so loader stats surface the HBM tier).
+        self._device_cache = None
         self._donate_supported = None   # probed on first donated put
         self._device_stream_min_bytes = (
             8 << 20 if device_stream_min_bytes is None
@@ -1105,13 +1144,18 @@ class JaxLoader(object):
                 and per_device_dispatch is not False:
             devices = self._collect_stager_devices()
             if devices:
-                from petastorm_tpu.staging import DeviceStager
+                from petastorm_tpu.staging import DeviceStager, OverlapMeter
                 self._stager_devices = devices
                 # Stream threads start LAZILY on the first streamed wave
                 # (DeviceStager.start via put_shards): a constructor
                 # failure below must not leak parked pst-device-put
                 # threads with no reachable stop path, and the inline
                 # tier never needs them running.
+                # The stager gets its OWN OverlapMeter: the loader tracks
+                # 'host' around _stage on it, the stager tracks one
+                # logical 'h2d' lane over its in-flight windows, and
+                # their co-activity IS the streamed-path h2d_overlap_frac
+                # (satellite: the bench probe used to report 0.0 here).
                 self._stager = DeviceStager(
                     stream_keys=[str(getattr(d, 'id', i))
                                  for i, d in enumerate(devices)],
@@ -1119,7 +1163,8 @@ class JaxLoader(object):
                     inflight=device_inflight,
                     ready_fn=jax.block_until_ready,
                     stop_event=self._stop,
-                    tracer=self._tracer)
+                    tracer=self._tracer,
+                    meter=OverlapMeter())
             elif per_device_dispatch:
                 raise ValueError(
                     'per_device_dispatch=True but the mesh/sharding has no '
@@ -1164,7 +1209,8 @@ class JaxLoader(object):
             self._metered_reader = host_reader
             self._arena_pool = ArenaPool(arena_depth, stop_event=self._stop,
                                          tracer=self._tracer, meter=meter,
-                                         heartbeat=hb_assemble)
+                                         heartbeat=hb_assemble,
+                                         pinned=pinned_arenas)
             arena_buffers = self._arena_pool.get_buffers
             if self._health is not None:
                 self._health.registry.register_probe('arena-pool',
@@ -1227,8 +1273,22 @@ class JaxLoader(object):
         self._mem_handles = []
         if self._arena_pool is not None:
             pool = self._arena_pool
+            self._arena_pinned_before_advisory = False
+
+            def arena_advisory(active):
+                # mlocked slabs are exactly the pages the kernel cannot
+                # reclaim under pressure — the advisory rung unpins new
+                # arena allocations (live slabs recycle out naturally)
+                # and the release restores the configured mode.
+                if active:
+                    self._arena_pinned_before_advisory = pool.pinned
+                    pool.set_pinned(False)
+                elif self._arena_pinned_before_advisory:
+                    pool.set_pinned(True)
+
             self._mem_handles.append(governor.register_pool(
-                'arena-pool', lambda: pool.nbytes))
+                'arena-pool', lambda: pool.nbytes,
+                advisory_fn=arena_advisory))
         def prefetch_queue_nbytes():
             # Arena-backed staging (the prefetch>0 engine path): every
             # queued batch's HOST bytes are already accounted by the
@@ -1303,6 +1363,14 @@ class JaxLoader(object):
                     'arena_depth', lambda: self._arena_pool.depth,
                     self._arena_pool.set_depth, lo=cfg.min_arena_depth,
                     hi=cfg.max_arena_depth)
+                # DMA-friendly host slabs: a dispatch-bound pipeline grows
+                # into pinned mode (faster transfers from page-aligned /
+                # mlocked buffers); the memory-shrink ladder steps it back
+                # off first — mlocked pages are unreclaimable.
+                arena_pool = self._arena_pool
+                knobs['arena_pinned'] = autotune_mod.Knob(
+                    'arena_pinned', lambda: int(arena_pool.pinned),
+                    lambda v: arena_pool.set_pinned(bool(v)), lo=0, hi=1)
             if self._stager is not None:
                 # Per-device window: the dispatch-bound classification
                 # steps this BEFORE the global inflight window (see
@@ -1313,6 +1381,17 @@ class JaxLoader(object):
                     'device_inflight', lambda: stager.inflight_window,
                     stager.set_inflight, lo=cfg.min_device_inflight,
                     hi=cfg.max_device_inflight)
+                if self._batched_put is not None:
+                    # Growing the inline/batched threshold routes MORE
+                    # fields through the single C++ batched transfer per
+                    # wave — the cheapest dispatch path when the pipeline
+                    # is dispatch-bound.
+                    knobs['device_stream_min_mb'] = autotune_mod.Knob(
+                        'device_stream_min_mb',
+                        lambda: self._device_stream_min_bytes >> 20,
+                        self.set_device_stream_min_mb,
+                        lo=cfg.min_device_stream_mb,
+                        hi=cfg.max_device_stream_mb)
             self._reader_telemetry = None
             adopt = getattr(reader, 'adopt_autotune', None)
             if adopt is not None:
@@ -1341,6 +1420,13 @@ class JaxLoader(object):
                         autotune_mod.writer_throttle_listener(store))
 
     # -- autotune hookups --------------------------------------------------
+
+    def set_device_stream_min_mb(self, mb):
+        """Retarget the inline-batched-put threshold at runtime (autotune
+        hookup). Fields whose per-shard bytes fall below the threshold go
+        out as one C++ batched transfer; at or above it they stream
+        through the per-device windows as one batched wave item."""
+        self._device_stream_min_bytes = max(0, int(mb)) << 20
 
     def set_prefetch(self, n):
         """Retarget the staged-batch bound at runtime (autotune hookup).
@@ -1518,7 +1604,11 @@ class JaxLoader(object):
         """DeviceStager ``put_fn``: issue one shard's transfer on its
         device's stream — through :meth:`_chunked_put` when
         ``stage_chunks`` asks (the transport optimization now applies
-        per device, so multi-device shardings ride it too)."""
+        per device, so multi-device shardings ride it too). A
+        :class:`_BatchedShardWave` item carries a whole field's wave and
+        goes out as one batched transfer."""
+        if isinstance(array, _BatchedShardWave):
+            return self._batched_stream_put(array)
         device = self._stager_devices[stream_index]
         if (self._stage_chunks > 1
                 and array.nbytes >= _STAGE_CHUNK_MIN_BYTES
@@ -1526,9 +1616,43 @@ class JaxLoader(object):
             return self._chunked_put(array, device=device, donate=donate)
         return self._device_put(array, device, donate)
 
+    def _batched_stream_put(self, wave):
+        """Streamed-batched tier (runs ON a device-put stream thread):
+        one C++ batched transfer for the whole field's wave, stitched
+        into the global array before it enters the in-flight window.
+        Falls back to serial per-shard puts inside this same call when
+        the internal API refuses — the stream item must still deliver a
+        global array — and records the wave's true per-device breakdown
+        either way (``pst_self_accounting``: the stream loop skipped its
+        own accounting for this item)."""
+        t0 = time.perf_counter()
+        batched = self._batched_put
+        staged = None
+        if batched is not None:
+            try:
+                aval = self._shaped_array(wave.plan.global_shape,
+                                          wave.views[0].dtype)
+                staged = batched(aval, wave.sharding, list(wave.views),
+                                 list(wave.plan.devices))
+            except Exception:  # noqa: BLE001 - internal API drifted
+                logger.warning(
+                    'pxla.batched_device_put failed on the stream tier; '
+                    'falling back to per-shard device_put for the rest of '
+                    'this run', exc_info=True)
+                self._batched_put = None
+        if staged is None:
+            shards = [self._device_put(v, self._stager_devices[s], False)
+                      for s, v in zip(wave.streams, wave.views)]
+            staged = self._jax.make_array_from_single_device_arrays(
+                wave.plan.global_shape, wave.sharding, shards)
+        self._stager.record_inline_wave(
+            wave.streams, [v.nbytes for v in wave.views],
+            time.perf_counter() - t0, wave.from_arena)
+        return staged
+
     def _stage_pending_shards(self, pending, out, arena):
         """Dispatch every planned field's per-device shards, then stitch
-        each field's global ``jax.Array``. Two tiers, same result:
+        each field's global ``jax.Array``. Three tiers, same result:
 
         * **inline** (small shards): ONE batched per-device transfer per
           field on the dispatch thread — the precomputed zero-copy shard
@@ -1537,38 +1661,54 @@ class JaxLoader(object):
           round-trips (measurably faster than the one-shot
           ``make_array_from_process_local_data``, which re-wrangles
           indices every call);
-        * **streams** (DMA-scale shards, chunked puts, or no batched-put
-          API): the whole wave is submitted across the per-device stream
-          threads before gathering, so every device issues concurrently
-          and transfers land in the background against the per-device
-          in-flight windows; the field stitches with
+        * **streamed-batched** (DMA-scale shards): the same single C++
+          batched transfer, but issued FROM a stream thread as one
+          :class:`_BatchedShardWave` item so it lands against the
+          per-device in-flight windows (fence pipelining) instead of
+          blocking the dispatch thread for the whole transfer;
+        * **streams** (chunked puts, or no batched-put API): the wave is
+          submitted shard-by-shard across the per-device stream threads
+          before gathering, so every device issues concurrently; the
+          field stitches with
           ``jax.make_array_from_single_device_arrays``.
         """
         jax = self._jax
         streamed = []
+        waves = []
         for name, sharding, plan, streams, donate_ok, array in pending:
             views, from_arena = self._shard_arrays(name, array, arena, plan)
             shard_nbytes = views[0].nbytes if views else 0
             chunked = (self._stage_chunks > 1
                        and shard_nbytes >= _STAGE_CHUNK_MIN_BYTES)
-            if (self._batched_put is not None and not chunked
-                    and shard_nbytes < self._device_stream_min_bytes):
-                staged = self._batched_assemble(sharding, plan, streams,
-                                                views, from_arena)
-                if staged is not None:
-                    out[name] = staged
+            if self._batched_put is not None and not chunked:
+                if shard_nbytes < self._device_stream_min_bytes:
+                    staged = self._batched_assemble(sharding, plan, streams,
+                                                    views, from_arena)
+                    if staged is not None:
+                        out[name] = staged
+                        continue
+                else:
+                    waves.append((name, _BatchedShardWave(
+                        sharding, plan, streams, views, from_arena)))
                     continue
             streamed.append((name, sharding, plan, streams, donate_ok,
                              views, from_arena))
-        if not streamed:
+        if not waves and not streamed:
             return
         items = []
+        for i, (_name, wave) in enumerate(waves):
+            # Round-robin the submitting stream over the wave's own
+            # devices so concurrent fields issue from different threads
+            # (the batched put covers every device either way).
+            items.append((wave.streams[i % len(wave.streams)], wave, False))
         for _name, _sh, _plan, streams, donate_ok, views, from_arena \
                 in streamed:
             for stream, view, unique in zip(streams, views, donate_ok):
                 items.append((stream, view, from_arena and unique))
         staged_flat = self._stager.put_shards(items)
-        pos = 0
+        for k, (name, _wave) in enumerate(waves):
+            out[name] = staged_flat[k]
+        pos = len(waves)
         for name, sharding, plan, streams, _ok, views, _fa in streamed:
             count = len(streams)
             out[name] = jax.make_array_from_single_device_arrays(
@@ -1648,7 +1788,15 @@ class JaxLoader(object):
         pending = []   # per-device sharded fields, dispatched as one wave
         t0 = time.perf_counter()
         nbytes = 0
-        with self._tracer.span('stage', 'device'):
+        # The stager's OverlapMeter: staging batch N+1 counts as 'host'
+        # work; its co-activity with the stager's in-flight 'h2d' windows
+        # (transfers of batch N still unfenced) is the streamed-path
+        # h2d_overlap_frac.
+        host_span = (self._stager.meter.track('host')
+                     if self._stager is not None
+                     and self._stager.meter is not None
+                     else contextlib.nullcontext())
+        with self._tracer.span('stage', 'device'), host_span:
             for name, array in host_batch.items():
                 nbytes += array.nbytes
                 if hasattr(array, 'is_ready'):
@@ -2034,6 +2182,11 @@ class JaxLoader(object):
             # Provenance ledger health: records minted vs dropped, the
             # write-behind lag, and where the ledger landed on disk.
             out['lineage'] = self._lineage.stats()
+        if self._device_cache is not None:
+            # HBM-resident dataset tier (device_cache.DeviceDatasetCache
+            # attached itself): cached bytes/superbatches, hit/eviction
+            # counts, and whether the governor paused or stopped the fill.
+            out['device_cache'] = self._device_cache.stats()
         from petastorm_tpu import membudget as membudget_mod
         governor = membudget_mod.get_governor()
         if governor.armed:

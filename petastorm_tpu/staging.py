@@ -64,6 +64,23 @@ _DONE = object()        # assemble exhausted its iterator
 #: pstlint thread-lifecycle checker both know who joins them.
 DEVICE_PUT_THREAD_PREFIX = 'pst-device-put'
 
+#: Per-field offset alignment inside a pinned arena slab. Page alignment
+#: keeps every field's buffer on its own page boundary — the transfer
+#: granularity DMA engines and ``mlock`` both work in.
+PINNED_FIELD_ALIGN = 4096
+
+
+def _pinned_slab_layout(spec):
+    """``({name: (offset, size)}, total)`` for one arena slab: every field
+    starts on a :data:`PINNED_FIELD_ALIGN` boundary."""
+    offsets, total = {}, 0
+    for name, (shape, dtype) in spec.items():
+        size = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        offsets[name] = (total, size)
+        padded = -(-max(size, 1) // PINNED_FIELD_ALIGN) * PINNED_FIELD_ALIGN
+        total += padded
+    return offsets, total
+
 
 _alias_probe_memo = {}
 
@@ -142,10 +159,23 @@ class HostArena(object):
     ``StaleViewError`` at the stale access instead of silently reading a
     different batch's bytes."""
 
-    def __init__(self, pool, spec):
+    def __init__(self, pool, spec, slab=None):
         # spec: {name: (shape, dtype)}; shape includes the batch dim.
-        self.buffers = {name: np.empty(shape, dtype)
-                        for name, (shape, dtype) in spec.items()}
+        # With a pinned slab the buffers are page-aligned (optionally
+        # mlocked) carve-outs of one DMA-friendly allocation; without one
+        # they are plain np.empty — bit-for-bit the same to every consumer.
+        if slab is not None:
+            offsets, _ = _pinned_slab_layout(spec)
+            self.buffers = {}
+            for name, (shape, dtype) in spec.items():
+                off, size = offsets[name]
+                self.buffers[name] = (slab.array[off:off + size]
+                                      .view(dtype).reshape(shape))
+        else:
+            self.buffers = {name: np.empty(shape, dtype)
+                            for name, (shape, dtype) in spec.items()}
+        self._slab = slab   # keeps the mapping alive while buffers exist
+        self.pinned = slab is not None
         self._pool = pool
         self._lock = threading.Lock()
         self._holds = 0
@@ -259,10 +289,26 @@ class ArenaPool(object):
 
     def __init__(self, depth, stop_event=None, grow_timeout_s=0.5,
                  tracer=None, meter=None, meter_stage='assemble',
-                 heartbeat=None):
+                 heartbeat=None, pinned=None):
         if depth < 1:
             raise ValueError('ArenaPool depth must be >= 1, got {}'.format(depth))
         self._depth = depth
+        # Pinned (DMA-friendly) allocation mode: new arenas carve their
+        # buffers out of page-aligned, pre-faulted, best-effort-mlocked
+        # slabs (petastorm_tpu.native.pinned). None resolves the
+        # PETASTORM_TPU_PINNED_ARENAS env ('1' arms it); allocation
+        # failure falls back to np.empty per arena, so the mode can never
+        # wedge a pipeline. set_pinned() retargets live (autotune toggle;
+        # the governor's advisory rung unpins growth — mlocked pages are
+        # exactly the ones the kernel cannot reclaim under pressure).
+        if pinned is None:
+            import os
+            pinned = os.environ.get('PETASTORM_TPU_PINNED_ARENAS', '') == '1'
+        self._pinned = bool(pinned)
+        self._pinned_bytes = 0
+        self._pinned_locked = 0
+        self._pinned_mode = None
+        self._pinned_fallback_logged = False
         self._stop = stop_event if stop_event is not None else threading.Event()
         self._grow_timeout_s = grow_timeout_s
         # Health hookup: while the assembler is parked waiting for an arena
@@ -299,6 +345,10 @@ class ArenaPool(object):
         self._m_wait = metrics_mod.histogram(
             'pst_arena_wait_seconds',
             'Assembler blocked time per arena acquisition (backpressure)')
+        self._m_pinned = metrics_mod.gauge(
+            'pst_arena_pinned_bytes',
+            'Host bytes in live pinned (page-aligned/mlocked) arena slabs '
+            'across all pools (inc/dec per slab lifetime)')
 
     def _matches(self, spec):
         if self._spec is None:
@@ -326,7 +376,7 @@ class ArenaPool(object):
                     self._reuse += 1
                     break
                 if self._allocated < self._depth or waited >= self._grow_timeout_s:
-                    arena = HostArena(self, self._spec)
+                    arena = self._new_arena()
                     self._allocated += 1
                     self._alloc += 1
                     # Growth is STICKY: depth tracks the high-water mark so
@@ -364,6 +414,66 @@ class ArenaPool(object):
             self._pending = arena
             self._tracer.counter('arena_pool_free', len(self._free), 'staging')
             return arena.borrowed_buffers()
+
+    def _new_arena(self):
+        """One arena in the pool's current allocation mode (called with
+        the pool condition held). Pinned mode carves the buffers out of a
+        DMA-friendly slab; any slab failure (no native tier, mmap limit,
+        RLIMIT) falls back to a plain arena — logged once, never raised."""
+        slab = None
+        if self._pinned:
+            try:
+                from petastorm_tpu.native import pinned as pinned_mod
+                _, total = _pinned_slab_layout(self._spec)
+                slab = pinned_mod.allocate(total, lock=True)
+            except Exception:  # noqa: BLE001 - pinned mode is best-effort
+                slab = None
+            if slab is None and not self._pinned_fallback_logged:
+                self._pinned_fallback_logged = True
+                logger.warning('pinned arena allocation unavailable; '
+                               'falling back to unpinned host buffers')
+        arena = HostArena(self, self._spec, slab=slab)
+        if slab is not None:
+            self._pinned_bytes += slab.nbytes
+            self._pinned_mode = slab.mode
+            if slab.locked:
+                self._pinned_locked += 1
+            self._m_pinned.inc(slab.nbytes)
+            # The condition's lock is an RLock, so the finalizer (run at
+            # GC time on an arbitrary thread, possibly mid-critical-
+            # section) can re-enter safely — same contract _drop_hold
+            # already relies on.
+            weakref.finalize(arena, self._drop_pinned,
+                             slab.nbytes, slab.locked)
+        return arena
+
+    def _drop_pinned(self, nbytes, locked):
+        with self._cond:
+            self._pinned_bytes -= nbytes
+            if locked:
+                self._pinned_locked -= 1
+        self._m_pinned.inc(-nbytes)
+
+    def set_pinned(self, enabled):
+        """Toggle pinned allocation for arenas allocated from now on
+        (autotune pinned-arena knob; the loader's governor advisory also
+        drops it). Existing arenas keep their slabs — they drain as the
+        working set cycles through ``set_depth``-style replacement."""
+        with self._cond:
+            self._pinned = bool(enabled)
+
+    @property
+    def pinned(self):
+        with self._cond:
+            return self._pinned
+
+    @property
+    def pinned_nbytes(self):
+        """Bytes in live pinned slabs (page-padded actual mapping sizes;
+        the membudget ``arena-pool`` pool already counts these buffers —
+        this is the mlock-exposure view, not extra memory)."""
+        with self._cond:
+            return self._pinned_bytes
 
     def claim_pending(self):
         """The arena handed out by the latest ``get_buffers`` call (or
@@ -470,6 +580,10 @@ class ArenaPool(object):
                     'arena_wait_s': round(self._wait_s, 4),
                     'arena_depth': self._depth,
                     'arena_allocated': self._allocated,
+                    'arena_pinned': self._pinned,
+                    'arena_pinned_bytes': self._pinned_bytes,
+                    'arena_pinned_locked': self._pinned_locked,
+                    'arena_pinned_mode': self._pinned_mode or 'off',
                     # Context for watchdog diagnoses: a wait can only
                     # outlive this before growth relieves it, so a pool
                     # that CAN grow shows wedges as climbing arena_alloc
@@ -501,6 +615,13 @@ class OverlapMeter(object):
         self._overlap_s = 0.0
         self._base_busy = {}
         self._base_overlap = 0.0
+        # Spans currently open ({token: (name, t0)}): stats() credits
+        # their elapsed time live. With fence pipelining the stager's
+        # 'h2d' span is open whenever any stream window holds a transfer
+        # — i.e. ~always in steady state — so exit-only accounting would
+        # chronically report busy_s['h2d'] = 0 and overlap_frac = 0.0 at
+        # every mid-stream stats read.
+        self._live = {}
 
     def _transition(self, delta):
         now = time.perf_counter()
@@ -510,15 +631,30 @@ class OverlapMeter(object):
         self._mark = now
         return now
 
+    def _busy_snapshot(self, now):
+        busy = dict(self._busy)
+        for name, t0 in self._live.values():
+            busy[name] = busy.get(name, 0.0) + (now - t0)
+        return busy
+
+    def _overlap_snapshot(self, now):
+        overlap = self._overlap_s
+        if self._active >= 2 and self._mark is not None:
+            overlap += now - self._mark
+        return overlap
+
     @contextmanager
     def track(self, name):
+        token = object()
         with self._lock:
             t0 = self._transition(+1)
+            self._live[token] = (name, t0)
         try:
             yield
         finally:
             with self._lock:
                 t1 = self._transition(-1)
+                self._live.pop(token, None)
                 self._busy[name] = self._busy.get(name, 0.0) + (t1 - t0)
 
     @contextmanager
@@ -544,8 +680,9 @@ class OverlapMeter(object):
 
     def stats(self, total=False):
         with self._lock:
-            busy = dict(self._busy)
-            overlap = self._overlap_s
+            now = time.perf_counter()
+            busy = self._busy_snapshot(now)
+            overlap = self._overlap_snapshot(now)
             if not total:
                 busy = {k: v - self._base_busy.get(k, 0.0)
                         for k, v in busy.items()}
@@ -556,10 +693,13 @@ class OverlapMeter(object):
 
     def reset(self):
         """Start a new window; lifetime totals (``stats(total=True)``)
-        keep accumulating."""
+        keep accumulating. Spans open across the reset contribute only
+        their post-reset elapsed time to the new window (their
+        elapsed-so-far is folded into the base)."""
         with self._lock:
-            self._base_busy = dict(self._busy)
-            self._base_overlap = self._overlap_s
+            now = time.perf_counter()
+            self._base_busy = self._busy_snapshot(now)
+            self._base_overlap = self._overlap_snapshot(now)
 
 
 class MeteredReader(object):
@@ -642,13 +782,24 @@ class DeviceStager(object):
     """
 
     def __init__(self, stream_keys, put_fn, inflight=2, ready_fn=None,
-                 stop_event=None, tracer=None):
+                 stop_event=None, tracer=None, meter=None):
         self._keys = tuple(str(k) for k in stream_keys)
         if not self._keys:
             raise ValueError('DeviceStager needs at least one stream')
         self._put_fn = put_fn
         self._ready_fn = ready_fn or (lambda staged: None)
         self._inflight = max(1, int(inflight))
+        # Streamed-path overlap measurement: the owner tracks its
+        # host-side staging work as 'host' on this meter; the stager
+        # keeps ONE refcounted 'h2d' span open while ANY stream holds an
+        # unfenced transfer (all streams collapse into one logical h2d
+        # lane — per-stream spans would measure stream-vs-stream
+        # co-activity, not transfer-vs-host overlap). stats() then
+        # reports h2d_overlap_frac for the streamed path, which the
+        # bench's one-shot probe cannot see.
+        self.meter = meter
+        self._h2d_tokens = 0
+        self._h2d_span = None
         self._stop = stop_event if stop_event is not None else threading.Event()
         if tracer is None:
             from petastorm_tpu.trace import NullTracer
@@ -767,31 +918,48 @@ class DeviceStager(object):
                     continue
                 array, donate, slot, results, state, lock, done = item
                 try:
+                    # Fence pipelining: make room at SUBMIT time, not
+                    # after delivery. The window only gives up its oldest
+                    # transfer when a new one is about to take the slot,
+                    # so between waves every slot stays occupied by an
+                    # in-flight transfer — the h2d stream never drains —
+                    # and the fence is frequently free because the oldest
+                    # transfer completed while the stream sat waiting for
+                    # this wave.
+                    while len(window) >= self._inflight:
+                        self._retire_oldest(window, block=True)
+                    # A wave item may account itself (the streamed
+                    # batched-put tier calls record_inline_wave with the
+                    # true per-device breakdown from inside put_fn); the
+                    # stream then only does window/byte bookkeeping.
+                    self_acct = bool(getattr(array, 'pst_self_accounting',
+                                             False))
                     t0 = time.perf_counter()
                     staged = self._put_fn(array, index, donate)
                     dt = time.perf_counter() - t0
                     nbytes = int(getattr(array, 'nbytes', 0))
-                    self._m_put.labels(key).observe(dt)
-                    if donate:
-                        self._m_donated.inc()
-                    with self._stats_lock:
-                        self._put_s[key] += dt
-                        self._put_bytes[key] += nbytes
-                        self._shards_put += 1
+                    if not self_acct:
+                        self._m_put.labels(key).observe(dt)
                         if donate:
-                            self._donated += 1
+                            self._m_donated.inc()
+                    with self._stats_lock:
+                        if not self_acct:
+                            self._put_s[key] += dt
+                            self._put_bytes[key] += nbytes
+                            self._shards_put += 1
+                            if donate:
+                                self._donated += 1
                         self._window_bytes += nbytes
                     window.append((staged, nbytes))
-                    # Deliver BEFORE fencing the window tail: the caller
-                    # stitches (and the assemble thread collates the next
-                    # batch) while this stream pays its backpressure.
+                    self._h2d_enter()
+                    # Deliver immediately: the caller stitches (and the
+                    # assemble thread collates the next batch) while the
+                    # transfers ride the window.
                     with lock:
                         results[slot] = staged
                         state['remaining'] -= 1
                         if state['remaining'] <= 0:
                             done.set()
-                    while len(window) > self._inflight:
-                        self._retire_oldest(window, block=True)
                 except Exception as e:  # noqa: BLE001 - surfaced to the wave
                     with lock:
                         state['error'] = e
@@ -817,6 +985,7 @@ class DeviceStager(object):
             with self._stats_lock:
                 self._ready_wait_s += time.perf_counter() - t0
                 self._window_bytes -= nbytes
+            self._h2d_exit()
             return True
         if not block and not self._stop.is_set():
             try:
@@ -827,6 +996,7 @@ class DeviceStager(object):
                 pass
         with self._stats_lock:
             self._window_bytes -= nbytes
+        self._h2d_exit()
         return True
 
     @staticmethod
@@ -834,14 +1004,39 @@ class DeviceStager(object):
         probe = getattr(staged, 'is_ready', None)
         return True if probe is None else bool(probe())
 
+    # -- streamed-path overlap ---------------------------------------------
+
+    def _h2d_enter(self):
+        """A transfer entered some stream's window: open (or refcount)
+        the single logical 'h2d' span on the stager's meter."""
+        if self.meter is None:
+            return
+        with self._stats_lock:
+            self._h2d_tokens += 1
+            if self._h2d_tokens == 1:
+                self._h2d_span = self.meter.track('h2d')
+                self._h2d_span.__enter__()
+
+    def _h2d_exit(self):
+        """A transfer retired; close the 'h2d' span when no stream holds
+        an unfenced transfer any more."""
+        if self.meter is None:
+            return
+        with self._stats_lock:
+            self._h2d_tokens -= 1
+            if self._h2d_tokens == 0 and self._h2d_span is not None:
+                span, self._h2d_span = self._h2d_span, None
+                span.__exit__(None, None, None)
+
     def record_inline_wave(self, stream_indices, nbytes_list, elapsed,
                            donate):
-        """Account a wave the owner issued INLINE (one batched per-device
-        transfer on its own thread — the small-shard fast tier) so
-        per-device put seconds/bytes and donation counts stay coherent
-        across tiers. Issue time is attributed evenly across the wave's
-        shards (the batched call is one C++ fan-out; per-shard splits are
-        not observable)."""
+        """Account one batched per-device wave — issued inline on the
+        owner's thread (the small-shard fast tier) or from a stream
+        thread as a self-accounting wave item (the streamed-batched
+        tier) — so per-device put seconds/bytes and donation counts
+        stay coherent across tiers. Issue time is attributed evenly
+        across the wave's shards (the batched call is one C++ fan-out;
+        per-shard splits are not observable)."""
         count = max(1, len(stream_indices))
         per_shard = elapsed / count
         for index, nbytes in zip(stream_indices, nbytes_list):
@@ -862,9 +1057,9 @@ class DeviceStager(object):
 
     def set_inflight(self, n):
         """Retarget the per-stream in-flight window (the autotune
-        ``device_inflight`` knob): each stream re-reads it per shard, so
-        widening takes effect on the next put and narrowing drains by
-        fencing the oldest transfers."""
+        ``device_inflight`` knob): each stream re-reads it at submit
+        time, so widening takes effect on the next put and narrowing
+        fences the excess oldest transfers before the next one issues."""
         self._inflight = max(1, int(n))
 
     @property
@@ -889,8 +1084,10 @@ class DeviceStager(object):
             return self._window_bytes
 
     def stats(self):
+        # Meter first (its own lock) so nothing nests under _stats_lock.
+        overlap = self.meter.stats() if self.meter is not None else None
         with self._stats_lock:
-            return {
+            out = {
                 'n_devices': len(self._keys),
                 'device_inflight': self._inflight,
                 'shards_put': self._shards_put,
@@ -900,8 +1097,17 @@ class DeviceStager(object):
                                  for k, v in self._put_s.items()},
                 'device_put_bytes': dict(self._put_bytes),
                 'leaked_threads': list(self._leaked_threads)}
+        if overlap is not None:
+            # The streamed-path measurement the bench's one-shot probe
+            # cannot see: 'h2d' (any transfer unfenced in a window) vs
+            # 'host' (the owner's staging work) co-activity.
+            out['h2d_overlap'] = overlap
+            out['h2d_overlap_frac'] = overlap['overlap_frac']
+        return out
 
     def reset_stats(self):
+        if self.meter is not None:
+            self.meter.reset()
         with self._stats_lock:
             self._put_s = {k: 0.0 for k in self._keys}
             self._put_bytes = {k: 0 for k in self._keys}

@@ -1,17 +1,25 @@
 """Device-resident dataset cache (``petastorm_tpu/device_cache.py``):
-epoch 0 streams-and-caches, later epochs run from device memory with a
-jitted on-device reshuffle.
+epoch 0 streams-and-caches incrementally in superbatch units, later
+epochs run from device memory with a jitted on-device reshuffle, and
+partial mode keeps the hottest superbatches under an armed memory
+governor while streaming the remainder.
 """
+
+import zlib
 
 import numpy as np
 import pytest
 
 import jax
 
-from petastorm_tpu import make_tensor_reader
+from petastorm_tpu import make_tensor_reader, membudget
 from petastorm_tpu.device_cache import DeviceCacheOverflow, DeviceDatasetCache
 from petastorm_tpu.jax_loader import JaxLoader
+from petastorm_tpu.membudget import (GovernorConfig, MemoryGovernor,
+                                     STATE_ADVISORY, STATE_DEGRADE, STATE_OK)
 from petastorm_tpu.parallel import make_mesh
+
+pytestmark = pytest.mark.devicecache
 
 N_ROWS = 48
 BATCH = 8
@@ -135,6 +143,132 @@ def test_abandoned_caching_epoch_refuses_restart(cache_dataset):
         next(it)  # abandon mid-stream
         with pytest.raises(RuntimeError, match='abandoned mid-stream'):
             next(iter(cache.epoch(1)))
+
+
+def _factory(url):
+    """Zero-arg loader_factory: replays the SAME deterministic pass
+    (single worker, fixed seed) the cache was filled from."""
+    def _gen():
+        reader = make_tensor_reader(url, num_epochs=1, seed=0,
+                                    reader_pool_type='thread',
+                                    workers_count=1)
+        with reader:
+            with JaxLoader(reader, BATCH, last_batch='drop') as loader:
+                for batch in loader:
+                    yield batch
+    return _gen
+
+
+def _digests(batches):
+    return [tuple(zlib.crc32(np.asarray(getattr(b, f)).tobytes())
+                  for f in b._fields) for b in batches]
+
+
+def test_partial_mode_streams_past_budget_without_overflow(cache_dataset):
+    # 128 B/batch per device (vec 96 + sid 32 -- x64 off); a 300 B
+    # budget caps the cache at 2 batches, the remaining 4 stream every
+    # epoch.
+    reader, loader, cache = _make_cache(cache_dataset, workers=1,
+                                        shuffle=False, partial=True,
+                                        max_bytes=300, superbatch_batches=2,
+                                        loader_factory=_factory(cache_dataset))
+    with reader, loader:
+        e0 = list(cache.epoch(0))   # must NOT raise DeviceCacheOverflow
+        st = loader.stats['device_cache']
+    assert st['partial'] and st['fill_stopped'] and st['materialized']
+    assert st['cached_batches'] == 2
+    assert st['total_batches'] == N_ROWS // BATCH
+    assert 0 < st['nbytes'] <= 300
+    e1 = list(cache.epoch(1))
+    assert sorted(_epoch_ids(e1)) == sorted(_epoch_ids(e0))
+    assert cache.stats()['hits'] == 2   # the resident run served from HBM
+    cache.clear()
+
+
+def test_partial_mode_bit_identical_vs_streamed(cache_dataset):
+    reference = _digests(list(_factory(cache_dataset)()))
+    reader, loader, cache = _make_cache(cache_dataset, workers=1,
+                                        shuffle=False, partial=True,
+                                        max_bytes=300, superbatch_batches=2,
+                                        loader_factory=_factory(cache_dataset))
+    with reader, loader:
+        assert _digests(list(cache.epoch(0))) == reference
+    # HBM-resident + streamed-remainder merge reproduces the streamed
+    # pass byte for byte.
+    assert _digests(list(cache.epoch(1))) == reference
+    # Live eviction (the governor's degrade action) must not change the
+    # stream: evicted indices fall back to the source pass.
+    assert cache._evict_coldest()
+    assert cache.stats()['superbatches'] == 0
+    assert _digests(list(cache.epoch(2))) == reference
+    cache.clear()
+
+
+def test_governor_degrade_evicts_coldest_superbatch(cache_dataset):
+    previous = membudget.get_governor()
+    gov = MemoryGovernor(budget=1_000_000, config=GovernorConfig())
+    gov._arm_count += 1     # arm without the sampler thread
+    membudget.set_governor(gov)   # BEFORE the cache registers its pool
+    try:
+        reader, loader, cache = _make_cache(
+            cache_dataset, workers=1, shuffle=False, partial=True,
+            max_bytes=10**9, superbatch_batches=2,
+            loader_factory=_factory(cache_dataset))
+        with reader, loader:
+            list(cache.epoch(0))
+        e1 = list(cache.epoch(1))   # heats superbatches in start order
+        assert cache.stats()['superbatches'] == 3
+        ballast = gov.register_pool('ballast', lambda: 860_000)
+        # 860k ballast + ~1k cache bytes of the 1M budget -> degrade rung;
+        # the tick runs the device-cache degrade hook once.
+        assert gov.check() == STATE_DEGRADE
+        st = cache.stats()
+        assert st['evictions'] == 1 and st['superbatches'] == 2
+        # Coldest by (last_hit, start): epoch 1 visited starts 0,2,4 in
+        # order, so the start-0 run is least-recently hit.
+        assert sorted(sb.start for sb in cache._superbatches) == [2, 4]
+        # The epoch stays complete under the eviction.
+        e2 = list(cache.epoch(2))
+        assert sorted(_epoch_ids(e2)) == sorted(_epoch_ids(e1))
+        ballast.close()
+        cache.clear()
+    finally:
+        while gov._arm_count > 0:
+            gov.release()
+        membudget.set_governor(previous)
+
+
+def test_governor_advisory_pauses_fill(cache_dataset):
+    previous = membudget.get_governor()
+    gov = MemoryGovernor(budget=1_000_000, config=GovernorConfig())
+    gov._arm_count += 1
+    membudget.set_governor(gov)
+    try:
+        ballast = gov.register_pool('ballast', lambda: 750_000)
+        assert gov.check() == STATE_ADVISORY
+        # A pool registered mid-episode joins the advisory toggle at
+        # registration: the cache starts with fill paused.
+        reader, loader, cache = _make_cache(
+            cache_dataset, workers=1, shuffle=False, partial=True,
+            max_bytes=10**9, superbatch_batches=2,
+            loader_factory=_factory(cache_dataset))
+        assert cache.stats()['fill_paused']
+        with reader, loader:
+            e0 = list(cache.epoch(0))   # completes, caching nothing
+        st = cache.stats()
+        assert st['materialized'] and st['cached_batches'] == 0
+        assert st['nbytes'] == 0 and not st['fill_stopped']
+        # Pressure relief unpauses; epochs keep streaming the full pass.
+        ballast.close()
+        assert gov.check() == STATE_OK
+        assert not cache.stats()['fill_paused']
+        e1 = list(cache.epoch(1))
+        assert sorted(_epoch_ids(e1)) == sorted(_epoch_ids(e0))
+        cache.clear()
+    finally:
+        while gov._arm_count > 0:
+            gov.release()
+        membudget.set_governor(previous)
 
 
 def test_ragged_final_batch_rejected(cache_dataset):

@@ -448,3 +448,170 @@ def test_loader_engine_survives_worker_kill(synthetic_dataset, tmp_path,
 def test_staging_aliases_host_probe_runs():
     import jax
     assert staging_aliases_host(jax) in (True, False)
+
+# ---------------------------------------------------------------------------
+# pinned (DMA-friendly) arenas
+# ---------------------------------------------------------------------------
+
+def test_pinned_slab_layout_page_aligned():
+    from petastorm_tpu.staging import PINNED_FIELD_ALIGN, _pinned_slab_layout
+    offsets, total = _pinned_slab_layout(_spec(batch=4, width=3))
+    assert all(off % PINNED_FIELD_ALIGN == 0 for off, _ in offsets.values())
+    assert offsets['x'][1] == 4 * 3 * 4 and offsets['y'][1] == 4 * 4
+    assert total % PINNED_FIELD_ALIGN == 0
+    assert total >= sum(size for _, size in offsets.values())
+
+
+def test_pinned_pool_carves_aligned_buffers_and_accounts():
+    from petastorm_tpu.staging import PINNED_FIELD_ALIGN
+    pool = ArenaPool(depth=1, pinned=True)
+    bufs = pool.get_buffers(_spec())
+    assert bufs is not None and set(bufs) == {'x', 'y'}
+    arena = pool.claim_pending()
+    assert arena is not None
+    stats = pool.stats()
+    if stats['arena_pinned_bytes'] == 0:
+        pytest.skip('pinned allocation unavailable on this host')
+    assert stats['arena_pinned'] is True
+    assert stats['arena_pinned_mode'] in ('native', 'mmap')
+    # Every field starts on its own page — the transfer granularity DMA
+    # engines and mlock both work in.
+    for buf in bufs.values():
+        assert buf.__array_interface__['data'][0] % PINNED_FIELD_ALIGN == 0
+    # To consumers the buffers behave exactly like np.empty arenas.
+    bufs['x'][:] = 7.0
+    np.testing.assert_array_equal(
+        np.asarray(bufs['x']), np.full((4, 3), 7.0, np.float32))
+    # Finalizer accounting: the slab's bytes leave the gauge when the
+    # arena DIES, not when it recycles.
+    assert pool.pinned_nbytes > 0
+    del bufs, arena
+    gc.collect()
+    assert pool.pinned_nbytes == 0
+
+
+def test_pinned_allocation_failure_falls_back(monkeypatch):
+    from petastorm_tpu.native import pinned as pinned_mod
+    monkeypatch.setattr(pinned_mod, 'allocate',
+                        lambda nbytes, lock=True: None)
+    pool = ArenaPool(depth=1, pinned=True)
+    bufs = pool.get_buffers(_spec())
+    assert bufs is not None and set(bufs) == {'x', 'y'}
+    stats = pool.stats()
+    assert stats['arena_pinned'] is True       # the mode stays armed...
+    assert stats['arena_pinned_bytes'] == 0    # ...but nothing is pinned
+    assert stats['arena_pinned_mode'] == 'off'
+    pool.claim_pending().retire()
+
+
+def test_pinned_env_default_and_live_toggle(monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_PINNED_ARENAS', '1')
+    pool = ArenaPool(depth=1)
+    assert pool.pinned                         # env arms the default
+    pool.set_pinned(False)                     # autotune/advisory toggle
+    assert pool.get_buffers(_spec()) is not None
+    assert pool.claim_pending() is not None
+    assert pool.stats()['arena_pinned_bytes'] == 0
+    monkeypatch.delenv('PETASTORM_TPU_PINNED_ARENAS')
+    assert not ArenaPool(depth=1).pinned
+
+
+# ---------------------------------------------------------------------------
+# DeviceStager fence pipelining (jax-free: fake put/ready functions)
+# ---------------------------------------------------------------------------
+
+class _FakeShard(object):
+    nbytes = 10
+
+    def __init__(self, tag):
+        self.tag = tag
+
+
+class _FakeStaged(object):
+    def __init__(self, tag):
+        self.tag = tag
+        self.ready = False
+
+    def is_ready(self):
+        return self.ready
+
+
+def _fence_stager(inflight, fences, staged_out, put_hook=None):
+    from petastorm_tpu.staging import DeviceStager
+
+    def put_fn(array, stream, donate):
+        if put_hook is not None:
+            put_hook()
+        staged = _FakeStaged(array.tag)
+        staged_out.append(staged)
+        return staged
+
+    return DeviceStager(['d0'], put_fn, inflight=inflight,
+                        ready_fn=lambda staged: fences.append(staged.tag))
+
+
+def test_fence_pipelining_window_never_drains():
+    """The window fences its OLDEST transfer only when full at submit
+    time: between waves every slot stays occupied by an in-flight
+    transfer (the h2d stream never drains), fences run FIFO, and idle
+    retirement only collects transfers that report ready."""
+    fences, staged = [], []
+    st = _fence_stager(2, fences, staged)
+    try:
+        for i in range(5):
+            st.put_shards([(0, _FakeShard('s{}'.format(i)), False)])
+            if i >= 1:
+                # Not a drained stream: both slots in flight between waves.
+                assert st.window_nbytes == 2 * _FakeShard.nbytes
+        assert fences == ['s0', 's1', 's2']
+        # Nothing reports ready, so the idle loop must not shrink the
+        # window behind the fence discipline's back.
+        time.sleep(0.3)
+        assert st.window_nbytes == 2 * _FakeShard.nbytes
+        # Transfers completing in the background retire WITHOUT a fence.
+        for s in staged:
+            s.ready = True
+        deadline = time.time() + 5
+        while st.window_nbytes and time.time() < deadline:
+            time.sleep(0.01)
+        assert st.window_nbytes == 0
+        assert fences == ['s0', 's1', 's2']
+    finally:
+        st.stop()
+    assert not any(t.name.startswith('pst-device-put-')
+                   for t in threading.enumerate() if t.is_alive())
+
+
+def test_fence_pipelining_under_device_put_delay(monkeypatch):
+    """The device-put-delay fault site slows every transfer; the window
+    discipline holds regardless — puts keep issuing behind a full
+    window and the fence order stays FIFO."""
+    from petastorm_tpu import faults
+    monkeypatch.setenv(faults.ENV_VAR, 'device-put-delay:delay=0.02')
+    fences, staged = [], []
+    st = _fence_stager(1, fences, staged,
+                       put_hook=lambda: faults.maybe_inject(
+                           'device-put-delay'))
+    try:
+        for i in range(4):
+            st.put_shards([(0, _FakeShard('s{}'.format(i)), False)])
+            assert st.window_nbytes == _FakeShard.nbytes
+        assert fences == ['s0', 's1', 's2']
+    finally:
+        st.stop()
+
+
+def test_stager_stop_reclaims_inflight_window_without_fencing():
+    """stop() mid-stream: every in-flight window entry is reclaimed (the
+    byte accounting the arena pool's recycling rides returns to zero)
+    without fencing transfers on a pipeline that is going away, and the
+    stream threads join with nothing leaked."""
+    fences, staged = [], []
+    st = _fence_stager(4, fences, staged)
+    for i in range(3):
+        st.put_shards([(0, _FakeShard('s{}'.format(i)), False)])
+    assert st.window_nbytes == 3 * _FakeShard.nbytes
+    assert st.stop() == []                     # joined; nothing leaked
+    assert st.window_nbytes == 0
+    assert fences == []                        # reclaim, not fence
+    assert not st.alive

@@ -411,7 +411,9 @@ def test_probe_now_single_flight(tmp_path, monkeypatch, capsys):
     bench = _import_bench(monkeypatch)
     art = tmp_path / 'opp.json'
     monkeypatch.setattr(bench, '_OPPORTUNISTIC_PATH', str(art))
-    holder = open(str(art) + '.probe_lock', 'w')
+    # The lock lives in the tempdir keyed by the artifact path (a repo-
+    # root lock file would get committed by accident).
+    holder = open(bench._probe_lock_path(), 'w')
     fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
     try:
         assert bench.probe_now(2, [1]) == 0      # benign skip
