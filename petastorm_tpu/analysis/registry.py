@@ -140,6 +140,18 @@ THREAD_GUARDS = (
         'its deadline.',
         marker='fleet', action='fail'),
     ThreadGuard(
+        'pst-fleet-registry', 'petastorm_tpu.fleet.registry',
+        'FleetRegistry.watch() SUB loop folding worker heartbeats into '
+        'membership; stop() joins. A leak keeps a SUB socket connected '
+        'to workers that the test already tore down.',
+        marker='fleet', action='fail'),
+    ThreadGuard(
+        'pst-fleet-autoscaler', 'petastorm_tpu.fleet.autoscaler',
+        'FleetAutoscaler.start() control loop (and its bounded announce '
+        'readers); stop() joins. A leaked loop keeps launching/draining '
+        'workers for a fleet whose test is over.',
+        marker='fleet', action='fail'),
+    ThreadGuard(
         'pst-pool-worker', 'petastorm_tpu.workers.thread_pool',
         'Daemon pool workers joined by ThreadPool.join(); retirement '
         'between items is the resize contract, tested in '

@@ -70,6 +70,23 @@ INPUT_BOUND = 'input-bound'     # consumer waits but no stage blames a wait:
 BALANCED = 'balanced'
 
 
+def active_bottleneck_classes(snapshot):
+    """Read the ``pst_autotune_bottleneck`` enum gauge out of a metrics
+    snapshot (one process's ``collect()``, or a fleet aggregate from
+    :func:`petastorm_tpu.metrics.aggregate_snapshots`): ``{pipeline:
+    class}`` for every pipeline whose active class reads >= 1. The
+    shared vocabulary bridge between the in-process tuner and the fleet
+    autoscaler — both sides consume the classification through this one
+    parse instead of re-reading gauge samples by hand."""
+    metric = (snapshot or {}).get('pst_autotune_bottleneck') or {}
+    active = {}
+    for sample in metric.get('samples', ()):
+        if sample.get('value', 0) >= 1:
+            labels = sample.get('labels') or {}
+            active[labels.get('pipeline', '')] = labels.get('class')
+    return active
+
+
 def autotune_enabled(explicit=None):
     """Resolve the ``autotune=`` knob against the environment default.
 

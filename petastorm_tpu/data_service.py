@@ -85,8 +85,6 @@ dropped/refused). The key authenticates; it does not encrypt — for
 untrusted networks add CurveZMQ or a TLS tunnel.
 """
 
-import hashlib
-import hmac as hmac_mod
 import logging
 import os
 import pickle
@@ -94,33 +92,37 @@ import struct
 import threading
 import time
 
+from petastorm_tpu.fleet import control_plane
 from petastorm_tpu.utils import cached_namedtuple
 
 logger = logging.getLogger(__name__)
 
 _CTRL_END = b'PST_END'
 _CTRL_ERR = b'PST_ERR'
-#: Lease heartbeat on the control PUB socket: ``PST_HB`` + packed
-#: (server_id, lease_s, state code) + the server's rpc endpoint (utf-8).
-#: A consumer that has seen one heartbeat and then none for ``lease_s``
-#: treats the lease as EXPIRED — the fleet's dead-server signal, replacing
-#: per-tick rpc liveness probes (a dead server cannot renew; a merely slow
-#: one still heartbeats from its control thread).
-_CTRL_HB = b'PST_HB'
-_HB_STRUCT = struct.Struct('<16sdB')    # (server_id, lease_s, state code)
-_STATE_CODES = {'serving': 0, 'draining': 1, 'drained': 2,
-                'awaiting-cursor': 3}
-_STATE_NAMES = {v: k for k, v in _STATE_CODES.items()}
+# Lease heartbeat on the control PUB socket: ``PST_HB`` + packed
+# (server_id, lease_s, state code) + the server's rpc endpoint (utf-8)
+# [+ fleet announce tail]. A consumer that has seen one heartbeat and
+# then none for ``lease_s`` treats the lease as EXPIRED — the fleet's
+# dead-server signal, replacing per-tick rpc liveness probes (a dead
+# server cannot renew; a merely slow one still heartbeats from its
+# control thread). The wire constants, announce codec, admission
+# ledger, and drain state machine are the shared control plane in
+# petastorm_tpu.fleet.control_plane — this module composes it; the
+# aliases keep the wire spellings importable from here.
+_CTRL_HB = control_plane.CTRL_HB
+_HB_STRUCT = control_plane.HB_STRUCT
+_STATE_CODES = control_plane.STATE_CODES
+_STATE_NAMES = control_plane.STATE_NAMES
 _SERVER_ID_LEN = 16
 _COUNT_STRUCT = struct.Struct('<Q')
 _META_STRUCT = struct.Struct('<16sQ')   # (server_id, chunk seq)
-_MAC_LEN = 16
+_MAC_LEN = control_plane.MAC_LEN
 
 #: Server lease duration (seconds): heartbeats go out every third of it,
 #: consumers declare a server dead one full lease after its last
 #: heartbeat. Override per server via ``DataServer(lease_s=)``.
-ENV_LEASE = 'PETASTORM_TPU_LEASE_S'
-DEFAULT_LEASE_S = 10.0
+ENV_LEASE = control_plane.ENV_LEASE
+DEFAULT_LEASE_S = control_plane.DEFAULT_LEASE_S
 #: Sole-consumer reconnect window (seconds): after a server's lease
 #: expires, how long the consumer keeps polling for a replacement (a
 #: restarted or cursor-resumed server) before raising. 0 disables
@@ -128,16 +130,7 @@ DEFAULT_LEASE_S = 10.0
 ENV_RECONNECT = 'PETASTORM_TPU_RECONNECT_S'
 DEFAULT_RECONNECT_S = 60.0
 
-
-def _env_float(var, default):
-    raw = os.environ.get(var, '').strip()
-    if not raw:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        logger.warning('ignoring non-numeric %s=%r', var, raw)
-        return default
+_env_float = control_plane.env_float
 #: After a liveness probe finds an endpoint unreachable (whole rpc retry
 #: budget unanswered), further probes report it dead from memory for this
 #: long instead of re-paying the budget — a watchdog sweeping every tick
@@ -153,19 +146,11 @@ class RpcUnanswered(Exception):
     whole budget is treated as dead (a single dropped REP is just slow)."""
 
 
-def _mac(key, *parts):
-    h = hashlib.blake2b(digest_size=_MAC_LEN, key=key)
-    for p in parts:
-        # Length-framed: without it, moving bytes across a frame boundary
-        # keeps the concatenation (and so the MAC) identical while the
-        # chunk parses differently.
-        h.update(_COUNT_STRUCT.pack(len(p)))
-        h.update(p)
-    return h.digest()
-
-
-def _mac_ok(key, mac, *parts):
-    return hmac_mod.compare_digest(bytes(mac), _mac(key, *parts))
+# Keyed, length-framed chunk/heartbeat MAC — the shared control plane
+# owns the implementation (same framing, same digest size) so the data
+# plane and the fleet registry verify identical bytes.
+_mac = control_plane.mac
+_mac_ok = control_plane.mac_ok
 
 
 def _dump_frames(cols):
@@ -262,7 +247,7 @@ class DataServer(object):
                  snapshot_every=16, snapshot_resume=None,
                  replay_ring_chunks=None, bind_retry_policy=None,
                  lineage=True, lease_s=None, max_consumers=None,
-                 reader_builder=None):
+                 reader_builder=None, job_id=None, tenants=None):
         import zmq
 
         if (reader is None) == (reader_builder is None):
@@ -390,10 +375,18 @@ class DataServer(object):
         else:
             self._server_id = uuid.uuid4().bytes
         # -- fleet control plane: lease, drain, admission, flow control --
-        self._lease_s = float(lease_s if lease_s is not None
-                              else _env_float(ENV_LEASE, DEFAULT_LEASE_S))
+        # Composed from petastorm_tpu.fleet.control_plane — the shared
+        # implementation the lookup tier runs too.
+        self._lease_s = control_plane.resolve_lease_s(lease_s)
         self._max_consumers = (None if max_consumers is None
                                else int(max_consumers))
+        # Fleet membership announce (job id + capacity) riding the
+        # heartbeat tail; None = not a fleet member, tail absent.
+        self._job_id = control_plane.resolve_job_id(job_id)
+        # Tenant isolation (petastorm_tpu.fleet.tenancy.TenantLedger):
+        # attaches carry a 'tenant' and are admitted against per-tenant
+        # quotas before the server-wide checks. None = single-tenant.
+        self._tenants = tenants
         self._m_rejected = metrics_mod.counter(
             'pst_consumers_rejected_total',
             'Consumer attach requests a data-service server refused',
@@ -408,12 +401,13 @@ class DataServer(object):
         self._mem_shed = False
         self._mem_handle = membudget.register_pool(
             'snapshot-ring', self._ring_nbytes, shed_fn=self._set_mem_shed)
-        # Admission ledger: consumer_id -> last renew time. Entries expire
-        # after 3 leases without a renew (the client control thread
-        # re-attaches every lease), so a crashed consumer frees its
-        # admission slot without a detach.
-        self._admission_lock = threading.Lock()
-        self._consumers = {}
+        # Admission ledger (shared control plane): consumer_id -> entry
+        # with a 3-lease expiry (the client control thread re-attaches
+        # every lease), so a crashed consumer frees its admission slot
+        # without a detach. The ledger's lock doubles as the flow-control
+        # lock: admit + credit math must be one atomic decision.
+        self._admission = control_plane.AdmissionLedger(self._lease_s)
+        self._admission_lock = self._admission.lock
         # Aggregate credit pool (credit-based flow control): None until a
         # consumer attaches with a credit grant; afterwards the serve loop
         # sends only while credit remains, so total outstanding chunks are
@@ -422,10 +416,13 @@ class DataServer(object):
         # (a credit-blind consumer would otherwise starve behind it).
         self._credit = None
         self._credit_disabled = False
-        # Drain state machine: serving -> draining (stop admitting, finish
-        # the in-flight chunk, emit the final cursor) -> drained.
-        self._draining = threading.Event()
-        self._drained = threading.Event()
+        # Drain state machine (shared control plane): serving -> draining
+        # (stop admitting, finish the in-flight chunk, emit the final
+        # cursor) -> drained. The events are bound locally so the serve
+        # loop's between-chunk checks stay one attribute read.
+        self._drain_state = control_plane.DrainState()
+        self._draining = self._drain_state.draining
+        self._drained = self._drain_state.drained
         self._final_cursor = None
         # End-of-stream marker handed to the control thread, which owns
         # the PUB socket once start() ran (heartbeats and END broadcasts
@@ -449,6 +446,7 @@ class DataServer(object):
         graceful drain loses zero chunks."""
         from petastorm_tpu import faults
         err_body = None
+        abandoned_tail = False
         try:
             if self._reader is None:
                 # Deferred build (reader_builder / await_cursor): the
@@ -538,11 +536,13 @@ class DataServer(object):
                 seq = self._served_chunks
                 self._ring.append((seq, frames))
                 if not self._send_chunk(seq, frames, count=True):
-                    # Stopped mid-HWM-retry: the reader has advanced past
-                    # this chunk but `sent` has not — a snapshot here
-                    # would be one chunk ahead of its count and a resume
-                    # would reuse this seq for DIFFERENT rows (consumers
-                    # would dedupe them away). Don't snapshot; exit.
+                    # Stopped (or idle-drained) mid-HWM-retry: the reader
+                    # has advanced past this chunk but `sent` has not — a
+                    # snapshot or final cursor here would be one chunk
+                    # ahead of its count and a resume would reuse this seq
+                    # for DIFFERENT rows (consumers would dedupe them
+                    # away). Don't snapshot; exit.
+                    abandoned_tail = not self._stop.is_set()
                     break
                 if (self._snapshot_path is not None
                         and self._served_chunks % self._snapshot_every == 0):
@@ -556,7 +556,7 @@ class DataServer(object):
             if err_body is None:
                 marker = (_CTRL_END + self._server_id
                           + _COUNT_STRUCT.pack(self._served_chunks))
-                if self._snapshot_path is not None:
+                if self._snapshot_path is not None and not abandoned_tail:
                     # Final snapshot: a restart after a clean end re-serves
                     # nothing and re-advertises the full count.
                     try:
@@ -567,7 +567,7 @@ class DataServer(object):
                 # orchestrator (drain rpc reply / stats) so its stream can
                 # be continued elsewhere exactly where it stopped.
                 state_fn = getattr(self._reader, 'state_dict', None)
-                if state_fn is not None:
+                if state_fn is not None and not abandoned_tail:
                     try:
                         self._final_cursor = state_fn()
                     except Exception:   # noqa: BLE001 - cursor is advisory
@@ -638,6 +638,14 @@ class DataServer(object):
                             self._credit -= 1
                 return True
             except self._zmq.Again:
+                if self._draining.is_set() and self._admission.count() == 0:
+                    # Draining with NO admitted consumer: nobody can take
+                    # this chunk and nobody can lose it — abandon the
+                    # parked send so an idle worker's drain-first release
+                    # completes (the autoscaler's scale-down and the
+                    # worker CLI's SIGTERM path both rely on this)
+                    # instead of wedging in the HWM retry forever.
+                    return False
                 # All consumers at HWM (or none connected yet): wake the
                 # moment one can take the chunk.
                 self._data_sock.poll(50, self._zmq.POLLOUT)
@@ -666,23 +674,22 @@ class DataServer(object):
         """Drain state machine position: ``'awaiting-cursor'`` (deferred
         build, no consumer yet), ``'serving'``, ``'draining'``, or
         ``'drained'``."""
-        if self._drained.is_set():
-            return 'drained'
-        if self._draining.is_set():
-            return 'draining'
-        if self._reader is None:
-            return 'awaiting-cursor'
-        return 'serving'
+        return self._drain_state.state(
+            serving='awaiting-cursor' if self._reader is None
+            else 'serving')
 
     def drain(self, timeout_s=None):
         """Graceful drain: stop admitting consumers, finish the in-flight
         chunk, capture the final stream cursor, broadcast a clean END
         (exact served count — consumers verify zero chunks were lost),
         and let the serve loop exit. Returns True once fully drained
-        (``timeout_s=None`` waits indefinitely; a server parked in an
-        HWM send retry with no consumer drains only when one returns or
-        ``stop()`` cuts it short). Draining a server that already ENDed
-        cleanly reports drained — idempotent for orchestrators."""
+        (``timeout_s=None`` waits indefinitely). A server parked in an
+        HWM send retry with ADMITTED consumers waits for one to take the
+        chunk; parked with none admitted it abandons the unsent (and
+        uncounted) chunk — an idle fleet worker must drain promptly, and
+        with no admitted consumer there is nobody to lose it. Draining a
+        server that already ENDed cleanly reports drained — idempotent
+        for orchestrators."""
         self._draining.set()
         done = self._serving_done.wait(timeout_s)
         if done and (self._end_marker or b'').startswith(_CTRL_END):
@@ -702,46 +709,53 @@ class DataServer(object):
         (the refund is approximate: chunks it had in flight are not
         attributable under PUSH fair-queuing, so the bound loosens by at
         most its unflushed grants rather than tightening forever)."""
-        entry = self._consumers.pop(cid, None)
+        entry = self._admission.release_locked(cid)
         if entry is None:
             return
+        self._refund_entry_locked(cid, entry)
+
+    def _refund_entry_locked(self, cid, entry):
+        """Post-release accounting for one ledger entry: refund its
+        credit grant and free its tenant slot."""
         credits = entry.get('credits') or 0
         if self._credit is not None and not self._credit_disabled:
             self._credit += credits
             if not any(e.get('credits')
-                       for e in self._consumers.values()):
+                       for e in self._admission.entries_locked().values()):
                 # No credit-granting consumer remains: disarm so a stale
                 # deficit can't wedge the serve loop; the next credit
                 # attach re-bases the pool from scratch.
                 self._credit = None
+        if self._tenants is not None and entry.get('tenant') is not None:
+            self._tenants.release(entry['tenant'], cid,
+                                  credits=entry.get('credits') or 0)
 
     def _prune_consumers_locked(self, now):
-        expiry = 3 * self._lease_s
-        for cid in [c for c, e in self._consumers.items()
-                    if now - e['renewed'] > expiry]:
-            self._release_consumer_locked(cid)
+        for cid, entry in self._admission.prune_locked(now):
+            self._refund_entry_locked(cid, entry)
             logger.warning('data server %s: consumer %s admission lease '
                            'expired (no renew in %.0fs)',
-                           self.data_endpoint, cid, expiry)
+                           self.data_endpoint, cid,
+                           self._admission.expiry_leases * self._lease_s)
 
     def _control_loop(self):
         """Owns the control PUB socket (after start()): lease heartbeats
         every ``lease_s / 3``, END/ERR broadcast once the stream is done
         (repeating, for slow joiners), admission-ledger pruning, and
         post-end checkpoint-pause acknowledgement."""
-        hb_interval = max(self._lease_s / 3.0, 0.05)
-        hb_tail = self._rpc_endpoint_bytes()
+        hb_interval = control_plane.heartbeat_interval(self._lease_s)
+        try:
+            rpc_ep = self.rpc_endpoint
+        except Exception:   # noqa: BLE001 - heartbeat must still go out
+            rpc_ep = ''
         next_hb = 0.0
         while not self._stop.is_set():
             now = time.monotonic()
             if now >= next_hb:
-                state = _STATE_CODES.get(self.state, 0)
-                msg = (_CTRL_HB
-                       + _HB_STRUCT.pack(self._server_id, self._lease_s,
-                                         state)
-                       + hb_tail)
-                if self._auth_key is not None:
-                    msg += _mac(self._auth_key, msg)
+                msg = control_plane.pack_heartbeat(
+                    self._server_id, self._lease_s, self.state, rpc_ep,
+                    announce=self._announce_payload(),
+                    auth_key=self._auth_key)
                 self._ctrl_sock.send(msg)
                 with self._admission_lock:
                     self._prune_consumers_locked(now)
@@ -758,11 +772,17 @@ class DataServer(object):
             self._stop.wait(0.05 if marker is not None
                             else min(hb_interval, 0.25))
 
-    def _rpc_endpoint_bytes(self):
-        try:
-            return self.rpc_endpoint.encode('utf-8')
-        except Exception:   # noqa: BLE001 - heartbeat must still go out
-            return b''
+    def _announce_payload(self):
+        """Fleet-membership announce riding the heartbeat tail: job id +
+        capacity (+ data endpoint, so the registry can hand a joiner a
+        complete connect spec). None when not a fleet member — the wire
+        then stays byte-identical to the pre-fleet format."""
+        if self._job_id is None:
+            return None
+        return {'job': self._job_id,
+                'capacity': self._max_consumers,
+                'data': self.data_endpoint,
+                'sent': self._served_chunks}
 
     def _rpc_loop(self):
         """Answer checkpoint/stats requests (REP socket, one at a time)."""
@@ -839,38 +859,56 @@ class DataServer(object):
             # resume cursor — a reader_builder server builds its reader
             # from it (reconnect-with-resume handoff).
             consumer = request.get('consumer') or 'anonymous'
+            tenant = request.get('tenant')
             now = time.monotonic()
             with self._admission_lock:
                 self._prune_consumers_locked(now)
                 state = self.state
-                known = consumer in self._consumers
+                known = self._admission.known_locked(consumer)
                 if state in ('draining', 'drained') and not known:
                     self._m_rejected.labels('draining').inc()
-                    return {'server_id': self._server_id, 'refused': state,
-                            'state': state, 'sent': self._served_chunks}
+                    return control_plane.refusal(
+                        self._server_id, state, state,
+                        sent=self._served_chunks)
                 if (self._max_consumers is not None and not known
-                        and len(self._consumers) >= self._max_consumers):
+                        and self._admission.count_locked()
+                        >= self._max_consumers):
                     self._m_rejected.labels('overloaded').inc()
-                    return {'server_id': self._server_id,
-                            'refused': 'overloaded',
-                            'max_consumers': self._max_consumers,
-                            'state': state}
+                    return control_plane.refusal(
+                        self._server_id,
+                        control_plane.REFUSED_OVERLOADED, state,
+                        max_consumers=self._max_consumers)
                 if self._mem_shed and not known:
                     # Memory-governor shed rung: same typed 'overloaded'
                     # refusal consumers already failover/back off on, with
                     # the reason naming the pressure for operators.
                     self._m_rejected.labels('memory-pressure').inc()
-                    return {'server_id': self._server_id,
-                            'refused': 'overloaded',
-                            'reason': 'memory-pressure',
-                            'state': state}
+                    return control_plane.refusal(
+                        self._server_id,
+                        control_plane.REFUSED_OVERLOADED, state,
+                        reason=control_plane.REASON_MEMORY_PRESSURE)
                 credits = int(request.get('credits') or 0)
+                if self._tenants is not None and not known:
+                    # Tenant isolation: quota checks scoped to THIS
+                    # tenant — a noisy neighbor's exhaustion refuses
+                    # its own attaches, never another tenant's. The
+                    # credit grant is clamped to the tenant's partition
+                    # of the flow-control window.
+                    tenant_refusal = self._tenants.admit(
+                        tenant, consumer, server_id=self._server_id,
+                        state=state)
+                    if tenant_refusal is not None:
+                        self._m_rejected.labels(
+                            tenant_refusal.get('reason')
+                            or 'overloaded').inc()
+                        return tenant_refusal
+                    credits = self._tenants.clamp_credits(tenant, credits)
                 if known:
-                    entry = self._consumers[consumer]
-                    entry['renewed'] = now
+                    self._admission.renew_locked(consumer, now)
                 else:
-                    self._consumers[consumer] = {'renewed': now,
-                                                 'credits': credits}
+                    self._admission.admit_locked(consumer, now,
+                                                 credits=credits,
+                                                 tenant=tenant)
                     if credits and not self._credit_disabled:
                         self._credit = (self._credit or 0) + credits
                 # The aggregate gate is sound only while EVERY admitted
@@ -878,9 +916,10 @@ class DataServer(object):
                 # consume credit nobody grants back, so a mixed ledger —
                 # in either attach order — disarms the gate rather than
                 # wedge the fleet.
+                entries = self._admission.entries_locked()
                 if (self._credit is not None and not self._credit_disabled
                         and any(not e.get('credits')
-                                for e in self._consumers.values())):
+                                for e in entries.values())):
                     self._credit_disabled = True
                     logger.warning('credit-blind consumer present beside '
                                    'flow-controlled ones; credit gate '
@@ -895,7 +934,8 @@ class DataServer(object):
                 self._cursor_evt.set()
             return {'server_id': self._server_id, 'state': self.state,
                     'lease_s': self._lease_s, 'sent': self._served_chunks,
-                    'resume': resume}
+                    'resume': resume, 'tenant': tenant,
+                    'credits': credits}
         if cmd == 'detach':
             with self._admission_lock:
                 self._release_consumer_locked(request.get('consumer'))
@@ -962,12 +1002,13 @@ class DataServer(object):
             # readiness (a stale snapshot means a wide replay window).
             snap_sent, snap_at = self._last_snapshot
             with self._admission_lock:
-                n_consumers = len(self._consumers)
+                n_consumers = self._admission.count_locked()
                 credit = self._credit if not self._credit_disabled else None
             return {'server_id': self._server_id,
                     'sent': self._served_chunks,
                     'done': self._serving_done.is_set(),
                     'state': self.state,
+                    'job': self._job_id,
                     'lease_s': self._lease_s,
                     'consumers': n_consumers,
                     'max_consumers': self._max_consumers,
@@ -992,6 +1033,18 @@ class DataServer(object):
             ctx_fn = getattr(self._reader, 'lineage_context', None)
             return {'server_id': self._server_id,
                     'ctx': ctx_fn() if ctx_fn is not None else None}
+        if cmd == 'fleet':
+            # Membership announce over rpc — the same payload the
+            # heartbeat tail carries, for orchestrators (and the fleet
+            # status CLI) that poll instead of subscribing to PUB.
+            reply = {'server_id': self._server_id, 'state': self.state,
+                     'job': self._job_id, 'rpc': self.rpc_endpoint,
+                     'capacity': self._max_consumers,
+                     'consumers': self._admission.count(),
+                     'sent': self._served_chunks}
+            if self._tenants is not None:
+                reply['tenants'] = self._tenants.snapshot()
+            return reply
         if cmd == 'metrics':
             # This server process's full metrics-registry snapshot
             # (petastorm_tpu.metrics — JSON-safe, so the pickle reply is
@@ -1097,7 +1150,8 @@ def serve_dataset(dataset_url, bind, reader_factory=None, start=True,
                   sndhwm=4, auth_key=None, snapshot_path=None,
                   snapshot_every=16, snapshot_resume=None,
                   replay_ring_chunks=None, lineage=True, lease_s=None,
-                  max_consumers=None, await_cursor=False, **reader_kwargs):
+                  max_consumers=None, await_cursor=False, job_id=None,
+                  tenants=None, **reader_kwargs):
     """Convenience: build a tensor reader over ``dataset_url`` and serve it.
 
     Returns the started :class:`DataServer` (context-manage it). Extra
@@ -1144,7 +1198,8 @@ def serve_dataset(dataset_url, bind, reader_factory=None, start=True,
                          snapshot_resume=snapshot_resume,
                          replay_ring_chunks=replay_ring_chunks,
                          lineage=lineage, lease_s=lease_s,
-                         max_consumers=max_consumers)
+                         max_consumers=max_consumers, job_id=job_id,
+                         tenants=tenants)
     if await_cursor:
         def _builder(resume_state=None):
             kwargs = dict(reader_kwargs)
@@ -1258,7 +1313,7 @@ class RemoteReader(object):
                  rcvhwm=4, poll_timeout_s=0.1, shared_stream=False,
                  end_grace_s=5.0, resume_state=None, auth_key=None,
                  rpc_retry_policy=None, admission=True, flow_control=None,
-                 reconnect_s=None, consumer_id=None):
+                 reconnect_s=None, consumer_id=None, tenant=None):
         import zmq
 
         if isinstance(endpoints, str):
@@ -1350,6 +1405,9 @@ class RemoteReader(object):
         import uuid as uuid_mod
         self._data_endpoints = list(endpoints)
         self._consumer_id = consumer_id or uuid_mod.uuid4().hex[:12]
+        # Tenant identity rides every attach: multi-tenant servers admit
+        # and account this consumer against that tenant's quotas.
+        self._tenant = tenant
         self._flow_control = int(flow_control) if flow_control else None
         self._reconnect_s = (float(reconnect_s) if reconnect_s is not None
                              else _env_float(ENV_RECONNECT,
@@ -1435,7 +1493,11 @@ class RemoteReader(object):
         if len(body) < _HB_STRUCT.size:
             return
         sid, lease_s, state_code = _HB_STRUCT.unpack_from(body)
-        rpc_ep = body[_HB_STRUCT.size:].decode('utf-8', 'replace') or None
+        # The tail is rpc endpoint [+ '\n' + fleet announce JSON]; the
+        # reader only needs the endpoint — the announce is the fleet
+        # registry's concern (petastorm_tpu.fleet.registry).
+        rpc_ep, _announce = control_plane.split_hb_tail(
+            body[_HB_STRUCT.size:])
         state = _STATE_NAMES.get(state_code, 'serving')
         now = time.monotonic()
         with self._acct_lock:
@@ -2225,6 +2287,8 @@ class RemoteReader(object):
         if cursor is _MISSING:
             cursor = self.det_cursor(endpoint)
         request = {'cmd': 'attach', 'consumer': self._consumer_id}
+        if self._tenant is not None:
+            request['tenant'] = self._tenant
         if self._flow_control:
             request['credits'] = self._flow_control
         if cursor is not None:
@@ -2422,32 +2486,10 @@ class RemoteReader(object):
         in exactly once (summing identical snapshots would double every
         counter)."""
         from petastorm_tpu import metrics as metrics_mod
-        servers, unreachable = {}, []
-        by_process = {}
-        for endpoint in self._rpc_endpoints:
-            try:
-                reply = self._one_shot_rpc(endpoint, {'cmd': 'metrics'},
-                                           timeout_ms=timeout_ms)
-            except Exception:  # noqa: BLE001 - a dying server mid-scrape
-                # (connection refused, auth failure, garbled reply) must
-                # land in `unreachable`, not abort the whole aggregation.
-                logger.debug('fleet_metrics: %s failed mid-scrape',
-                             endpoint, exc_info=True)
-                reply = None
-            if reply is None or 'error' in reply \
-                    or not isinstance(reply.get('metrics'), dict):
-                unreachable.append(endpoint)
-                continue
-            servers[endpoint] = reply['metrics']
-            # Unknown registry id (None) can't be deduped: keep
-            # per-endpoint.
-            process_key = reply.get('registry_id')
-            by_process[process_key if process_key is not None
-                       else ('endpoint', endpoint)] = reply['metrics']
-        return {'servers': servers,
-                'aggregate': metrics_mod.aggregate_snapshots(
-                    by_process.values()),
-                'unreachable': unreachable}
+        return metrics_mod.scrape_fleet_metrics(
+            self._rpc_endpoints,
+            lambda ep: self._one_shot_rpc(ep, {'cmd': 'metrics'},
+                                          timeout_ms=timeout_ms))
 
     def _health_probe(self):
         """Watchdog probe: runs only while SOME stage looks stalled (any

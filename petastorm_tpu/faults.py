@@ -97,6 +97,24 @@ Sites and their effects when they fire:
                      the next heartbeat) but no read may fail — flapping
                      liveness signals are a routing hint, never an
                      error. Consumed via ``should_fire``.
+``fleet-worker-kill`` ``SIGKILL`` a preprocessing-fleet worker right after
+                     it announces itself (``tools/fleet.py --worker``) —
+                     the autoscaler's "spawn died mid-scale-up" drill:
+                     the registry never sees a heartbeat, the grace
+                     timer reaps the handle, and a later tick retries.
+                     Pair with ``token=`` to kill one worker of a fleet.
+``registry-blackhole`` drop every heartbeat at the fleet registry's ingest
+                     (``fleet/registry.py``) — the "registry lost sight
+                     of the fleet" drill: members age out of membership,
+                     but in-flight drains must still complete zero-loss
+                     because drain completion is an orchestrator-to-
+                     worker rpc, never registry state. Consumed via
+                     ``should_fire``.
+``scale-race``       sleep ``delay`` seconds between the autoscaler's
+                     decision and its action (``fleet/autoscaler.py``),
+                     stretching the observe->act window so chaos tests
+                     can race membership changes (a kill, a join)
+                     against an already-made scaling decision.
 ==================== ======================================================
 
 Params (all optional):
@@ -155,11 +173,14 @@ KNOWN_SITES = (
     'mem-pressure',
     'partition-lost',
     'hb-flap',
+    'fleet-worker-kill',
+    'registry-blackhole',
+    'scale-race',
 )
 
 #: Sites whose effect is a sleep rather than an error.
 _DELAY_SITES = ('fs-read-delay', 'queue-stall', 'device-put-delay',
-                'server-slow')
+                'server-slow', 'scale-race')
 
 _DEFAULT_DELAY_S = 0.05
 
@@ -325,7 +346,7 @@ class FaultInjector(object):
                            site, key, spec.delay_s)
             time.sleep(spec.delay_s)
             return
-        if site in ('worker-kill', 'server-kill'):
+        if site in ('worker-kill', 'server-kill', 'fleet-worker-kill'):
             logger.warning('fault injection: %s SIGKILLing pid %d',
                            site, os.getpid())
             import signal

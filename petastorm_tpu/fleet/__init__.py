@@ -1,0 +1,21 @@
+"""petastorm_tpu.fleet: the multi-tenant preprocessing-fleet layer.
+
+The tf.data-service papers' disaggregated input tier, built from the
+repo's own primitives:
+
+* :mod:`~petastorm_tpu.fleet.control_plane` — the ONE implementation of
+  leases, admission, drain, and typed refusals that the data plane and
+  the lookup tier both compose (previously three near-copies).
+* :mod:`~petastorm_tpu.fleet.registry` — soft-state membership built
+  from the heartbeat stream: per-job worker sets, 3-lease expiry,
+  restart-rebuildable, no persistent store.
+* :mod:`~petastorm_tpu.fleet.tenancy` — per-tenant credit partitions,
+  membudget sub-pools, and SLO metrics so one noisy job is capped
+  instead of starving its neighbors.
+* :mod:`~petastorm_tpu.fleet.autoscaler` — the drain-first control
+  loop that grows and shrinks a job's worker set from its own
+  bottleneck telemetry.
+
+Import the submodules directly; this package intentionally re-exports
+nothing so that ``import petastorm_tpu.fleet`` stays free of zmq.
+"""

@@ -425,6 +425,56 @@ def aggregate_snapshots(snapshots):
     return merged
 
 
+def scrape_fleet_metrics(endpoints, scrape_one, server_value='metrics',
+                         unreachable_detail=False):
+    """The one fleet-metrics scrape both service clients call
+    (``RemoteReader.fleet_metrics`` on the data plane,
+    ``LookupClient.fleet_metrics`` on the lookup tier — previously two
+    drifting copies of the same dedupe).
+
+    ``scrape_one(endpoint)`` performs one ``metrics`` rpc and returns
+    the reply dict (or raises); replies are deduped on the process
+    registry uuid (co-located servers share one registry — summing
+    identical snapshots would double every counter) and folded through
+    :func:`aggregate_snapshots`. Endpoints that raise, or reply without
+    a ``metrics`` dict, land in ``unreachable`` instead of aborting the
+    aggregation.
+
+    ``server_value`` picks the per-endpoint shape the caller's API
+    promised: ``'metrics'`` (just the snapshot) or ``'reply'`` (the
+    whole rpc reply). ``unreachable_detail=True`` records
+    ``{'endpoint', 'error'}`` dicts instead of bare endpoints."""
+    servers, unreachable, by_process = {}, [], {}
+
+    def _mark_unreachable(endpoint, error):
+        unreachable.append({'endpoint': endpoint, 'error': error}
+                           if unreachable_detail else endpoint)
+
+    for endpoint in endpoints:
+        try:
+            reply = scrape_one(endpoint)
+        except Exception as e:  # noqa: BLE001 - a dying server mid-scrape
+            # (connection refused, auth failure, garbled reply) must land
+            # in `unreachable`, not abort the whole aggregation.
+            logger.debug('fleet metrics scrape: %s failed', endpoint,
+                         exc_info=True)
+            _mark_unreachable(endpoint, repr(e))
+            continue
+        if not isinstance(reply, dict) or 'error' in reply \
+                or not isinstance(reply.get('metrics'), dict):
+            _mark_unreachable(endpoint, repr(reply))
+            continue
+        servers[endpoint] = (reply if server_value == 'reply'
+                             else reply['metrics'])
+        # Unknown registry id (None) can't be deduped: keep per-endpoint.
+        process_key = reply.get('registry_id')
+        by_process[process_key if process_key is not None
+                   else ('endpoint', endpoint)] = reply['metrics']
+    return {'servers': servers,
+            'aggregate': aggregate_snapshots(by_process.values()),
+            'unreachable': unreachable}
+
+
 # --------------------------------------------------------------------------
 # process-wide default registry
 # --------------------------------------------------------------------------

@@ -612,31 +612,11 @@ class LookupClient(object):
         the same shape as ``RemoteReader.fleet_metrics()`` (deduped on
         the process registry id so co-located servers fold once)."""
         from petastorm_tpu import metrics as metrics_mod
-        per_server, unreachable, seen = {}, [], set()
-        for endpoint in self._endpoints_all():
-            try:
-                reply = self._request_one(endpoint,
-                                          {'cmd': 'metrics'},
-                                          timeout_ms)
-            except Exception as e:  # noqa: BLE001 - fold into unreachable
-                unreachable.append({'endpoint': endpoint,
-                                    'error': repr(e)})
-                continue
-            if not isinstance(reply, dict) or 'metrics' not in reply:
-                unreachable.append({'endpoint': endpoint,
-                                    'error': repr(reply)})
-                continue
-            per_server[endpoint] = reply
-        snapshots = []
-        for reply in per_server.values():
-            rid = reply.get('registry_id')
-            if rid is not None and rid in seen:
-                continue
-            seen.add(rid)
-            snapshots.append(reply['metrics'])
-        return {'servers': per_server,
-                'aggregate': metrics_mod.aggregate_snapshots(snapshots),
-                'unreachable': unreachable}
+        return metrics_mod.scrape_fleet_metrics(
+            self._endpoints_all(),
+            lambda ep: self._request_one(ep, {'cmd': 'metrics'},
+                                         timeout_ms),
+            server_value='reply', unreachable_detail=True)
 
     def _request_one(self, endpoint, request, timeout_ms):
         """Single-endpoint rpc (no failover) under the breaker."""

@@ -53,12 +53,16 @@ import threading
 import time
 import uuid
 
+from petastorm_tpu.fleet import control_plane
+
 logger = logging.getLogger(__name__)
 
-#: Control-plane heartbeat prefix (PUB broadcasts, JSON body).
-CTRL_HB = b'PST_LHB'
+#: Control-plane heartbeat prefix (PUB broadcasts, JSON body) — the
+#: shared control plane's JSON dialect; the fleet registry parses both
+#: this and the data plane's binary ``PST_HB``.
+CTRL_HB = control_plane.CTRL_HB_JSON
 
-DEFAULT_LEASE_S = 10.0
+DEFAULT_LEASE_S = control_plane.DEFAULT_LEASE_S
 
 
 def _one_shot(context, endpoint, request, timeout_ms):
@@ -109,13 +113,12 @@ class LookupServer(object):
 
     def __init__(self, engine, bind, control_bind=None, lease_s=None,
                  max_consumers=None, rpc_workers=1, gc_freeze=True,
-                 server_name=None):
+                 server_name=None, job_id=None):
         import zmq
 
         from petastorm_tpu import membudget
         from petastorm_tpu import metrics as metrics_mod
-        from petastorm_tpu.data_service import (ENV_LEASE, _connectable,
-                                                _env_float,
+        from petastorm_tpu.data_service import (_connectable,
                                                 _next_port_endpoint)
 
         self._engine = engine
@@ -127,10 +130,12 @@ class LookupServer(object):
         self.server_name = server_name or 'ls-{}'.format(
             self._server_id[:8])
         self._pmap = None
-        self._lease_s = float(lease_s if lease_s is not None
-                              else _env_float(ENV_LEASE, DEFAULT_LEASE_S))
+        self._lease_s = control_plane.resolve_lease_s(lease_s)
         self._max_consumers = (None if max_consumers is None
                                else int(max_consumers))
+        # Fleet-registry announce: heartbeats carry job + capacity when
+        # this server is a declared member of a preprocessing fleet.
+        self._job_id = control_plane.resolve_job_id(job_id)
         self._rpc_workers = max(1, int(rpc_workers))
         self._gc_freeze = bool(gc_freeze)
         self._gc_frozen = False
@@ -186,10 +191,15 @@ class LookupServer(object):
             'by reason',
             labelnames=('reason',))
 
-        self._lock = threading.Lock()
-        self._consumers = {}           # consumer id -> last renew (monotonic)
-        self._draining = threading.Event()
-        self._drained = threading.Event()
+        # Shared control plane (petastorm_tpu.fleet.control_plane): the
+        # admission ledger's lock doubles as this server's one big lock
+        # (it guarded consumers + inflight + pmap before the extraction;
+        # splitting them would change admission atomicity).
+        self._admission = control_plane.AdmissionLedger(self._lease_s)
+        self._lock = self._admission.lock
+        self._drain_state = control_plane.DrainState()
+        self._draining = self._drain_state.draining
+        self._drained = self._drain_state.drained
         self._stop = threading.Event()
         self._inflight = 0             # requests inside worker handlers
         self._response_bytes = 0       # serialized replies not yet sent
@@ -270,11 +280,7 @@ class LookupServer(object):
 
     @property
     def state(self):
-        if self._drained.is_set():
-            return 'drained'
-        if self._draining.is_set():
-            return 'draining'
-        return 'serving'
+        return self._drain_state.state()
 
     def drain(self, timeout_s=30.0, _inflight_floor=0):
         """Stop admitting, refuse further reads with the typed
@@ -287,9 +293,7 @@ class LookupServer(object):
         in-flight requests finish. ``_inflight_floor`` is the ``drain``
         rpc handler's own request, which is in-flight by definition and
         must not wait on itself."""
-        first = not self._draining.is_set()
-        self._draining.set()
-        if first:
+        if self._drain_state.request():
             self._reassign_on_drain()
         deadline = time.monotonic() + (timeout_s
                                        if timeout_s is not None else 30.0)
@@ -471,7 +475,7 @@ class LookupServer(object):
         plus admission-ledger pruning (3 leases without a renew frees a
         crashed consumer's slot)."""
         from petastorm_tpu import faults
-        hb_interval = max(self._lease_s / 3.0, 0.05)
+        hb_interval = control_plane.heartbeat_interval(self._lease_s)
         while not self._stop.is_set():
             with self._lock:
                 pmap = self._pmap
@@ -480,6 +484,12 @@ class LookupServer(object):
                   'lease_s': self._lease_s,
                   'state': self.state,
                   'rpc': self.rpc_endpoint}
+            if self._job_id is not None:
+                # Fleet announce (same payload the data plane rides on
+                # its binary heartbeat tail): membership for the
+                # registry, capacity for the autoscaler.
+                hb['job'] = self._job_id
+                hb['capacity'] = self._max_consumers
             if pmap is not None:
                 hb['pmap'] = pmap.to_wire()
             body = json.dumps(hb).encode('utf-8')
@@ -489,11 +499,8 @@ class LookupServer(object):
             else:
                 self._ctrl_sock.send(CTRL_HB + body)
             now = time.monotonic()
-            expiry = 3 * self._lease_s
             with self._lock:
-                for cid in [c for c, t in self._consumers.items()
-                            if now - t > expiry]:
-                    del self._consumers[cid]
+                for cid, _entry in self._admission.prune_locked(now):
                     logger.warning('lookup server %s: consumer %s admission '
                                    'lease expired', self.rpc_endpoint, cid)
             self._stop.wait(hb_interval)
@@ -625,7 +632,7 @@ class LookupServer(object):
         consumer = request.get('consumer') or 'anonymous'
         now = time.monotonic()
         with self._lock:
-            known = consumer in self._consumers
+            known = self._admission.known_locked(consumer)
             state = self.state
             if state in ('draining', 'drained'):
                 # Unlike the data plane (which finishes feeding admitted
@@ -633,22 +640,22 @@ class LookupServer(object):
                 # request is standalone, and the typed reply is what makes
                 # the client fail over instead of waiting out a corpse.
                 self._m_rejected.labels('draining').inc()
-                return {'server_id': self._server_id, 'refused': state,
-                        'state': state}
+                return control_plane.refusal(self._server_id, state, state)
             if not known:
                 if self._max_consumers is not None \
-                        and len(self._consumers) >= self._max_consumers:
+                        and self._admission.count_locked() \
+                        >= self._max_consumers:
                     self._m_rejected.labels('overloaded').inc()
-                    return {'server_id': self._server_id,
-                            'refused': 'overloaded',
-                            'max_consumers': self._max_consumers,
-                            'state': state}
+                    return control_plane.refusal(
+                        self._server_id,
+                        control_plane.REFUSED_OVERLOADED, state,
+                        max_consumers=self._max_consumers)
                 if self._mem_shed:
                     self._m_rejected.labels('memory-pressure').inc()
-                    return {'server_id': self._server_id,
-                            'refused': 'overloaded',
-                            'reason': 'memory-pressure',
-                            'state': state}
+                    return control_plane.refusal(
+                        self._server_id,
+                        control_plane.REFUSED_OVERLOADED, state,
+                        reason=control_plane.REASON_MEMORY_PRESSURE)
             partition = request.get('partition')
             if self._mem_shed and partition is not None \
                     and self._pmap is not None \
@@ -662,12 +669,15 @@ class LookupServer(object):
                 # consumers included: shedding must move load, not just
                 # refuse strangers.
                 self._m_rejected.labels('memory-pressure').inc()
-                return {'server_id': self._server_id,
-                        'refused': 'overloaded',
-                        'reason': 'memory-pressure',
-                        'partition': partition,
-                        'state': state}
-            self._consumers[consumer] = now
+                return control_plane.refusal(
+                    self._server_id,
+                    control_plane.REFUSED_OVERLOADED, state,
+                    reason=control_plane.REASON_MEMORY_PRESSURE,
+                    partition=partition)
+            if known:
+                self._admission.renew_locked(consumer, now)
+            else:
+                self._admission.admit_locked(consumer, now)
         return None
 
     def _handle(self, request):
@@ -681,7 +691,7 @@ class LookupServer(object):
                     'lease_s': self._lease_s}
         if cmd == 'detach':
             with self._lock:
-                self._consumers.pop(request.get('consumer'), None)
+                self._admission.release_locked(request.get('consumer'))
             return {'ok': True}
         if cmd == 'lookup':
             refusal = self._admit(request)
@@ -740,7 +750,7 @@ class LookupServer(object):
                     'drained': bool(drained)}
         if cmd == 'stats':
             with self._lock:
-                n_consumers = len(self._consumers)
+                n_consumers = self._admission.count_locked()
                 served = self.requests_served
                 pmap = self._pmap
             return {'server_id': self._server_id,

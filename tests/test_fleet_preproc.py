@@ -1,0 +1,805 @@
+"""Multi-tenant preprocessing fleet (``petastorm_tpu/fleet/``): the
+shared control plane both serving tiers compose, the heartbeat-derived
+membership registry, per-tenant isolation on the admission/credit/
+memory surfaces, and the drain-first autoscaler — chaos-proven against
+the ``fleet-worker-kill`` / ``registry-blackhole`` / ``scale-race``
+fault sites.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.data_service import RemoteReader, serve_dataset
+from petastorm_tpu.fleet import control_plane
+from petastorm_tpu.fleet.autoscaler import (FleetAutoscaler, ScalePolicy,
+                                            SubprocessLauncher,
+                                            WorkerLauncher)
+from petastorm_tpu.fleet.registry import FleetRegistry
+from petastorm_tpu.fleet.tenancy import TenantLedger
+
+pytestmark = pytest.mark.fleet
+
+ROWS = 512
+ROWS_PER_GROUP = 16
+
+#: One copy of the deterministic reader config (mirrors
+#: tests/test_fleet_ft.py): the bit-identical acceptance compares a
+#: fleet run against an unscaled run of the SAME stream.
+DET_KW = dict(num_epochs=1, seed=7, workers_count=2,
+              shuffle_row_groups=True, reader_pool_type='thread',
+              deterministic=True)
+
+
+@pytest.fixture(scope='module')
+def fleet_dataset(tmp_path_factory):
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.writer import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('Preproc', [
+        UnischemaField('vec', np.float32, (1024,), NdarrayCodec(), False),
+        UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    rng = np.random.default_rng(13)
+    url = 'file://' + str(tmp_path_factory.mktemp('preproc') / 'store')
+    write_dataset(url, schema,
+                  ({'vec': rng.standard_normal(1024).astype(np.float32),
+                    'id': i} for i in range(ROWS)),
+                  rows_per_row_group=ROWS_PER_GROUP)
+    return url
+
+
+def _hb(server_id, job=None, state='serving', lease_s=1.0, rpc=None,
+        name=None, capacity=None):
+    announce = None
+    if job is not None:
+        announce = {'job': job}
+        if capacity is not None:
+            announce['capacity'] = capacity
+    return {'server_id': server_id, 'lease_s': lease_s, 'state': state,
+            'rpc': rpc, 'name': name, 'announce': announce}
+
+
+# ---------------------------------------------------------------------------
+# control plane: wire, ledger, drain state (unit)
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_roundtrip_both_dialects():
+    sid = os.urandom(16)
+    # Binary dialect, bare (pre-fleet wire unchanged: no announce tail).
+    msg = control_plane.pack_heartbeat(sid, 2.0, 'serving',
+                                       'tcp://127.0.0.1:9001')
+    hb = control_plane.parse_heartbeat(msg)
+    assert hb['server_id'] == sid.hex()
+    assert hb['state'] == 'serving' and hb['lease_s'] == 2.0
+    assert hb['rpc'] == 'tcp://127.0.0.1:9001' and hb['announce'] is None
+    # With an announce tail + mac.
+    key = b'fleet-secret'
+    msg = control_plane.pack_heartbeat(
+        sid, 2.0, 'draining', 'tcp://127.0.0.1:9001',
+        announce={'job': 'j1', 'capacity': 4}, auth_key=key)
+    hb = control_plane.parse_heartbeat(msg, auth_key=key)
+    assert hb['state'] == 'draining'
+    assert hb['announce'] == {'job': 'j1', 'capacity': 4}
+    # Tampering / key mismatch is rejected, not believed.
+    assert control_plane.parse_heartbeat(msg[:-1] + b'x',
+                                         auth_key=key) is None
+    assert control_plane.parse_heartbeat(msg, auth_key=b'wrong') is None
+    # JSON dialect (lookup tier) parses into the SAME shape.
+    body = json.dumps({'server_id': 'abc', 'name': 'lk-0', 'lease_s': 3.0,
+                       'state': 'serving', 'rpc': 'tcp://h:1',
+                       'job': 'j2', 'capacity': 8}).encode()
+    hb = control_plane.parse_heartbeat(control_plane.CTRL_HB_JSON + body)
+    assert hb['name'] == 'lk-0' and hb['announce']['job'] == 'j2'
+    assert hb['announce']['capacity'] == 8
+    # Garbage is None, never a raise (the registry folds raw PUB bytes).
+    assert control_plane.parse_heartbeat(b'PST_HBx') is None
+    assert control_plane.parse_heartbeat(b'') is None
+
+
+def test_admission_ledger_and_drain_state():
+    ledger = control_plane.AdmissionLedger(lease_s=1.0)
+    with ledger.lock:
+        assert not ledger.known_locked('c1')
+        ledger.admit_locked('c1', now=100.0, credits=4, tenant='a')
+        ledger.admit_locked('c2', now=100.5)
+        assert ledger.count_locked() == 2
+        ledger.renew_locked('c1', now=102.0)
+        # c2 silent past 3 leases -> pruned WITH its entry (the owner
+        # refunds credits / releases tenant slots from it); c1 renewed
+        # -> kept.
+        expired = ledger.prune_locked(now=103.6)
+        assert [cid for cid, _ in expired] == ['c2']
+        assert ledger.count_locked() == 1
+        entry = ledger.release_locked('c1')
+        assert entry['credits'] == 4 and entry['tenant'] == 'a'
+        assert ledger.release_locked('c1') is None   # idempotent
+    drain = control_plane.DrainState()
+    assert drain.state() == 'serving'
+    assert drain.request() is True      # first caller runs drain hooks
+    assert drain.request() is False
+    assert drain.state() == 'draining' and drain.is_draining
+    drain.mark_drained()
+    assert drain.state() == 'drained' and drain.is_drained
+    refusal = control_plane.refusal(
+        b'x' * 16, control_plane.REFUSED_OVERLOADED, 'serving',
+        reason=control_plane.REASON_TENANT_OVER_BUDGET, tenant='a')
+    assert refusal['refused'] == 'overloaded'
+    assert refusal['reason'] == 'tenant-over-budget'
+    assert refusal['tenant'] == 'a'
+
+
+# ---------------------------------------------------------------------------
+# membership registry (unit: fed parsed heartbeats)
+# ---------------------------------------------------------------------------
+
+def test_registry_join_drain_leave_and_expiry():
+    t0 = time.monotonic()
+    reg = FleetRegistry()
+    reg.note_heartbeat(_hb('w1', job='j'), now=t0)
+    reg.note_heartbeat(_hb('w2', job='j', capacity=4), now=t0 + 0.5)
+    assert reg.jobs() == ['j']
+    assert reg.worker_count('j') == 2
+    assert [m['key'] for m in reg.members('j')] == ['w1', 'w2']
+    assert reg.members('j')[1]['capacity'] == 4
+    # Heartbeats without a job are ignored (bare pre-fleet servers)...
+    assert reg.note_heartbeat(_hb('w3'), now=t0 + 0.6) is None
+    # ...unless the registry was built with a default job bucket.
+    reg_dflt = FleetRegistry(default_job='dflt')
+    assert reg_dflt.note_heartbeat(_hb('w3'), now=t0)['job'] == 'dflt'
+    # A drained member leaves IMMEDIATELY (drain-first scale-down must
+    # not hold its slot for three leases).
+    reg.note_heartbeat(_hb('w2', job='j', state='drained'), now=t0 + 1.0)
+    assert [m['key'] for m in reg.members('j')] == ['w1']
+    # Silence past 3 leases ages the member out like a crashed consumer.
+    reg.expire(now=t0 + 4.7)
+    assert reg.members('j') == []
+    # Restart story: a fresh registry rebuilds from the next beat round —
+    # membership IS the heartbeat stream, there is no store to lose.
+    reborn = FleetRegistry()
+    reborn.note_heartbeat(_hb('w1', job='j'), now=t0 + 5.0)
+    assert reborn.worker_count('j') == 1
+
+
+def test_registry_warm_peer_and_worker_count_states():
+    t0 = time.monotonic()
+    reg = FleetRegistry()
+    reg.note_heartbeat(_hb('old', job='j', lease_s=60.0), now=t0)
+    reg.note_heartbeat(_hb('mid', job='j', lease_s=60.0), now=t0 + 0.1)
+    reg.note_heartbeat(_hb('new', job='j', lease_s=60.0,
+                           state='awaiting-cursor'), now=t0 + 0.2)
+    # A replacement awaiting its cursor still counts toward fleet size...
+    assert reg.worker_count('j') == 3
+    # ...but a draining member does not (it is already on its way out
+    # and must not suppress a needed scale-up).
+    reg.note_heartbeat(_hb('mid', job='j', lease_s=60.0,
+                           state='draining'), now=t0 + 0.3)
+    assert reg.worker_count('j') == 2
+    # Warm peer = longest-serving healthy member, excludable (a joiner
+    # must not warm from itself), never a draining/warming one.
+    assert reg.pick_warm_peer('j')['key'] == 'old'
+    assert reg.pick_warm_peer('j', exclude=('old',)) is None
+
+
+def test_registry_blackhole_drops_heartbeats(monkeypatch):
+    t0 = time.monotonic()
+    reg = FleetRegistry()
+    reg.note_heartbeat(_hb('w1', job='j'), now=t0)
+    monkeypatch.setenv('PETASTORM_TPU_FAULTS', 'registry-blackhole')
+    # Every beat is dropped at ingest: the member record freezes...
+    assert reg.note_heartbeat(_hb('w1', job='j'), now=t0 + 1.0) is None
+    assert reg.note_heartbeat(_hb('w9', job='j'), now=t0 + 1.0) is None
+    assert reg.worker_count('j') == 1
+    # ...and ages out on lease silence exactly like a crashed worker.
+    reg.expire(now=t0 + 4.0)
+    assert reg.members('j') == []
+    monkeypatch.delenv('PETASTORM_TPU_FAULTS')
+    # Recovery = the next heartbeat round; no state to repair.
+    reg.note_heartbeat(_hb('w1', job='j'), now=t0 + 5.0)
+    assert reg.worker_count('j') == 1
+
+
+def test_registry_watches_live_server_heartbeats(fleet_dataset):
+    """Integration: a real DataServer with a job id announces itself on
+    its control PUB stream; the registry's watch thread folds it in, a
+    stock consumer still speaks the extended wire, and the server's
+    drain is observed as an immediate leave."""
+    kwargs = dict(DET_KW, num_epochs=None)
+    with serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*', lease_s=0.5,
+                       job_id='live-job', **kwargs) as server:
+        with FleetRegistry() as reg:
+            reg.watch([server.control_endpoint])
+            assert reg.wait_for_member('live-job', timeout_s=20.0), \
+                'first heartbeat never reached the registry'
+            (member,) = reg.members('live-job')
+            assert member['key'] == server._server_id.hex()
+            assert member['rpc'] == server.rpc_endpoint
+            assert member['state'] in ('serving', 'awaiting-cursor')
+            # Wire compat: the announce-extended heartbeat stream still
+            # serves a plain consumer on the same endpoints.
+            with RemoteReader(server.data_endpoint, shared_stream=True,
+                              end_grace_s=1.0) as remote:
+                chunk = next(remote)
+                assert np.asarray(chunk.id).size > 0
+            # Drain-first leave: consumer-less endless stream — drain
+            # abandons the parked chunk, and the registry drops the
+            # member the moment it reports drained.
+            assert server.drain(timeout_s=10.0)
+            deadline = time.monotonic() + 15
+            while reg.worker_count('live-job') > 0:
+                assert time.monotonic() < deadline, \
+                    'drained worker never left membership'
+                time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# tenant isolation
+# ---------------------------------------------------------------------------
+
+def test_tenant_caps_isolate_noisy_from_quiet():
+    from petastorm_tpu import metrics as metrics_mod
+    refusals = metrics_mod.counter(
+        'pst_fleet_tenant_refusals_total', '',
+        labelnames=('tenant', 'reason'))
+    noisy_before = refusals.labels('noisy', 'tenant-over-budget').value
+    with TenantLedger(quotas={'noisy': {'max_consumers': 1}},
+                      membudget_pool=None) as ledger:
+        assert ledger.admit('noisy', 'n1') is None
+        refusal = ledger.admit('noisy', 'n2')
+        assert refusal['refused'] == 'overloaded'
+        assert refusal['reason'] == 'tenant-over-budget'
+        # The quiet tenant's attaches keep landing: isolation, not a
+        # global overload.
+        assert ledger.admit('quiet', 'q1') is None
+        assert ledger.admit('quiet', 'q2') is None
+        # Releasing the noisy slot re-opens it.
+        ledger.release('noisy', 'n1')
+        assert ledger.admit('noisy', 'n3') is None
+    assert refusals.labels('noisy', 'tenant-over-budget').value \
+        == noisy_before + 1
+
+
+def test_tenant_credit_partition_clamps_initial_grants():
+    with TenantLedger(quotas={'a': {'credits': 6}},
+                      membudget_pool=None) as ledger:
+        assert ledger.clamp_credits('a', 4) == 4
+        assert ledger.clamp_credits('a', 4) == 2    # partition exhausted
+        assert ledger.clamp_credits('a', 4) == 0
+        # Uncapped tenants pass through untouched.
+        assert ledger.clamp_credits('b', 64) == 64
+        ledger.release('a', 'c1', credits=4)
+        assert ledger.clamp_credits('a', 4) == 4
+        snap = ledger.snapshot()
+        assert snap['a']['credits'] == 6
+        assert snap['a']['quota']['credits'] == 6
+
+
+def test_tenant_mem_budget_sheds_heaviest_first():
+    with TenantLedger(quotas={'heavy': {'mem_budget': '1k'},
+                              'light': {'mem_budget': 4096}},
+                      membudget_pool=None) as ledger:
+        ledger.charge('heavy', 2048)
+        ledger.charge('light', 128)
+        # Over its own sub-pool: the heavy tenant's NEXT attach refuses.
+        refusal = ledger.admit('heavy', 'h1')
+        assert refusal['reason'] == 'tenant-over-budget'
+        assert ledger.admit('light', 'l1') is None
+        # Governor shed rung: the HEAVIEST tenant is shed, not everyone.
+        ledger._set_mem_shed(True)
+        snap = ledger.snapshot()
+        assert snap['heavy']['shed'] and not snap['light']['shed']
+        assert ledger.admit('light', 'l2') is None
+        ledger._set_mem_shed(False)
+        ledger.discharge('heavy', 2048)
+        assert ledger.admit('heavy', 'h2') is None
+
+
+def test_server_enforces_tenant_quota_end_to_end(fleet_dataset):
+    """A noisy tenant at its per-tenant consumer cap is refused with the
+    typed tenant-over-budget reason (riding the `overloaded` kind, so
+    stock clients fail over unchanged) while the quiet tenant's attach
+    lands on the SAME server."""
+    from petastorm_tpu.errors import ServerOverloaded
+
+    tenants = TenantLedger(quotas={'noisy': {'max_consumers': 1}},
+                           membudget_pool=None)
+    kwargs = dict(DET_KW, num_epochs=None)
+    with serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*',
+                       tenants=tenants, job_id='tenant-job',
+                       **kwargs) as server:
+        with RemoteReader(server.data_endpoint, shared_stream=True,
+                          end_grace_s=1.0, tenant='noisy') as admitted:
+            deadline = time.monotonic() + 30
+            while admitted.diagnostics['attach'].get(
+                    admitted._rpc_endpoints[0]) != 'attached':
+                assert time.monotonic() < deadline, 'attach never landed'
+                time.sleep(0.05)
+            second = RemoteReader(server.data_endpoint, tenant='noisy')
+            with pytest.raises(ServerOverloaded) as exc_info:
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    next(second)
+                raise AssertionError('tenant refusal never surfaced')
+            assert exc_info.value.reason == 'overloaded'
+            second.join()
+            # Same server, different tenant: admitted fine.
+            with RemoteReader(server.data_endpoint, shared_stream=True,
+                              end_grace_s=1.0, tenant='quiet') as quiet:
+                next(quiet)
+            # The per-tenant books ride the `fleet` rpc verb.
+            reply = admitted._one_shot_rpc(admitted._rpc_endpoints[0],
+                                           {'cmd': 'fleet'})
+            assert reply['job'] == 'tenant-job'
+            assert reply['tenants']['noisy']['consumers'] >= 1
+    tenants.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler (unit: fake launcher, registry fed synthetically)
+# ---------------------------------------------------------------------------
+
+class _FakeLauncher(WorkerLauncher):
+    """In-process launcher: 'workers' are registry records. ``join=False``
+    simulates a spawn that dies before its first heartbeat."""
+
+    def __init__(self, registry, job, join=True):
+        self.registry, self.job, self.join = registry, job, join
+        self.launched, self.drained, self.terminated = [], [], []
+
+    def launch(self, index):
+        key = 'fw{}'.format(index)
+        self.launched.append(key)
+        if self.join:
+            self.registry.note_heartbeat(
+                _hb(key, job=self.job, lease_s=60.0))
+        return {'key': key}
+
+    def drain(self, handle, timeout_s):
+        self.drained.append(handle['key'])
+        self.registry.note_heartbeat(
+            _hb(handle['key'], job=self.job, state='drained'))
+        return True
+
+    def terminate(self, handle):
+        self.terminated.append(handle['key'])
+
+    def alive(self, handle):
+        return handle['key'] not in self.terminated
+
+
+def _bottleneck(cls, pipeline='p0'):
+    return {'pst_autotune_bottleneck': {
+        'type': 'gauge',
+        'samples': [{'labels': {'pipeline': pipeline, 'class': cls},
+                     'value': 1}]}}
+
+
+def _served(total):
+    return {'pst_data_service_chunks_served_total': {
+        'type': 'counter', 'samples': [{'labels': {}, 'value': total}]}}
+
+
+def test_autoscaler_min_floor_then_hysteresis_up():
+    reg = FleetRegistry()
+    launcher = _FakeLauncher(reg, 'j')
+    signal_box = {'agg': _bottleneck('balanced')}
+    scaler = FleetAutoscaler(
+        'j', reg, launcher,
+        metrics_fn=lambda: {'aggregate': signal_box['agg']},
+        policy=ScalePolicy(min_workers=1, max_workers=3, hysteresis=2,
+                           cooldown_ticks=1, spawn_grace_s=2.0))
+    # Empty fleet: below min is a deficit, scaled up with NO hysteresis.
+    decision = scaler.tick(now=0.0)
+    assert decision['action'] == 'up' and decision['ok']
+    assert reg.worker_count('j') == 1
+    # input-bound must repeat `hysteresis` ticks before acting, and the
+    # post-action cooldown holds one further tick each time.
+    signal_box['agg'] = dict(_bottleneck('input-bound'), **_served(0))
+    assert scaler.tick(now=1.0) is None     # streak 1 < hysteresis
+    decision = scaler.tick(now=2.0)         # streak 2 -> act
+    assert decision['action'] == 'up' and reg.worker_count('j') == 2
+    assert scaler.tick(now=3.0) is None     # cooldown
+    decision = scaler.tick(now=4.0)
+    assert decision['action'] == 'up' and reg.worker_count('j') == 3
+    assert scaler.tick(now=5.0) is None     # cooldown
+    # At max_workers the up direction is parked, not queued.
+    assert scaler.tick(now=6.0) is None
+    assert scaler.tick(now=7.0) is None
+    assert reg.worker_count('j') == 3
+
+
+def test_autoscaler_drains_newest_and_reverts_on_rate_collapse():
+    reg = FleetRegistry()
+    launcher = _FakeLauncher(reg, 'j')
+    signal_box = {'agg': dict(_bottleneck('consumer-bound'),
+                              **_served(0))}
+    scaler = FleetAutoscaler(
+        'j', reg, launcher,
+        metrics_fn=lambda: {'aggregate': signal_box['agg']},
+        policy=ScalePolicy(min_workers=1, max_workers=3, hysteresis=2,
+                           cooldown_ticks=1, throughput_tolerance=0.5,
+                           spawn_grace_s=2.0))
+    # Imperative fill (bypasses hysteresis) so the loop holds handles
+    # for both workers.
+    assert scaler.scale_to(2) == 2
+    assert launcher.launched == ['fw1', 'fw2']
+    assert scaler.tick(now=0.0) is None             # streak 1, rate primed
+    signal_box['agg'] = dict(_bottleneck('consumer-bound'),
+                             **_served(100))
+    decision = scaler.tick(now=10.0)                # streak 2 -> drain
+    assert decision['action'] == 'down' and decision['ok']
+    # Drain-first, newest member first out: fw1 keeps the warm cache.
+    assert launcher.drained == ['fw2']
+    assert launcher.terminated == ['fw2']
+    assert [m['key'] for m in reg.members('j')] == ['fw1']
+    # Served rate collapsed past tolerance inside the settling window:
+    # the scale-down is REVERTED (the AutoTuner's throughput-revert
+    # discipline) instead of waiting out another hysteresis streak.
+    signal_box['agg'] = dict(_bottleneck('consumer-bound'),
+                             **_served(110))
+    decision = scaler.tick(now=20.0)
+    assert decision['action'] == 'revert-up' and decision['ok']
+    assert reg.worker_count('j') == 2
+    assert launcher.launched == ['fw1', 'fw2', 'fw3']
+
+
+def test_autoscaler_reaps_spawn_that_never_joins():
+    from petastorm_tpu import metrics as metrics_mod
+    actions = metrics_mod.counter('pst_fleet_scale_actions_total', '',
+                                  labelnames=('job', 'action'))
+    failed_before = actions.labels('jx', 'up-failed').value
+    reg = FleetRegistry()
+    launcher = _FakeLauncher(reg, 'jx', join=False)
+    scaler = FleetAutoscaler(
+        'jx', reg, launcher, metrics_fn=None,
+        policy=ScalePolicy(min_workers=1, max_workers=2,
+                           spawn_grace_s=0.2))
+    decision = scaler.tick(now=0.0)
+    # The spawn produced no heartbeat within the grace: reaped, counted
+    # as a FAILED action, never counted as a member.
+    assert decision['action'] == 'up' and decision['ok'] is False
+    assert launcher.terminated == launcher.launched
+    assert reg.worker_count('jx') == 0
+    assert actions.labels('jx', 'up-failed').value == failed_before + 1
+
+
+def test_scale_policy_reads_fleet_env(monkeypatch):
+    monkeypatch.setenv('PETASTORM_TPU_FLEET_MIN_WORKERS', '2')
+    monkeypatch.setenv('PETASTORM_TPU_FLEET_MAX_WORKERS', '7')
+    monkeypatch.setenv('PETASTORM_TPU_FLEET_INTERVAL_S', '0.5')
+    policy = ScalePolicy()
+    assert policy.min_workers == 2 and policy.max_workers == 7
+    assert policy.interval_s == 0.5
+    # Constructor args win over env; max is clamped to min.
+    assert ScalePolicy(min_workers=4, max_workers=1).max_workers == 4
+
+
+# ---------------------------------------------------------------------------
+# mixed-fleet admission failover (satellite)
+# ---------------------------------------------------------------------------
+
+def test_mixed_fleet_failover_lands_on_healthy_without_stealing(
+        fleet_dataset):
+    """One draining, one over-capacity, one healthy: the client is
+    refused by the first two, excludes them, and consumes the healthy
+    server's FULL stream — exactly ROWS rows, sole-consumer accounting
+    intact, so provably no chunk was stolen from (or lost to) a refused
+    endpoint."""
+    # Neither refused server can have produced a chunk when the client
+    # connects, so a stolen chunk is structurally impossible rather
+    # than just racy-unlikely: a PUSH socket with no peers buffers
+    # nothing, so the drained server's abandoned chunk never left it,
+    # and await_cursor defers the over-capacity server's reader build
+    # entirely.
+    draining = serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*',
+                             **DET_KW)
+    over_cap = serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*',
+                             await_cursor=True, max_consumers=0,
+                             **DET_KW)
+    healthy = serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*', **DET_KW)
+    try:
+        # Idle-drain: no admitted consumer, so the parked chunk is
+        # abandoned and the drain completes instead of wedging.
+        assert draining.drain(timeout_s=10.0)
+        # shared_stream: excluded endpoints are treated as failed over,
+        # so the stream can END with the healthy survivor's accounting
+        # (a refused await_cursor server never sends an END marker).
+        remote = RemoteReader(
+            [draining.data_endpoint, over_cap.data_endpoint,
+             healthy.data_endpoint], shared_stream=True,
+            end_grace_s=2.0)
+        with remote:
+            ids = [np.asarray(chunk.id).tolist() for chunk in remote]
+        rows = sorted(i for chunk in ids for i in chunk)
+        assert rows == list(range(ROWS))
+        attach = remote.diagnostics['attach']
+        assert attach[remote._rpc_endpoints[0]] == 'excluded'
+        assert attach[remote._rpc_endpoints[1]] == 'excluded'
+        assert attach[remote._rpc_endpoints[2]] == 'attached'
+        assert draining.served_chunks == 0
+        assert over_cap.served_chunks == 0
+    finally:
+        for server in (draining, over_cap, healthy):
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet CLI
+# ---------------------------------------------------------------------------
+
+def _fleet_cli_argv(url, job):
+    return [sys.executable, '-m', 'petastorm_tpu.tools.fleet', '--worker',
+            url, '--job', job, '--bind', 'tcp://127.0.0.1:*',
+            '--epochs', '0', '--lease-s', '0.5', '--workers', '1',
+            '--drain-grace', '0.5']
+
+
+def _cli_env(faults=None):
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env['PYTHONPATH'] = repo_root + os.pathsep + env.get('PYTHONPATH', '')
+    env['JAX_PLATFORMS'] = 'cpu'
+    env.pop('PETASTORM_TPU_FAULTS', None)
+    if faults:
+        env['PETASTORM_TPU_FAULTS'] = faults
+    return env
+
+
+@pytest.mark.processpool
+def test_fleet_worker_cli_announces_joins_and_drains_on_sigterm(
+        fleet_dataset):
+    proc = subprocess.Popen(_fleet_cli_argv(fleet_dataset, 'cli-job'),
+                            stdout=subprocess.PIPE, text=True,
+                            env=_cli_env())
+    try:
+        line = proc.stdout.readline()
+        assert line, 'fleet worker died before announcing itself'
+        announce = json.loads(line)
+        assert announce['job'] == 'cli-job'
+        assert announce['server_id'] and announce['rpc_endpoint']
+        with FleetRegistry() as reg:
+            reg.watch([announce['control_endpoint']])
+            assert reg.wait_for_member('cli-job',
+                                       key=announce['server_id'],
+                                       timeout_s=30.0)
+        # --status: one JSON line of membership + tenant SLO aggregate.
+        import io
+        from contextlib import redirect_stdout
+
+        from petastorm_tpu.tools import fleet as fleet_cli
+        out = io.StringIO()
+        with redirect_stdout(out):
+            rc = fleet_cli.main(['--status', '--rpc',
+                                 announce['rpc_endpoint']])
+        assert rc == 0
+        status = json.loads(out.getvalue().strip())
+        assert status['unreachable'] == []
+        member = status['members'][announce['rpc_endpoint']]
+        assert member['job'] == 'cli-job'
+        assert member['server_id'] == announce['server_id']
+        assert 'tenant_slo' in status
+        # FIRST SIGTERM = graceful drain of an endless, consumer-less
+        # stream: must exit 0 with state 'drained' (the launcher's
+        # zero-loss judgement), not wedge in the HWM send retry.
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+        final = json.loads(proc.stdout.read().strip().splitlines()[-1])
+        assert final['state'] == 'drained'
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+        proc.stdout.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: 2-tenant fleet, 1 -> 3 -> 1, kill + blackhole,
+# zero loss, deterministic tenant bit-identical
+# ---------------------------------------------------------------------------
+
+class _WatchingLauncher(SubprocessLauncher):
+    """SubprocessLauncher that also points the registry at each new
+    worker's control endpoint (the wiring a real orchestrator owns) and
+    keeps the zero-loss book: which workers left by an ACKNOWLEDGED
+    drain."""
+
+    def __init__(self, argv_fn, registry, **kwargs):
+        super(_WatchingLauncher, self).__init__(argv_fn, **kwargs)
+        self._registry = registry
+        self.drained_ok = []
+
+    def launch(self, index):
+        handle = super(_WatchingLauncher, self).launch(index)
+        self._registry.watch([handle['info']['control_endpoint']])
+        return handle
+
+    def drain(self, handle, timeout_s):
+        ok = super(_WatchingLauncher, self).drain(handle, timeout_s)
+        if ok:
+            self.drained_ok.append(handle['key'])
+        return ok
+
+
+def _ledger_run(remote, ledger_dir):
+    from petastorm_tpu.jax_loader import JaxLoader
+    os.makedirs(str(ledger_dir), exist_ok=True)
+    rows = 0
+    with JaxLoader(remote, ROWS_PER_GROUP, last_batch='drop', prefetch=2,
+                   autotune=False, lineage=str(ledger_dir)) as loader:
+        for batch_out in loader:
+            rows += int(np.asarray(batch_out.id).shape[0])
+    return rows
+
+
+@pytest.mark.chaos
+@pytest.mark.processpool
+@pytest.mark.lineage
+def test_chaos_two_tenant_fleet_scales_1_3_1_zero_loss(
+        fleet_dataset, tmp_path, monkeypatch):
+    """ACCEPTANCE: a two-tenant fleet scales 1 -> 3 -> 1 under load with
+    one SIGKILL mid-scale-up (``fleet-worker-kill``) and one registry
+    blackhole mid-drain (``registry-blackhole``); zero chunks are lost
+    (served == delivered per tenant), the deterministic tenant's stream
+    is bit-identical to an unscaled run (``replay --diff-ledgers`` exit
+    0), and the noisy tenant's overload never refuses the quiet one."""
+    from petastorm_tpu import metrics as metrics_mod
+    from petastorm_tpu.errors import ServerOverloaded
+    from petastorm_tpu.tools import replay as replay_cli
+
+    refusals = metrics_mod.counter(
+        'pst_fleet_tenant_refusals_total', '',
+        labelnames=('tenant', 'reason'))
+    det_refused_before = refusals.labels(
+        'det', 'tenant-over-budget').value
+
+    # ---- reference: the deterministic tenant against an UNSCALED fleet.
+    with serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*',
+                       **DET_KW) as ref_server:
+        with RemoteReader(ref_server.data_endpoint,
+                          tenant='det') as ref_remote:
+            ref_rows = _ledger_run(ref_remote, tmp_path / 'ref')
+    assert ref_rows == ROWS
+
+    # ---- the fleet under chaos. worker0 hosts the deterministic
+    # tenant (its SOLE consumer — sole-consumer accounting raises on
+    # any shortfall); worker1 hosts the noisy tenant behind a
+    # 1-consumer quota. Spawned fleet members stream the same dataset
+    # endlessly and leave drain-first.
+    det_tenants = TenantLedger(quotas={'det': {}}, membudget_pool=None)
+    noisy_tenants = TenantLedger(quotas={'noisy': {'max_consumers': 1}},
+                                 membudget_pool=None)
+    worker0 = serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*',
+                            job_id='chaos', tenants=det_tenants,
+                            **DET_KW)
+    worker1 = serve_dataset(fleet_dataset, 'tcp://127.0.0.1:*',
+                            tenants=noisy_tenants, **DET_KW)
+    registry = FleetRegistry()
+    registry.watch([worker0.control_endpoint])
+    kill_token = str(tmp_path / 'kill-one-spawn.token')
+    launcher = _WatchingLauncher(
+        lambda index: _fleet_cli_argv(fleet_dataset, 'chaos'),
+        registry, announce_timeout_s=60.0,
+        env=_cli_env(
+            faults='fleet-worker-kill:token={}'.format(kill_token)))
+    scaler = FleetAutoscaler(
+        'chaos', registry, launcher, metrics_fn=None,
+        policy=ScalePolicy(min_workers=1, max_workers=3,
+                           spawn_grace_s=10.0, drain_timeout_s=60.0))
+    consumed = {}
+
+    def _consume_det():
+        with RemoteReader(worker0.data_endpoint,
+                          tenant='det') as remote:
+            consumed['det'] = _ledger_run(remote, tmp_path / 'fleet')
+
+    det_thread = threading.Thread(target=_consume_det,
+                                  name='det-tenant-consumer')
+    try:
+        assert registry.wait_for_member('chaos', min_count=1,
+                                        timeout_s=20.0)
+        det_thread.start()       # the fleet scales UNDER this load
+        # Scale 1 -> 3. The kill token SIGKILLs exactly ONE spawn right
+        # after its announce: that launch attempt dies (reaped on
+        # spawn-grace or lease expiry) and the loop retries with a
+        # fresh spawn — membership still reaches 3 live workers.
+        deadline = time.monotonic() + 180
+        while True:
+            scaler._reap_dead()
+            count = registry.worker_count('chaos')
+            with scaler._lock:
+                live_handles = len(scaler._handles)
+            if count == 3 and live_handles == 2:
+                break
+            assert time.monotonic() < deadline, \
+                'fleet never reached 3 live workers (count={}, ' \
+                'handles={})'.format(count, live_handles)
+            if count < 3:
+                scaler._act('up', count, detail='chaos scale-up')
+            else:
+                time.sleep(0.2)     # a killed spawn is aging out
+        assert os.path.exists(kill_token), \
+            'fleet-worker-kill never fired — the drill did not run'
+        # Mid-drain blackhole: the registry goes blind while one worker
+        # drains. Drain completion is an orchestrator<->worker exchange
+        # (SIGTERM -> exit code), NOT registry state, so the drain still
+        # completes with zero loss.
+        monkeypatch.setenv('PETASTORM_TPU_FAULTS', 'registry-blackhole')
+        decision = scaler._act('down',
+                               observed=registry.worker_count('chaos'))
+        assert decision['ok'], 'drain-first scale-down failed under ' \
+                               'registry blackhole: {}'.format(decision)
+        assert len(launcher.drained_ok) == 1
+        monkeypatch.delenv('PETASTORM_TPU_FAULTS')
+        # Blackhole over: membership reconverges from the next heartbeat
+        # round — no state to repair, survivors just keep beating.
+        deadline = time.monotonic() + 60
+        while registry.worker_count('chaos') != 2:
+            assert time.monotonic() < deadline, \
+                'membership never reconverged after the blackhole ' \
+                '(count={})'.format(registry.worker_count('chaos'))
+            time.sleep(0.1)
+        # Scale back to 1: drain-first release of the remaining spawn;
+        # worker0 — oldest, warmest — is never a victim.
+        scaler.drain_all()
+        assert len(launcher.drained_ok) == 2
+        deadline = time.monotonic() + 60
+        while registry.worker_count('chaos') != 1:
+            assert time.monotonic() < deadline, \
+                'fleet never shrank back to 1'
+            time.sleep(0.1)
+        assert [m['key'] for m in registry.members('chaos')] \
+            == [worker0._server_id.hex()]
+        # Noisy tenant, meanwhile: its one admitted consumer takes the
+        # FULL stream (zero loss for the noisy tenant too), and with
+        # that slot held a second noisy consumer is refused
+        # tenant-over-budget — without ever touching the det tenant.
+        noisy_before = refusals.labels(
+            'noisy', 'tenant-over-budget').value
+        with RemoteReader(worker1.data_endpoint,
+                          tenant='noisy') as noisy:
+            noisy_rows = sum(
+                int(np.asarray(chunk.id).size) for chunk in noisy)
+            assert noisy_rows == ROWS
+            refused = RemoteReader(worker1.data_endpoint, tenant='noisy')
+            with pytest.raises(ServerOverloaded):
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    next(refused)
+                raise AssertionError('noisy refusal never surfaced')
+            refused.join()
+        assert refusals.labels('noisy', 'tenant-over-budget').value \
+            > noisy_before
+        assert worker1.served_chunks == ROWS // ROWS_PER_GROUP
+        # The deterministic tenant's stream rode through the whole
+        # scale dance untouched: full delivery, served == delivered.
+        det_thread.join(timeout=120)
+        assert not det_thread.is_alive(), 'det tenant consumer wedged'
+        assert consumed['det'] == ROWS
+        assert worker0.served_chunks == ROWS // ROWS_PER_GROUP
+        # The noisy tenant's overload never refused the quiet tenant.
+        assert refusals.labels('det', 'tenant-over-budget').value \
+            == det_refused_before
+    finally:
+        monkeypatch.delenv('PETASTORM_TPU_FAULTS', raising=False)
+        det_thread.join(timeout=10)
+        scaler.stop()
+        scaler.drain_all()
+        registry.stop()
+        worker0.stop()
+        worker1.stop()
+        det_tenants.close()
+        noisy_tenants.close()
+
+    # ---- bit-identical: the scaled fleet's deterministic stream diffs
+    # clean against the unscaled reference, ledger against ledger.
+    rc = replay_cli.main(['--diff-ledgers', str(tmp_path / 'ref'),
+                          str(tmp_path / 'fleet')])
+    assert rc == 0
