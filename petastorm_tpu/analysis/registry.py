@@ -49,11 +49,14 @@ class ThreadGuard(object):
 
 
 class DirGuard(object):
-    def __init__(self, patterns, owner, rationale, marker=None):
-        # glob patterns relative to tempfile.gettempdir()
+    def __init__(self, patterns, owner, rationale, marker=None, base=None):
+        # glob patterns relative to tempfile.gettempdir(), or to ``base``
+        # when the guarded resource lives elsewhere (e.g. /dev/shm for
+        # the wire's POSIX shm segments)
         self.patterns = tuple(patterns)
         self.owner = owner
         self.marker = marker
+        self.base = base
         self.rationale = rationale
 
     def __repr__(self):
@@ -152,6 +155,13 @@ THREAD_GUARDS = (
         'workers for a fleet whose test is over.',
         marker='fleet', action='fail'),
     ThreadGuard(
+        'pst-wire', 'petastorm_tpu.fleet.wire',
+        'The negotiated data-plane wire is deliberately thread-free '
+        '(encode/decode run on the owning server/consumer threads; acks '
+        'ride the existing client control thread); this guard catches a '
+        'future threaded helper outliving its reader.',
+        marker='wire', action='fail'),
+    ThreadGuard(
         'pst-pool-worker', 'petastorm_tpu.workers.thread_pool',
         'Daemon pool workers joined by ThreadPool.join(); retirement '
         'between items is the resize contract, tested in '
@@ -181,6 +191,14 @@ DIR_GUARDS = (
         'Trace sidecar dirs, bare sidecar files from PETASTORM_TPU_'
         'TRACE_DIR pointed at the tempdir, and flight-recorder dump '
         'dirs.', marker='observability'),
+    DirGuard(
+        ('pst-wire-*',), 'petastorm_tpu.fleet.wire',
+        'Per-consumer shm segment rings of the negotiated data-plane '
+        'wire live under /dev/shm, not the tempdir. Servers unlink them '
+        'on release/stop and sweep stale ones (boot-id + pid liveness) '
+        'at start; the guard deletes what a test leaked anyway so one '
+        'SIGKILL drill cannot strand 64MB segments on the CI host.',
+        marker='wire', base='/dev/shm'),
     DirGuard(
         ('pst-bench-probe-*',), 'bench',
         'Opportunistic-prober flock files (bench._probe_lock_path) live '

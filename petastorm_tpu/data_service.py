@@ -93,6 +93,7 @@ import threading
 import time
 
 from petastorm_tpu.fleet import control_plane
+from petastorm_tpu.fleet import wire as wire_mod
 from petastorm_tpu.utils import cached_namedtuple
 
 logger = logging.getLogger(__name__)
@@ -247,7 +248,8 @@ class DataServer(object):
                  snapshot_every=16, snapshot_resume=None,
                  replay_ring_chunks=None, bind_retry_policy=None,
                  lineage=True, lease_s=None, max_consumers=None,
-                 reader_builder=None, job_id=None, tenants=None):
+                 reader_builder=None, job_id=None, tenants=None,
+                 wire=None):
         import zmq
 
         if (reader is None) == (reader_builder is None):
@@ -325,6 +327,11 @@ class DataServer(object):
         self._rpc_thread = None
         self._stop = threading.Event()
         self._serving_done = threading.Event()
+        # Wakes the control loop out of its heartbeat sleep the moment
+        # the serve thread posts the END marker — consumers otherwise
+        # learn the stream ended only at the next heartbeat tick (up to
+        # 250ms), a fixed tail every epoch pays.
+        self._ctrl_wake = threading.Event()
         # Checkpoint pause handshake: the (single) rpc thread sets _pause
         # and bumps _pause_gen; the serve loop parks at its next chunk
         # boundary and acknowledges by copying the generation into
@@ -374,6 +381,21 @@ class DataServer(object):
             self._ring.extend(self._replay)
         else:
             self._server_id = uuid.uuid4().bytes
+        # -- negotiated data-plane wire (fleet.wire) ---------------------
+        # Transport tier per consumer session: shm segment rings for a
+        # co-located sole consumer, Arrow IPC for remote ones, legacy
+        # pickle for mixed-version fleets. Snapshot mode pins the fleet
+        # to pickle — the replay ring stores raw frames and re-sends
+        # them untagged, and a replayed shm descriptor would point into
+        # regions freed (or unlinked) across the crash.
+        # A SIGKILLed predecessor cannot unlink its segments; collect
+        # them before creating our own (boot-id + pid liveness).
+        wire_mod.sweep_stale_segments()
+        self._wire = wire_mod.ServerWire(
+            self._server_id,
+            allow_shm=snapshot_path is None,
+            force=wire_mod.TRANSPORT_PICKLE if snapshot_path is not None
+            else wire)
         # -- fleet control plane: lease, drain, admission, flow control --
         # Composed from petastorm_tpu.fleet.control_plane — the shared
         # implementation the lookup tier runs too.
@@ -532,10 +554,21 @@ class DataServer(object):
                         if chunk_det is not None:
                             sidecar['det'] = chunk_det
                         payload['__pst_lineage__'] = sidecar
-                frames = _dump_frames(payload)
                 seq = self._served_chunks
+                # The wire tier of THIS chunk: the best tier every
+                # currently-admitted session can decode (the tier is a
+                # session property on the admission entries; the PUSH
+                # socket fair-queues, so per-chunk tags — not per-
+                # consumer formats — keep a mixed/renegotiating fleet
+                # decodable mid-stream).
+                with self._admission_lock:
+                    tiers = list(control_plane.session_transports_locked(
+                        self._admission).values())
+                transport = self._wire.effective_transport(tiers)
+                tag, frames = self._wire.encode(
+                    seq, payload, transport, _dump_frames)
                 self._ring.append((seq, frames))
-                if not self._send_chunk(seq, frames, count=True):
+                if not self._send_chunk(seq, frames, count=True, tag=tag):
                     # Stopped (or idle-drained) mid-HWM-retry: the reader
                     # has advanced past this chunk but `sent` has not — a
                     # snapshot or final cursor here would be one chunk
@@ -584,6 +617,7 @@ class DataServer(object):
             # not race on one zmq socket) and declare the stream done.
             self._end_marker = marker
             self._serving_done.set()
+            self._ctrl_wake.set()
             if self._ctrl_thread is None:
                 # Direct serve_forever() call (no start(), so no control
                 # thread): broadcast inline until stopped. PUB drops
@@ -610,13 +644,17 @@ class DataServer(object):
                     return
             time.sleep(0.02)
 
-    def _send_chunk(self, seq, frames, count):
+    def _send_chunk(self, seq, frames, count, tag=None):
         """HWM-respecting send of ``[meta, header, buf...]``; returns False
         only when stopped mid-retry. The meta frame carries (server_id,
-        seq) — and, under ``auth_key``, a mac over the meta prefix, the
-        pickle header, and every payload buffer, so consumers authenticate
-        the whole chunk before unpickling."""
+        seq) — plus, for non-legacy wire tiers, a one-byte transport tag
+        (legacy pickle chunks stay byte-identical to the pre-wire format
+        so old consumers keep decoding them) — and, under ``auth_key``, a
+        mac over the meta prefix, the header, and every payload buffer,
+        so consumers authenticate the whole chunk before decoding."""
         meta = _META_STRUCT.pack(self._server_id, seq)
+        if tag is not None:
+            meta += tag
         if self._auth_key is not None:
             # MAC the WHOLE chunk (meta prefix + header + every payload
             # buffer): header-only coverage would let a peer replay a
@@ -716,7 +754,12 @@ class DataServer(object):
 
     def _refund_entry_locked(self, cid, entry):
         """Post-release accounting for one ledger entry: refund its
-        credit grant and free its tenant slot."""
+        credit grant, free its tenant slot, and tear down its wire
+        session (close + unlink any shm segment ring — a crashed
+        consumer's unacked regions must not pin ring space forever; the
+        remaining sessions' common tier is recomputed per chunk, so the
+        send path downgrades on its own)."""
+        self._wire.release_consumer(cid)
         credits = entry.get('credits') or 0
         if self._credit is not None and not self._credit_disabled:
             self._credit += credits
@@ -769,8 +812,16 @@ class DataServer(object):
                 # between chunks that will never come.
                 if self._pause.is_set():
                     self._paused_gen = self._pause_gen
-            self._stop.wait(0.05 if marker is not None
-                            else min(hb_interval, 0.25))
+            self._ctrl_wake.clear()
+            if self._stop.is_set():
+                break   # clear() must not eat stop()'s wake-up
+            if self._end_marker is None:
+                # Sleep until the next heartbeat is due — or until the
+                # serve thread posts END (_ctrl_wake), so the last-chunk
+                # -> END latency is a socket send, not a heartbeat tick.
+                self._ctrl_wake.wait(min(hb_interval, 0.25))
+            else:
+                self._stop.wait(0.05)
 
     def _announce_payload(self):
         """Fleet-membership announce riding the heartbeat tail: job id +
@@ -904,13 +955,23 @@ class DataServer(object):
                         return tenant_refusal
                     credits = self._tenants.clamp_credits(tenant, credits)
                 if known:
-                    self._admission.renew_locked(consumer, now)
+                    entry = self._admission.renew_locked(consumer, now)
                 else:
-                    self._admission.admit_locked(consumer, now,
-                                                 credits=credits,
-                                                 tenant=tenant)
+                    entry = self._admission.admit_locked(consumer, now,
+                                                         credits=credits,
+                                                         tenant=tenant)
                     if credits and not self._credit_disabled:
                         self._credit = (self._credit or 0) + credits
+                # Wire-tier negotiation (fleet.wire): the transport is a
+                # property of the consumer session, recorded on its
+                # admission entry — the serve loop reads the session
+                # tiers to pick each chunk's common tier. Renewals
+                # renegotiate: a second consumer joining demotes a
+                # sole-consumer shm grant on the next lease beat.
+                caps = request.get('wire')
+                wire_grant = self._wire.negotiate(
+                    consumer, caps, self._admission.count_locked() == 1)
+                entry['wire'] = wire_grant['transport']
                 # The aggregate gate is sound only while EVERY admitted
                 # consumer grants credits: a credit-blind consumer's pulls
                 # consume credit nobody grants back, so a mixed ledger —
@@ -932,13 +993,28 @@ class DataServer(object):
                 resume = 'cursor'
             if self._reader_builder is not None:
                 self._cursor_evt.set()
-            return {'server_id': self._server_id, 'state': self.state,
-                    'lease_s': self._lease_s, 'sent': self._served_chunks,
-                    'resume': resume, 'tenant': tenant,
-                    'credits': credits}
+            reply = {'server_id': self._server_id, 'state': self.state,
+                     'lease_s': self._lease_s, 'sent': self._served_chunks,
+                     'resume': resume, 'tenant': tenant,
+                     'credits': credits}
+            if caps is not None:
+                # Only negotiating consumers get the wire reply — its
+                # absence is how a new client detects a pre-wire server
+                # (and treats the endpoint as pickle).
+                reply['wire'] = wire_grant
+            return reply
         if cmd == 'detach':
             with self._admission_lock:
                 self._release_consumer_locked(request.get('consumer'))
+            return {'ok': True}
+        if cmd == 'wire_ack':
+            # Batched shm-region releases from the consumer's control
+            # loop (the flow-control analogue for ring space): each seq's
+            # region is marked free, the ring tail advances over the
+            # oldest contiguous freed run, and the serve loop's next shm
+            # placement finds room again.
+            self._wire.ack(request.get('consumer'),
+                           request.get('seqs') or ())
             return {'ok': True}
         if cmd == 'credit':
             with self._admission_lock:
@@ -1004,7 +1080,11 @@ class DataServer(object):
             with self._admission_lock:
                 n_consumers = self._admission.count_locked()
                 credit = self._credit if not self._credit_disabled else None
+                wire_sessions = control_plane.session_transports_locked(
+                    self._admission)
             return {'server_id': self._server_id,
+                    'wire': wire_sessions,
+                    'wire_segments': self._wire.segments(),
                     'sent': self._served_chunks,
                     'done': self._serving_done.is_set(),
                     'state': self.state,
@@ -1104,7 +1184,12 @@ class DataServer(object):
 
     def stop(self):
         self._mem_handle.close()
+        # Close + unlink the wire segment rings (and the wire-shm
+        # governor pool). Crash paths never reach this — that's what the
+        # start-time stale-segment sweep is for.
+        self._wire.close()
         self._stop.set()
+        self._ctrl_wake.set()   # control loop may be mid-heartbeat sleep
         # Stop the reader FIRST: it unblocks a serve thread parked inside
         # the reader's __next__. zmq sockets are not thread-safe, so they
         # may only be closed once the serve/rpc/control threads have
@@ -1151,7 +1236,7 @@ def serve_dataset(dataset_url, bind, reader_factory=None, start=True,
                   snapshot_every=16, snapshot_resume=None,
                   replay_ring_chunks=None, lineage=True, lease_s=None,
                   max_consumers=None, await_cursor=False, job_id=None,
-                  tenants=None, **reader_kwargs):
+                  tenants=None, wire=None, **reader_kwargs):
     """Convenience: build a tensor reader over ``dataset_url`` and serve it.
 
     Returns the started :class:`DataServer` (context-manage it). Extra
@@ -1199,7 +1284,7 @@ def serve_dataset(dataset_url, bind, reader_factory=None, start=True,
                          replay_ring_chunks=replay_ring_chunks,
                          lineage=lineage, lease_s=lease_s,
                          max_consumers=max_consumers, job_id=job_id,
-                         tenants=tenants)
+                         tenants=tenants, wire=wire)
     if await_cursor:
         def _builder(resume_state=None):
             kwargs = dict(reader_kwargs)
@@ -1302,6 +1387,11 @@ class RemoteReader(object):
         short jittered backoff — one dropped REP must not mark a healthy
         server dead; only a server that misses the whole budget counts as
         unreachable.
+    :param wire: force a data-plane transport tier (``'shm'``,
+        ``'arrow-ipc'``, ``'pickle'``; default: negotiate the best the
+        server grants — shm for a co-located sole consumer, Arrow IPC
+        otherwise, pickle against pre-wire servers). See
+        :mod:`petastorm_tpu.fleet.wire` and ``PETASTORM_TPU_WIRE``.
     """
 
     batched_output = True
@@ -1313,7 +1403,7 @@ class RemoteReader(object):
                  rcvhwm=4, poll_timeout_s=0.1, shared_stream=False,
                  end_grace_s=5.0, resume_state=None, auth_key=None,
                  rpc_retry_policy=None, admission=True, flow_control=None,
-                 reconnect_s=None, consumer_id=None, tenant=None):
+                 reconnect_s=None, consumer_id=None, tenant=None, wire=None):
         import zmq
 
         if isinstance(endpoints, str):
@@ -1433,6 +1523,16 @@ class RemoteReader(object):
         self._draining_eps = set()  # rpc endpoints heartbeating 'draining'
         self._reconnect_deadline = {}  # rpc ep -> give-up time (sole mode)
         self._reconnect_announce = set()  # rpc eps owed a reconnect metric
+        # -- negotiated data-plane wire (fleet.wire) ---------------------
+        # Capabilities advertised on every attach (same-host fingerprint,
+        # shm/arrow support — truncated by a forced tier); the server's
+        # grant per endpoint lands in _endpoint_wire (under _acct_lock).
+        # A pre-wire server's attach reply has no 'wire' key: recorded as
+        # the pickle tier, which its untagged frames already are.
+        self._wire_caps = wire_mod.client_capabilities(force=wire)
+        self._endpoint_wire = {}    # rpc ep -> grant dict from attach
+        self._wire_client = None    # lazily built on the first shm chunk
+        self._wire_decode_errors = 0    # CRC/segment failures (chunk dropped)
         self._breakers = {}         # rpc endpoint -> retry.CircuitBreaker
         self._breaker_threshold = 3     # whole-budget misses before open
         self._breaker_reset_s = 15.0    # open -> half-open cooldown
@@ -1712,6 +1812,11 @@ class RemoteReader(object):
                 self._hb_recv.beat('idle')   # stream over: quiet != stalled
             self._data_sock.close(linger=0)
             self._ctrl_sock.close(linger=0)
+            if self._wire_client is not None:
+                # Unmap the shm segments (tolerates live trainer views —
+                # those keep their pages until collected; the server
+                # unlinks the files regardless).
+                self._wire_client.close()
 
     def _recv_chunk_nowait(self):
         """One data chunk as ``(server_id, seq, cols)``, or None. Caller
@@ -1719,7 +1824,14 @@ class RemoteReader(object):
         one step via :meth:`_track` (the snapshot logic treats ``_chunks
         == sent`` as "every counted chunk is in _unacked/_pending or
         consumed"). Frames failing authentication or with a malformed
-        meta frame are dropped without touching pickle."""
+        meta frame are dropped without touching pickle.
+
+        The meta frame's length discriminates the wire tier: exactly
+        ``(server_id, seq)`` [+ mac] is a legacy pickle-p5 chunk; one
+        extra byte between them is the transport tag (Arrow IPC or shm
+        descriptor — :mod:`petastorm_tpu.fleet.wire`). Tiers can change
+        per chunk mid-stream (renegotiation, per-chunk server-side
+        fallback), so the tag is authoritative over the attach grant."""
         while not self._closed:
             try:
                 frames = self._data_sock.recv_multipart(
@@ -1736,18 +1848,60 @@ class RemoteReader(object):
                 continue
             meta = frames[0]
             meta = bytes(meta.buffer if hasattr(meta, 'buffer') else meta)
-            if len(meta) != want:
+            if len(meta) == want:
+                tag = None
+            elif len(meta) == want + 1:
+                tag = meta[_META_STRUCT.size:_META_STRUCT.size + 1]
+            else:
                 self._bad_auth_frames += 1
                 continue
             if self._auth_key is not None:
                 bufs = [f.buffer if hasattr(f, 'buffer') else f
                         for f in frames[1:]]
+                # The mac covers the whole meta prefix INCLUDING the tag
+                # byte: a peer must not be able to re-tag a valid chunk
+                # and steer the decoder onto a different (attacker-shaped)
+                # payload interpretation.
                 if not _mac_ok(self._auth_key, meta[-_MAC_LEN:],
-                               meta[:_META_STRUCT.size], *bufs):
+                               meta[:-_MAC_LEN], *bufs):
                     self._bad_auth_frames += 1
                     continue
             sid, seq = _META_STRUCT.unpack_from(meta)
-            return sid, seq, _load_frames(frames[1:])
+            if tag is None:
+                return sid, seq, _load_frames(frames[1:])
+            cols = self._decode_tagged(tag, frames[1:])
+            if cols is None:
+                continue    # decode failure counted; replay/accounting
+            return sid, seq, cols   # catches a genuinely lost chunk
+        return None
+
+    def _decode_tagged(self, tag, frames):
+        """Decode a non-legacy chunk (Arrow IPC bytes, or a shm ring
+        descriptor mapped into zero-copy views). ``None`` = undecodable —
+        the chunk is DROPPED, not fatal: a descriptor can legitimately
+        outlive its segment across a server crash (frames queued in zmq
+        while the restart unlinked the ring), and the restarted server's
+        replay ring redelivers; a sole consumer's exact end-of-stream
+        accounting catches any chunk nothing redelivered. Corruption
+        (CRC mismatch) takes the same path — counted, never delivered."""
+        try:
+            payload = frames[0]     # tagged chunks: one payload frame
+            payload = (payload.buffer if hasattr(payload, 'buffer')
+                       else payload)
+            if tag == wire_mod.TAG_ARROW:
+                return wire_mod.decode_arrow(payload)
+            if tag == wire_mod.TAG_SHM:
+                if self._wire_client is None:
+                    self._wire_client = wire_mod.WireClient()
+                return self._wire_client.decode_chunk(payload)
+            logger.warning('unknown wire transport tag %r — dropping chunk '
+                           '(mixed-version fleet newer than this consumer?)',
+                           tag)
+        except Exception:  # noqa: BLE001 - drop + count, never kill the pump
+            logger.warning('wire chunk decode failed (tag %r) — dropping',
+                           tag, exc_info=True)
+        with self._acct_lock:
+            self._wire_decode_errors += 1
         return None
 
     def _track(self, sid, seq):
@@ -2106,13 +2260,24 @@ class RemoteReader(object):
         return payload
 
     def _rpc_loads(self, raw):
+        """Parse one rpc reply; EVERY malformed frame — failed mac,
+        truncated/garbled pickle, stray bytes from an alien process on a
+        reused port — surfaces as the same typed ``RuntimeError`` refusal
+        instead of whatever the decoder tripped over (``EOFError``,
+        ``UnpicklingError``, a struct ``ValueError``...). Callers key
+        retry/breaker behavior on the exception type, so a malformed
+        reply must look like a refusal, not an internal bug."""
         if self._auth_key is not None:
             if (len(raw) < _MAC_LEN or
                     not _mac_ok(self._auth_key, raw[-_MAC_LEN:],
                                 raw[:-_MAC_LEN])):
-                raise RuntimeError('unauthenticated rpc reply')
+                raise RuntimeError('unauthenticated rpc reply refused')
             raw = raw[:-_MAC_LEN]
-        return pickle.loads(raw)
+        try:
+            return pickle.loads(raw)
+        except Exception as e:  # noqa: BLE001 - typed refusal for them all
+            raise RuntimeError('malformed rpc reply refused ({}: {})'.format(
+                type(e).__name__, e))
 
     def _rpc_attempt(self, endpoint, request, timeout_ms):
         """One REQ/REP round-trip on a fresh socket (REQ state machines
@@ -2267,6 +2432,7 @@ class RemoteReader(object):
                 if self._stopped or self._closed:
                     break
             self._flush_credits()
+            self._flush_wire_acks()
             time.sleep(0.25)
         # Best-effort detach: free the admission slot promptly instead of
         # letting it age out of the server's ledger.
@@ -2286,7 +2452,8 @@ class RemoteReader(object):
         (None when unreachable) and updates the attach ledger."""
         if cursor is _MISSING:
             cursor = self.det_cursor(endpoint)
-        request = {'cmd': 'attach', 'consumer': self._consumer_id}
+        request = {'cmd': 'attach', 'consumer': self._consumer_id,
+                   'wire': self._wire_caps}
         if self._tenant is not None:
             request['tenant'] = self._tenant
         if self._flow_control:
@@ -2325,6 +2492,12 @@ class RemoteReader(object):
                 st['status'] = 'attached'
                 st['last_renew'] = now
                 st['lease_s'] = reply.get('lease_s')
+                # Wire grant for this session (renegotiated every renew:
+                # a second consumer attaching demotes shm to arrow on the
+                # next lease beat). No 'wire' key = pre-wire server.
+                self._endpoint_wire[endpoint] = (
+                    reply.get('wire')
+                    or {'transport': wire_mod.TRANSPORT_PICKLE})
                 self._admission_refused.pop(endpoint, None)
                 sid = reply.get('server_id')
                 if sid is not None:
@@ -2382,6 +2555,44 @@ class RemoteReader(object):
                 # loosens by one batch rather than tightening forever.)
                 with self._acct_lock:
                     self._credit_owed[sid] = self._credit_owed.get(sid, 0) + n
+
+    def _flush_wire_acks(self):
+        """Release consumed shm-tier chunks back to their servers' rings:
+        drain the seqs whose views were finalized since the last tick and
+        batch them into one ``wire_ack`` rpc per endpoint. Segment ->
+        endpoint routing comes from the attach grants; acks for a segment
+        no grant names anymore (the server restarted under a new identity
+        and its ring died with it) are dropped — idempotent, like the
+        server side (``ServerWire.ack`` frees already-freed regions as a
+        no-op)."""
+        wc = self._wire_client
+        if wc is None:
+            return
+        acks = wc.drain_acks()
+        if not acks:
+            return
+        with self._acct_lock:
+            seg_ep = {g['segment']: ep
+                      for ep, g in self._endpoint_wire.items()
+                      if g.get('segment')}
+        for segment, seqs in acks.items():
+            endpoint = seg_ep.get(segment)
+            if endpoint is None:
+                continue
+            delivered = False
+            try:
+                delivered = self._one_shot_rpc(
+                    endpoint, {'cmd': 'wire_ack',
+                               'consumer': self._consumer_id, 'seqs': seqs},
+                    timeout_ms=1500) is not None
+            except Exception:  # noqa: BLE001 - requeued below
+                logger.debug('wire ack flush to %s failed', endpoint,
+                             exc_info=True)
+            if not delivered:
+                # A dropped ack must not pin ring regions on a healthy
+                # server (the ring would fill and every chunk would fall
+                # back to arrow): requeue for the next tick.
+                wc.requeue_acks(segment, seqs)
 
     # -- health supervision (petastorm_tpu.health) -----------------------
 
@@ -2486,10 +2697,20 @@ class RemoteReader(object):
         in exactly once (summing identical snapshots would double every
         counter)."""
         from petastorm_tpu import metrics as metrics_mod
-        return metrics_mod.scrape_fleet_metrics(
+        snap = metrics_mod.scrape_fleet_metrics(
             self._rpc_endpoints,
             lambda ep: self._one_shot_rpc(ep, {'cmd': 'metrics'},
                                           timeout_ms=timeout_ms))
+        # Per-endpoint wire tier mix (from the attach grants): a mixed-
+        # version fleet shows e.g. {'…:5555': 'shm', '…:6555': 'pickle'}
+        # — the operator's signal that some servers predate the
+        # negotiated wire (or refused shm) and are paying serialization.
+        with self._acct_lock:
+            snap['wire'] = {
+                ep: (grant or {}).get('transport',
+                                      wire_mod.TRANSPORT_PICKLE)
+                for ep, grant in self._endpoint_wire.items()}
+        return snap
 
     def _health_probe(self):
         """Watchdog probe: runs only while SOME stage looks stalled (any
@@ -2622,6 +2843,9 @@ class RemoteReader(object):
                       for ep, st in self._attach_state.items()}
             circuit = {ep: b.state for ep, b in self._breakers.items()}
             reconnect_pending = sorted(self._reconnect_deadline)
+            wire_tiers = {ep: (g or {}).get('transport')
+                          for ep, g in self._endpoint_wire.items()}
+            wire_decode_errors = self._wire_decode_errors
         return {'remote_chunks': self._chunks,
                 'servers': self._n_servers,
                 'servers_ended': len(self._ended_server_ids),
@@ -2639,6 +2863,11 @@ class RemoteReader(object):
                 'attach': attach,
                 'circuit_breakers': circuit,
                 'reconnect_pending': reconnect_pending,
+                # Negotiated data-plane tier per endpoint and chunks
+                # dropped undecodable (CRC mismatch, a descriptor that
+                # outlived its segment across a server restart).
+                'wire': wire_tiers,
+                'wire_decode_errors': wire_decode_errors,
                 # Seconds since each server's last chunk: a server gone
                 # silent (SIGKILL, network partition) shows a growing age
                 # here long before the end-of-epoch accounting notices.

@@ -115,6 +115,13 @@ Sites and their effects when they fire:
                      stretching the observe->act window so chaos tests
                      can race membership changes (a kill, a join)
                      against an already-made scaling decision.
+``wire-segment-leak`` make the data-service wire teardown skip unlinking
+                     its ``pst-wire-*`` shm segments (``fleet/wire.py``)
+                     — the SIGKILLed-server leak, minus the SIGKILL:
+                     the orphaned segment must be collected by the next
+                     server start's boot-id + pid liveness sweep, never
+                     by the leaking process. Consumed via
+                     ``should_fire``.
 ==================== ======================================================
 
 Params (all optional):
@@ -176,6 +183,7 @@ KNOWN_SITES = (
     'fleet-worker-kill',
     'registry-blackhole',
     'scale-race',
+    'wire-segment-leak',
 )
 
 #: Sites whose effect is a sleep rather than an error.

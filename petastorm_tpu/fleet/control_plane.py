@@ -27,10 +27,22 @@ what the service actually streams:
   shapes clients already fail over on, plus the tenancy layer's
   ``tenant-over-budget`` reason — new refusal spellings land HERE so
   both planes and all clients keep speaking one vocabulary.
+* **Session transport vocabulary**: the negotiated wire tier
+  (``fleet/wire.py``) is a *property of the consumer session* — it
+  lives on the admission entry (``'wire'`` field), read through
+  :func:`session_transport` / :func:`session_transports_locked` by the
+  data plane's send loop, the lookup tier's session stats, and
+  ``fleet_metrics()`` alike.
+* :class:`PipelineSupervisor`: the Reader-side ventilation/health/
+  tuning control loop, extracted so ``Reader``, ``JaxLoader``, the data
+  service, and the serving tier arm the SAME supervision lifecycle
+  (construct -> attach registry -> start; tuner stops before monitor)
+  instead of each re-growing its own copy.
 
 Keep this module light: stdlib + :mod:`petastorm_tpu.metrics` only.
 Both service planes and the static analyzer import it; it must never
-drag in zmq, jax, or pyarrow.
+drag in zmq, jax, or pyarrow (``PipelineSupervisor`` pulls health/
+autotune/trace lazily at arm time — all stdlib-safe).
 """
 
 import hashlib
@@ -285,6 +297,27 @@ class AdmissionLedger(object):
             return {cid: dict(e) for cid, e in self._entries.items()}
 
 
+#: Legacy/default wire tier: sessions that never negotiated (an old
+#: client, a plane without a data wire) are pickle sessions. Spelled
+#: here — not imported from ``fleet.wire`` — because that module needs
+#: numpy and this one must not.
+DEFAULT_TRANSPORT = 'pickle'
+
+
+def session_transport(entry):
+    """The negotiated data-plane tier recorded on an admission entry
+    (``'wire'`` field); :data:`DEFAULT_TRANSPORT` for legacy sessions."""
+    return (entry or {}).get('wire') or DEFAULT_TRANSPORT
+
+
+def session_transports_locked(ledger):
+    """Granted tier per admitted consumer — the input to the send
+    loop's best-common-tier pick and to the per-session stats surfaces.
+    Caller holds ``ledger.lock``."""
+    return {cid: session_transport(e)
+            for cid, e in ledger.entries_locked().items()}
+
+
 # -- drain state machine ----------------------------------------------------
 
 class DrainState(object):
@@ -327,3 +360,98 @@ class DrainState(object):
         if self.draining.is_set():
             return 'draining'
         return serving
+
+
+# -- pipeline supervision lifecycle -----------------------------------------
+
+class PipelineSupervisor(object):
+    """One lifecycle for the health-watchdog + adaptive-autotuner pair
+    every pipeline tier used to wire up by hand.
+
+    ``Reader`` and ``JaxLoader`` grew near-identical twenty-line blocks
+    (enable-check -> construct -> attach heartbeat registry -> start;
+    mirror block in ``stop()`` with the tuner stopped *before* the
+    monitor so a dying controller never races the watchdog it reports
+    to). This class is that block. Owners keep direct references to
+    :attr:`health` / :attr:`autotuner` for their stats surfaces — the
+    supervisor owns ORDER, not access.
+
+    Arm order matters and is enforced by the call sites: health first
+    (the tuner's ``watchdog_active_fn`` reads the armed monitor), then
+    autotune. ``stop()`` is idempotent and safe half-armed.
+    """
+
+    def __init__(self):
+        self.health = None
+        self.autotuner = None
+
+    def arm_health(self, watchdog, stall_timeouts, on_hard_stall,
+                   tracer=None, attach_fn=None, start=True):
+        """Construct + start the :class:`~petastorm_tpu.health.
+        HealthMonitor` when ``watchdog`` resolves enabled; returns it
+        (or None when off). ``attach_fn(registry)`` runs between
+        construction and start — the hook where owners register their
+        stage heartbeats/probes (Reader.attach_health, the loader's
+        consumer probe), matching the order the hand-rolled blocks
+        used. ``start=False`` defers the watchdog to a later
+        :meth:`start_health` — the loader pattern, where stages built
+        long after arming still register heartbeats and the first
+        classification must see the full beat table."""
+        from petastorm_tpu import health as health_mod
+        if not health_mod.watchdog_enabled(watchdog):
+            return None
+        if tracer is None:
+            from petastorm_tpu.trace import get_global_tracer
+            tracer = get_global_tracer()
+        self.health = health_mod.HealthMonitor(
+            stall_timeouts=stall_timeouts, tracer=tracer,
+            on_hard_stall=on_hard_stall)
+        if attach_fn is not None:
+            attach_fn(self.health.registry)
+        if start:
+            self.health.start()
+        return self.health
+
+    def start_health(self):
+        """Start a monitor armed with ``start=False`` (no-op when
+        health is off)."""
+        if self.health is not None:
+            self.health.start()
+
+    def arm_autotune(self, autotune, knobs_fn, telemetry_fn, classify_fn,
+                     watchdog_active_fn=None, memory_state_fn=None,
+                     tracer=None, listeners=()):
+        """Construct + start the :class:`~petastorm_tpu.autotune.
+        AutoTuner` when ``autotune`` resolves enabled; returns it (or
+        None when off / nothing tunable). ``knobs_fn(cfg)`` builds the
+        knob dict from the resolved config — returning an empty dict
+        keeps the tuner off (a dummy pool has nothing to tune), exactly
+        the guard both hand-rolled blocks carried."""
+        from petastorm_tpu import autotune as autotune_mod
+        if not autotune_mod.autotune_enabled(autotune):
+            return None
+        cfg = autotune_mod.resolve_config(autotune)
+        knobs = knobs_fn(cfg)
+        if not knobs:
+            return None
+        if tracer is None:
+            from petastorm_tpu.trace import get_global_tracer
+            tracer = get_global_tracer()
+        self.autotuner = autotune_mod.AutoTuner(
+            telemetry_fn=telemetry_fn, knobs=knobs, config=cfg,
+            tracer=tracer, classify_fn=classify_fn,
+            watchdog_active_fn=watchdog_active_fn,
+            memory_state_fn=memory_state_fn).start()
+        for listener in listeners:
+            self.autotuner.add_listener(listener)
+        return self.autotuner
+
+    def stop(self):
+        """Tuner first (it drives knobs on stages the monitor watches),
+        monitor second. Idempotent."""
+        tuner, self.autotuner = self.autotuner, None
+        if tuner is not None:
+            tuner.stop()
+        health, self.health = self.health, None
+        if health is not None:
+            health.stop()

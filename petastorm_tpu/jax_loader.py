@@ -1004,33 +1004,37 @@ class JaxLoader(object):
         self._ready = deque()
         self._stop = threading.Event()
         self._exhausted = False
-        # Pipeline health supervisor (petastorm_tpu.health): heartbeats on
-        # every stage + a watchdog that classifies stalls, runs soft
-        # recovery, and escalates to PipelineStallError instead of hanging.
-        from petastorm_tpu import health as health_mod
-        self._health = None
+        # Pipeline health supervisor (petastorm_tpu.health), armed through
+        # the shared control-plane lifecycle: heartbeats on every stage +
+        # a watchdog that classifies stalls, runs soft recovery, and
+        # escalates to PipelineStallError instead of hanging. Deferred
+        # start (start_health below) — staging stages register later.
+        from petastorm_tpu.fleet import control_plane as control_plane_mod
+        self._supervisor = control_plane_mod.PipelineSupervisor()
         self._hb_consumer = None
         self._stall_error = None
-        if health_mod.watchdog_enabled(watchdog):
-            self._health = health_mod.HealthMonitor(
-                stall_timeouts=stall_timeout_s,
-                on_hard_stall=self._deliver_stall, tracer=self._tracer)
-            self._hb_consumer = self._health.registry.register('consumer')
-            self._health.registry.register_probe(
+
+        def attach_stages(registry):
+            self._hb_consumer = registry.register('consumer')
+            registry.register_probe(
                 'consumer', lambda: {'queue_depth': (self._queue.qsize()
                                                      + len(self._ready)),
                                      'queue_capacity': self._prefetch_target,
                                      'exhausted': self._exhausted})
             attach = getattr(reader, 'attach_health', None)
             if attach is not None:
-                attach(self._health.registry)
+                attach(registry)
             # Memory-pressure classification (health.classify_stall): the
             # governor's ladder state rides every diagnosis, and a stall
             # while degradation is active classifies as memory-pressure
             # (soft) instead of blaming a deliberately-shrunk stage.
             from petastorm_tpu import membudget as membudget_mod
-            self._health.registry.register_probe(
+            registry.register_probe(
                 'memory', membudget_mod.get_governor().probe)
+
+        self._health = self._supervisor.arm_health(
+            watchdog, stall_timeout_s, self._deliver_stall,
+            tracer=self._tracer, attach_fn=attach_stages, start=False)
         # Batch provenance (petastorm_tpu.lineage): ring + ledger of what
         # exactly composed every delivered batch. Collector hooks ride the
         # host-batch iterators; records are minted at delivery in __next__.
@@ -1256,8 +1260,7 @@ class JaxLoader(object):
                          if self._lineage is not None else None)).start()
         # The watchdog starts only once every stage had the chance to
         # register, so its first classification sees the full beat table.
-        if self._health is not None:
-            self._health.start()
+        self._supervisor.start_health()
 
         # Host memory governor (petastorm_tpu.membudget): the loader's
         # byte-holding pools register for unified accounting — the arena
@@ -1346,9 +1349,9 @@ class JaxLoader(object):
         # (worker-pool size, ventilation watermark), which the reader hands
         # over via adopt_autotune (stopping any controller of its own).
         from petastorm_tpu import autotune as autotune_mod
-        self._autotuner = None
-        if autotune_mod.autotune_enabled(autotune):
-            cfg = autotune_mod.resolve_config(autotune)
+        self._reader_telemetry = None
+
+        def build_knobs(cfg):
             knobs = {}
             if not self._consumer_staging:
                 knobs['prefetch'] = autotune_mod.Knob(
@@ -1392,32 +1395,32 @@ class JaxLoader(object):
                         self.set_device_stream_min_mb,
                         lo=cfg.min_device_stream_mb,
                         hi=cfg.max_device_stream_mb)
-            self._reader_telemetry = None
             adopt = getattr(reader, 'adopt_autotune', None)
             if adopt is not None:
                 reader_knobs, self._reader_telemetry = adopt(cfg)
                 knobs.update(reader_knobs)
-            if knobs:
-                watchdog_active = None
-                if self._health is not None:
-                    watchdog = self._health.watchdog
-                    watchdog_active = lambda: watchdog.episode_active  # noqa: E731
-                self._autotuner = autotune_mod.AutoTuner(
-                    telemetry_fn=self._autotune_telemetry, knobs=knobs,
-                    config=cfg, tracer=self._tracer,
-                    classify_fn=autotune_mod.classify_loader,
-                    watchdog_active_fn=watchdog_active,
-                    # Advisory rung of the memory ladder: the tuner stops
-                    # growing and steps every knob down instead.
-                    memory_state_fn=governor.pressure_level).start()
-                store = getattr(reader, 'chunk_store', None)
-                if store is not None:
-                    # Epoch-0 spill throttling (the reader's own controller
-                    # was stopped by adopt_autotune above): pause the NVMe
-                    # write-behind whenever the pipeline itself is the
-                    # classified bottleneck.
-                    self._autotuner.add_listener(
-                        autotune_mod.writer_throttle_listener(store))
+            return knobs
+
+        watchdog_active = None
+        if self._health is not None:
+            watchdog_obj = self._health.watchdog
+            watchdog_active = lambda: watchdog_obj.episode_active  # noqa: E731
+        listeners = []
+        store = getattr(reader, 'chunk_store', None)
+        if store is not None:
+            # Epoch-0 spill throttling (the reader's own controller is
+            # stopped by adopt_autotune inside build_knobs): pause the
+            # NVMe write-behind whenever the pipeline itself is the
+            # classified bottleneck.
+            listeners.append(autotune_mod.writer_throttle_listener(store))
+        self._autotuner = self._supervisor.arm_autotune(
+            autotune, build_knobs, self._autotune_telemetry,
+            autotune_mod.classify_loader,
+            watchdog_active_fn=watchdog_active,
+            # Advisory rung of the memory ladder: the tuner stops
+            # growing and steps every knob down instead.
+            memory_state_fn=governor.pressure_level,
+            tracer=self._tracer, listeners=listeners)
 
     # -- autotune hookups --------------------------------------------------
 
@@ -2263,14 +2266,13 @@ class JaxLoader(object):
         if self._mem_armed:
             self._mem_armed = False
             governor.release()
-        if self._autotuner is not None:
-            # First: a tuner firing mid-teardown would retune stages that
-            # are being joined.
-            self._autotuner.stop()
-        if self._health is not None:
-            # A supervisor firing mid-teardown would misread the
-            # (deliberately) silent stages as a stall.
-            self._health.stop()
+        # Tuner first (a tuner firing mid-teardown would retune stages
+        # that are being joined), then the watchdog (which would misread
+        # the deliberately silent stages as a stall) — the order the
+        # shared supervisor owns.
+        # _health/_autotuner stay referenced: stats() remains readable
+        # post-stop (post-mortems read stats['watchdog'] after teardown).
+        self._supervisor.stop()
         self._stop.set()
         self._exhausted = True
         # Drain so the staging threads' bounded puts can exit.

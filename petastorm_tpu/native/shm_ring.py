@@ -6,10 +6,35 @@ One SPSC ring per direction per worker. Blocking calls release the GIL
 
 import ctypes
 import logging
+import os
 
 from petastorm_tpu.native.build import NativeBuildError, build_and_load
 
 logger = logging.getLogger(__name__)
+
+#: Where POSIX shared-memory objects surface as plain files (Linux
+#: tmpfs). Shared by the process-pool rings here and the fleet wire's
+#: ``pst-wire-*`` segment rings (``fleet/wire.py``) so segment listing,
+#: liveness sweeps, and diagnostics all look at one directory.
+SHM_DIR = '/dev/shm'
+
+
+def shm_dir():
+    """The shm mount, or None when the host has none — callers (the wire
+    transport's shm tier, stale-segment sweeps) degrade gracefully."""
+    return SHM_DIR if os.path.isdir(SHM_DIR) else None
+
+
+def list_segments(prefix, base_dir=None):
+    """Names of shm segments starting with ``prefix`` (e.g. the wire
+    transport's ``pst-wire-``), sorted, for sweeps and tests."""
+    d = base_dir or shm_dir()
+    if d is None:
+        return []
+    try:
+        return sorted(n for n in os.listdir(d) if n.startswith(prefix))
+    except OSError:
+        return []
 
 RING_OK = 0
 RING_ERR_SYS = -1

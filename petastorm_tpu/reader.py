@@ -918,61 +918,49 @@ class Reader(object):
                 self._pool_workers_count())
             self._workers_pool.decode_share = self._decode_share
 
-        # --- pipeline health supervision (petastorm_tpu.health) ------------
-        # A standalone reader owns its monitor; a wrapping JaxLoader calls
-        # attach_health(registry) instead so ONE watchdog supervises the
-        # whole pipeline (its registry wins — we then skip our own).
-        from petastorm_tpu import health as health_mod
-        self._health = None
+        # --- pipeline supervision (fleet.control_plane) ---------------------
+        # Health watchdog + adaptive autotuner, armed through the shared
+        # PipelineSupervisor lifecycle. A standalone reader owns its
+        # monitor/controller; a wrapping JaxLoader calls
+        # attach_health(registry) / adopt_autotune() instead so ONE
+        # watchdog and ONE controller supervise the whole pipeline.
+        from petastorm_tpu import autotune as autotune_mod
+        from petastorm_tpu.fleet import control_plane
+        self._supervisor = control_plane.PipelineSupervisor()
+        self._health = None     # before arm: attach_health reads it
         self._health_registry = None
         self._hb_handoff = None
         self._stall_error = None
-        if health_mod.watchdog_enabled(watchdog):
-            from petastorm_tpu.trace import get_global_tracer
-
-            def deliver(error):
-                # Raised at the next __next__ entry; additionally injected
-                # straight into a thread pool's results queue (its
-                # get_results blocks unboundedly, so entry-time checks
-                # alone would never fire), and substituted for the process
-                # pools' bounded get_results timeout when that pops.
-                self._stall_error = error
-                inject = getattr(self._workers_pool,
-                                 'inject_consumer_error', None)
-                if inject is not None:
-                    inject(error)
-
-            self._health = health_mod.HealthMonitor(
-                stall_timeouts=stall_timeout_s, tracer=get_global_tracer(),
-                on_hard_stall=deliver)
-            self.attach_health(self._health.registry)
-            self._health.start()
-
-        # --- adaptive autotuning (petastorm_tpu.autotune) -------------------
-        # A standalone reader owns its controller; a wrapping JaxLoader
-        # calls adopt_autotune() instead so ONE controller (which also sees
-        # the staging-side telemetry) tunes the whole pipeline.
-        from petastorm_tpu import autotune as autotune_mod
         self._rows_delivered = 0
-        self._autotuner = None
-        if autotune_mod.autotune_enabled(autotune):
-            from petastorm_tpu.trace import get_global_tracer
-            cfg = autotune_mod.resolve_config(autotune)
-            knobs = self._autotune_knobs(cfg)
-            if knobs:   # nothing tunable (e.g. dummy pool): stay off
-                self._autotuner = autotune_mod.AutoTuner(
-                    telemetry_fn=self._autotune_telemetry, knobs=knobs,
-                    config=cfg, tracer=get_global_tracer(),
-                    classify_fn=autotune_mod.classify_reader,
-                    watchdog_active_fn=self._watchdog_episode_active,
-                    memory_state_fn=membudget.get_governor().pressure_level,
-                ).start()
-                if self.chunk_store is not None:
-                    # Epoch-0 spill throttling: pause the store's write-
-                    # behind writer whenever the tuner classifies the
-                    # pipeline itself as the bottleneck.
-                    self._autotuner.add_listener(
-                        autotune_mod.writer_throttle_listener(self.chunk_store))
+
+        def deliver(error):
+            # Raised at the next __next__ entry; additionally injected
+            # straight into a thread pool's results queue (its
+            # get_results blocks unboundedly, so entry-time checks
+            # alone would never fire), and substituted for the process
+            # pools' bounded get_results timeout when that pops.
+            self._stall_error = error
+            inject = getattr(self._workers_pool,
+                             'inject_consumer_error', None)
+            if inject is not None:
+                inject(error)
+
+        self._health = self._supervisor.arm_health(
+            watchdog, stall_timeout_s, deliver,
+            attach_fn=self.attach_health)
+        listeners = []
+        if self.chunk_store is not None:
+            # Epoch-0 spill throttling: pause the store's write-behind
+            # writer whenever the tuner classifies the pipeline itself
+            # as the bottleneck.
+            listeners.append(
+                autotune_mod.writer_throttle_listener(self.chunk_store))
+        self._autotuner = self._supervisor.arm_autotune(
+            autotune, self._autotune_knobs, self._autotune_telemetry,
+            autotune_mod.classify_reader,
+            watchdog_active_fn=self._watchdog_episode_active,
+            memory_state_fn=membudget.get_governor().pressure_level,
+            listeners=listeners)
 
         # --- host memory governor (petastorm_tpu.membudget) -----------------
         # The reader tier's byte-holding pools register for unified
@@ -1136,6 +1124,7 @@ class Reader(object):
         if self._autotuner is not None:
             self._autotuner.stop()
             self._autotuner = None
+            self._supervisor.autotuner = None
         return self._autotune_knobs(cfg), self._autotune_telemetry
 
     def attach_health(self, registry):
@@ -1151,6 +1140,7 @@ class Reader(object):
             # pipeline (ours would see heartbeats nothing beats anymore).
             self._health.stop()
             self._health = None
+            self._supervisor.health = None
         self._health_registry = registry
         self._ventilator.heartbeat = registry.register('ventilator')
         self._hb_handoff = registry.register('reader-handoff')
@@ -1477,18 +1467,17 @@ class Reader(object):
         if self._mem_armed:
             self._mem_armed = False
             governor.release()
-        if self._autotuner is not None:
-            # First: a tuner firing mid-teardown would resize a pool whose
-            # workers are being joined.
-            self._autotuner.stop()
+        # Tuner first (a tuner firing mid-teardown would resize a pool
+        # whose workers are being joined), watchdog second — the order
+        # the supervisor owns. _health/_autotuner stay referenced so
+        # post-stop diagnostics keep their watchdog/autotune sections.
+        self._supervisor.stop()
         if self._decode_share is not None:
             # Stop counting toward the process decode-thread fair share:
             # surviving readers' workers widen to the freed threads on
             # their next decode call.
             self._decode_share.release()
             self._decode_share = None
-        if self._health is not None:
-            self._health.stop()
         self._workers_pool.stop()
         if self.chunk_store is not None:
             # Drain + stop the write-behind thread (don't leave a daemon

@@ -675,9 +675,15 @@ class LookupServer(object):
                     reason=control_plane.REASON_MEMORY_PRESSURE,
                     partition=partition)
             if known:
-                self._admission.renew_locked(consumer, now)
+                entry = self._admission.renew_locked(consumer, now)
             else:
-                self._admission.admit_locked(consumer, now)
+                entry = self._admission.admit_locked(consumer, now)
+            # Transport tier as a session property (shared vocabulary
+            # with the data plane's negotiated wire): lookup replies ride
+            # the rpc plane itself, so every session is the pickle tier —
+            # recorded anyway so fleet tooling reads ONE ledger shape
+            # across data servers and lookup servers.
+            entry.setdefault('wire', control_plane.DEFAULT_TRANSPORT)
         return None
 
     def _handle(self, request):
@@ -753,10 +759,13 @@ class LookupServer(object):
                 n_consumers = self._admission.count_locked()
                 served = self.requests_served
                 pmap = self._pmap
+                wire_sessions = control_plane.session_transports_locked(
+                    self._admission)
             return {'server_id': self._server_id,
                     'name': self.server_name, 'state': self.state,
                     'lease_s': self._lease_s,
                     'consumers': n_consumers,
+                    'wire': wire_sessions,
                     'max_consumers': self._max_consumers,
                     'requests_served': served,
                     'partition_map_version': (None if pmap is None

@@ -271,7 +271,10 @@ def _registry_leak_sweep(request):
     thread_guards = [g for g in THREAD_GUARDS
                      if g.action == 'fail' and applies(g)]
     tmp = tempfile.gettempdir()
-    patterns = [os.path.join(tmp, pat)
+    # A guard may anchor its patterns off the tempdir (base attr — e.g.
+    # /dev/shm for the wire's segment rings); older registry entries
+    # without the attr keep the tempdir default.
+    patterns = [os.path.join(getattr(g, 'base', None) or tmp, pat)
                 for g in DIR_GUARDS if applies(g) for pat in g.patterns]
     before = {p for pat in patterns for p in glob.glob(pat)}
     yield

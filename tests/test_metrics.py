@@ -384,7 +384,12 @@ _NON_METRIC_PST_LITERALS = {'pst_image', 'pst_parquet', 'pst_shm_ring',
                             'pst_det', 'pst_pinned', 'pst_self_accounting',
                             # prefix filter in tools/fleet.py --status, not
                             # an instrument name
-                            'pst_fleet_tenant_'}
+                            'pst_fleet_tenant_',
+                            # Arrow IPC field/schema metadata keys of the
+                            # fleet wire codec (fleet/wire.py), not
+                            # instrument names
+                            'pst_dtype', 'pst_shape', 'pst_object',
+                            'pst_sidecar'}
 
 
 def _source_metric_names():

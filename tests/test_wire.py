@@ -1,0 +1,551 @@
+"""Negotiated data-plane wire (``petastorm_tpu/fleet/wire.py``): the
+attach-time transport grant (shm / arrow-ipc / pickle), the shm segment
+ring and its zero-copy consumer views, per-chunk tier fallback, the
+stale-segment sweep + ``wire-segment-leak`` drill, and the service-level
+behaviors the tiers were built for — mixed-version fleets, bit-identical
+streams across tiers, and mid-stream server restart renegotiation.
+"""
+
+import collections
+import gc
+import os
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.data_service import DataServer, RemoteReader
+from petastorm_tpu.fleet import wire
+from petastorm_tpu.native import shm_ring
+
+pytestmark = pytest.mark.wire
+
+CHUNK_ROWS = 32
+VEC_WIDTH = 48
+
+
+# ---------------------------------------------------------------------------
+# synthetic service fixtures
+# ---------------------------------------------------------------------------
+
+def _make_stream_reader(sids, forever=False):
+    """Minimal batched-reader surface serving deterministic synthetic
+    chunks — one chunk per entry of ``sids`` (a list of row-id bases),
+    so tests can assert exactly which chunks arrived."""
+
+    nt = collections.namedtuple('WireChunk', ['vec', 'sid'])
+
+    class _StreamReader(object):
+        batched_output = True
+        ngram = None
+
+        def __iter__(self):
+            while True:
+                for base in sids:
+                    rng = np.random.default_rng(base)
+                    yield nt(
+                        vec=rng.random((CHUNK_ROWS, VEC_WIDTH)
+                                       ).astype(np.float32),
+                        sid=np.arange(base, base + CHUNK_ROWS,
+                                      dtype=np.int64))
+                if not forever:
+                    return
+
+        def stop(self):
+            pass
+
+        def join(self):
+            pass
+
+        @property
+        def diagnostics(self):
+            return {}
+
+    return _StreamReader()
+
+
+def _serve_attached(reader_obj, tier, **server_kw):
+    """A started DataServer whose serve loop is held until the FIRST
+    consumer attach is admitted — chunks encoded before the wire grant
+    lands would ride the empty-fleet tier (pickle) and pollute what a
+    tier test measures. Returns the server; caller must stop() it."""
+    server = DataServer(reader_obj, 'tcp://127.0.0.1:*', wire=tier,
+                        **server_kw)
+    server._pause.set()
+    server.start()
+    return server
+
+
+def _release_on_attach(server, timeout_s=30):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        with server._admission_lock:
+            if server._admission.count_locked() >= 1:
+                break
+        time.sleep(0.005)
+    server._pause.clear()
+
+
+def _drain_chunks(remote):
+    out = []
+    for chunk in remote:
+        out.append((np.array(chunk.vec, copy=True),
+                    np.array(chunk.sid, copy=True)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# negotiation matrix
+# ---------------------------------------------------------------------------
+
+def test_negotiate_matrix():
+    fp = wire.host_fingerprint()
+    full = {'fingerprint': fp,
+            'transports': [wire.TRANSPORT_SHM, wire.TRANSPORT_ARROW,
+                           wire.TRANSPORT_PICKLE]}
+    # Legacy consumer (no caps dict) -> pickle, always.
+    assert wire.negotiate(fp, None, True) == wire.TRANSPORT_PICKLE
+    assert wire.negotiate(fp, {}, True) == wire.TRANSPORT_PICKLE
+    # Co-located sole consumer advertising everything -> shm.
+    assert wire.negotiate(fp, full, True) == wire.TRANSPORT_SHM
+    # Second admitted consumer -> the per-consumer ring is off the table.
+    assert wire.negotiate(fp, full, False) == wire.TRANSPORT_ARROW
+    # Remote host (fingerprint mismatch) -> arrow.
+    caps_remote = dict(full, fingerprint='other-host-boot-uid')
+    assert wire.negotiate(fp, caps_remote, True) == wire.TRANSPORT_ARROW
+    # Server forbids shm (snapshots on / memory degrade) -> arrow.
+    assert wire.negotiate(fp, full, True,
+                          allow_shm=False) == wire.TRANSPORT_ARROW
+    # Consumer that can only decode pickle -> pickle.
+    caps_old = {'fingerprint': fp, 'transports': [wire.TRANSPORT_PICKLE]}
+    assert wire.negotiate(fp, caps_old, True) == wire.TRANSPORT_PICKLE
+    # Forced floor on the server truncates the grantable order.
+    assert wire.negotiate(fp, full, True,
+                          force=wire.TRANSPORT_ARROW) == wire.TRANSPORT_ARROW
+    assert wire.negotiate(fp, full, True,
+                          force=wire.TRANSPORT_PICKLE) == wire.TRANSPORT_PICKLE
+
+
+def test_negotiate_same_host_without_shm_grants_arrow(monkeypatch):
+    """The acceptance case: co-located sole consumer, but shm is not
+    usable (no writable /dev/shm, native ring missing) — the grant must
+    land on arrow-ipc, not silently pickle."""
+    fp = wire.host_fingerprint()
+    caps = {'fingerprint': fp,
+            'transports': [wire.TRANSPORT_SHM, wire.TRANSPORT_ARROW,
+                           wire.TRANSPORT_PICKLE]}
+    monkeypatch.setattr(wire, 'shm_available', lambda base_dir=None: False)
+    assert wire.negotiate(fp, caps, True) == wire.TRANSPORT_ARROW
+
+
+def test_client_capabilities_forced_tier_truncates():
+    caps = wire.client_capabilities()
+    assert caps['transports'][-1] == wire.TRANSPORT_PICKLE
+    assert caps['fingerprint'] == wire.host_fingerprint()
+    forced = wire.client_capabilities(force=wire.TRANSPORT_PICKLE)
+    assert forced['transports'] == [wire.TRANSPORT_PICKLE]
+    if wire.arrow_available():
+        forced = wire.client_capabilities(force=wire.TRANSPORT_ARROW)
+        assert wire.TRANSPORT_SHM not in forced['transports']
+        assert forced['transports'][0] == wire.TRANSPORT_ARROW
+
+
+def test_common_transport_is_fleet_floor():
+    shm, arrow, pickle_ = (wire.TRANSPORT_SHM, wire.TRANSPORT_ARROW,
+                           wire.TRANSPORT_PICKLE)
+    assert wire.common_transport([]) == pickle_
+    assert wire.common_transport([shm]) == shm
+    assert wire.common_transport([shm, arrow]) == arrow
+    assert wire.common_transport([arrow, pickle_]) == pickle_
+    # Two shm sessions: each ring is per-consumer but the data socket
+    # fair-queues, so shm is only legal for a sole session.
+    assert wire.common_transport([shm, shm]) != shm
+
+
+# ---------------------------------------------------------------------------
+# arrow codec
+# ---------------------------------------------------------------------------
+
+def test_arrow_roundtrip_fixed_width_and_object_bytes():
+    if not wire.arrow_available():
+        pytest.skip('pyarrow unavailable')
+    rng = np.random.default_rng(5)
+    payload = {
+        'vec': rng.random((6, 3, 2)).astype(np.float32),
+        'sid': np.arange(6, dtype=np.int64),
+        'blob': np.array([b'x' * i for i in range(6)], dtype=object),
+    }
+    sidecar = {'endpoint': 'tcp://x:1', 'seg': {'k': 1}}
+    frame = wire.encode_arrow(payload, sidecar)
+    assert frame is not None
+    cols = wire.decode_arrow(frame)
+    assert cols['vec'].dtype == np.float32
+    assert cols['vec'].shape == (6, 3, 2)
+    assert cols['vec'].tobytes() == payload['vec'].tobytes()
+    assert cols['sid'].tobytes() == payload['sid'].tobytes()
+    assert list(cols['blob']) == list(payload['blob'])
+    assert cols['__pst_lineage__'] == sidecar
+
+
+def test_arrow_refuses_unrideable_payloads():
+    if not wire.arrow_available():
+        pytest.skip('pyarrow unavailable')
+    # Non-bytes object column -> None (caller falls back a tier).
+    assert wire.encode_arrow(
+        {'bad': np.array([object(), object()], dtype=object)}) is None
+    # Ragged columns -> None.
+    assert wire.encode_arrow(
+        {'a': np.zeros(3, np.float32), 'b': np.zeros(4, np.float32)}) is None
+
+
+# ---------------------------------------------------------------------------
+# shm segment ring
+# ---------------------------------------------------------------------------
+
+def test_ring_alloc_free_wrap_and_checksum():
+    if not wire.shm_available():
+        pytest.skip('shm unavailable')
+    cap = 1 << 20
+    ring = wire.ShmSegmentRing('pst-wire-test-ring', capacity=cap)
+    try:
+        block = np.arange(40_000, dtype=np.uint8)  # 40KB per chunk
+        placed = []
+        seq = 0
+        while True:
+            fields = ring.place(seq, {'b': block})
+            if fields is None:
+                break   # ring full: the per-chunk tier fallback trigger
+            placed.append((seq, fields))
+            seq += 1
+        assert len(placed) >= cap // block.nbytes - 1
+        # Every placed field verifies against the segment bytes.
+        for s, fields in placed:
+            f = fields[0]
+            view = memoryview(ring._mm)[f['offset']:f['offset'] + block.nbytes]
+            try:
+                assert wire._checksum(view) == f['csum']
+                assert bytes(view) == block.tobytes()
+            finally:
+                view.release()  # an exported view would block ring.close()
+        # Free the oldest half; the ring must wrap and place again.
+        for s, _ in placed[:len(placed) // 2 + 1]:
+            ring.free(s)
+        refill = 0
+        while ring.place(seq, {'b': block}) is not None:
+            refill += 1
+            seq += 1
+        assert refill >= 1, 'freed space must become placeable (wrap path)'
+    finally:
+        ring.free_all()
+        gc.collect()
+        ring.close()
+    assert not os.path.exists(ring.path)
+
+
+def test_checksum_stripe_detects_prefix_contiguous_overwrites():
+    """Large fields are checksummed head+tail stripe only — sufficient
+    because a recycling chunk writes its region from the START, so any
+    overwrite reaching a field's middle has already clobbered its head
+    stripe. Both stripes must participate in the sum."""
+    big = bytearray(os.urandom(3 * wire._CSUM_STRIPE))
+    ref = wire._checksum(memoryview(big))
+    head_hit = bytearray(big)
+    head_hit[0] ^= 0xFF
+    assert wire._checksum(memoryview(head_hit)) != ref
+    tail_hit = bytearray(big)
+    tail_hit[-1] ^= 0xFF
+    assert wire._checksum(memoryview(tail_hit)) != ref
+    # Small fields are covered in full.
+    small = bytearray(os.urandom(100))
+    sref = wire._checksum(memoryview(small))
+    small[50] ^= 0xFF
+    assert wire._checksum(memoryview(small)) != sref
+
+
+def test_wireclient_view_lifecycle_and_acks():
+    """decode_chunk hands out zero-copy views; the ack for a chunk's
+    ring region is queued only when EVERY view (including slices) is
+    dead — a batch sliced out of a chunk keeps the region alive."""
+    if not wire.shm_available():
+        pytest.skip('shm unavailable')
+    import json
+    ring = wire.ShmSegmentRing('pst-wire-test-views', capacity=1 << 20)
+    client = wire.WireClient()
+    try:
+        data = np.arange(600, dtype=np.float32).reshape(20, 30)
+        fields = ring.place(7, {'vec': data})
+        desc = json.dumps({'segment': ring.name, 'seq': 7,
+                           'fields': fields}).encode()
+        cols = client.decode_chunk(desc)
+        view = cols['vec']
+        assert isinstance(view, wire.WireView)
+        assert view.tobytes() == data.tobytes()
+        tail = view[10:]            # slice inherits the region anchor
+        del cols, view
+        gc.collect()
+        assert client.drain_acks() == {}, 'live slice must pin the region'
+        assert tail._pst_wire_region is not None
+        del tail
+        gc.collect()
+        assert client.drain_acks() == {ring.name: [7]}
+        # Checksum mismatch (region recycled under a live descriptor)
+        # must raise, never feed the trainer.
+        fields2 = ring.place(8, {'vec': data})
+        off = fields2[0]['offset']
+        ring._mm[off:off + 4] = b'\xff\xff\xff\xff'
+        desc2 = json.dumps({'segment': ring.name, 'seq': 8,
+                            'fields': fields2}).encode()
+        with pytest.raises(RuntimeError, match='checksum mismatch'):
+            client.decode_chunk(desc2)
+    finally:
+        client.close()
+        gc.collect()
+        ring.free_all()
+        ring.close()
+
+
+def test_wireclient_refuses_foreign_segment_names():
+    client = wire.WireClient()
+    with pytest.raises(ValueError, match='non-wire segment'):
+        client.map_segment('etc/passwd')
+    with pytest.raises(ValueError, match='non-wire segment'):
+        client.map_segment('not-our-prefix')
+
+
+# ---------------------------------------------------------------------------
+# stale-segment sweep + leak drill
+# ---------------------------------------------------------------------------
+
+def _dead_pid():
+    """A pid guaranteed dead: spawn a trivial child and wait for it."""
+    proc = subprocess.Popen([sys.executable, '-c', 'pass'])
+    proc.wait()
+    return proc.pid
+
+
+def test_sweep_stale_segments(tmp_path):
+    d = str(tmp_path)
+    boot = wire._read_boot_id()
+
+    def seg(name, pid, boot_id=boot):
+        path = os.path.join(d, name)
+        with open(path, 'wb') as f:
+            f.write(wire._SEG_HDR.pack(
+                wire._SEG_MAGIC, boot_id.encode('ascii').ljust(36, b'\0'),
+                pid, 4096))
+            f.write(b'\0' * 64)
+        return path
+
+    live = seg('pst-wire-live', os.getpid())
+    dead = seg('pst-wire-dead', _dead_pid())
+    rebooted = seg('pst-wire-reboot', os.getpid(),
+                   boot_id='0' * 36)
+    foreign = os.path.join(d, 'pst-wire-foreign')
+    with open(foreign, 'wb') as f:
+        f.write(b'NOTOURS!' + b'\0' * 80)   # our prefix, not our magic
+    unrelated = os.path.join(d, 'other-file')
+    with open(unrelated, 'wb') as f:
+        f.write(b'x')
+
+    removed = wire.sweep_stale_segments(base_dir=d)
+    assert sorted(removed) == sorted([dead, rebooted])
+    assert os.path.exists(live), 'live owner: never swept'
+    assert os.path.exists(foreign), 'foreign magic: never unlinked'
+    assert os.path.exists(unrelated)
+
+
+def test_wire_segment_leak_drill(monkeypatch, tmp_path):
+    """The ``wire-segment-leak`` fault site: teardown leaves the segment
+    behind (a SIGKILLed server in miniature); the next server start's
+    sweep collects it once the owner pid is dead."""
+    if not wire.shm_available():
+        pytest.skip('shm unavailable')
+    sw = wire.ServerWire(b'leakdrill-serverid')
+    caps = wire.client_capabilities()
+    reply = sw.negotiate('c1', caps, sole_consumer=True)
+    assert reply['transport'] == wire.TRANSPORT_SHM
+    seg_name = reply['segment']
+    seg_path = os.path.join(shm_ring.shm_dir(), seg_name)
+    assert os.path.exists(seg_path)
+    monkeypatch.setenv('PETASTORM_TPU_FAULTS', 'wire-segment-leak:max=1')
+    sw.close()
+    monkeypatch.delenv('PETASTORM_TPU_FAULTS')
+    try:
+        assert os.path.exists(seg_path), 'drill must leave the segment'
+        # Owner (this process) is alive: the sweep must NOT collect it.
+        assert wire.sweep_stale_segments() == []
+        # Rewrite the owner pid to a dead process -> swept.
+        with open(seg_path, 'r+b') as f:
+            hdr = bytearray(f.read(wire._SEG_HDR.size))
+            magic, boot, _pid, cap = wire._SEG_HDR.unpack(bytes(hdr))
+            f.seek(0)
+            f.write(wire._SEG_HDR.pack(magic, boot, _dead_pid(), cap))
+        assert wire.sweep_stale_segments() == [seg_path]
+    finally:
+        if os.path.exists(seg_path):
+            os.unlink(seg_path)
+    assert shm_ring.list_segments(wire.SEGMENT_PREFIX) == []
+
+
+# ---------------------------------------------------------------------------
+# service integration
+# ---------------------------------------------------------------------------
+
+def _drain_tier(tier, sids):
+    server = _serve_attached(_make_stream_reader(sids), tier)
+    try:
+        with RemoteReader(server.data_endpoint) as remote:
+            _release_on_attach(server)
+            chunks = _drain_chunks(remote)
+            grants = dict(remote.fleet_metrics(timeout_ms=2000)['wire'])
+    finally:
+        server.stop()
+    return chunks, grants
+
+
+def test_shm_epoch_bit_identical_to_pickle():
+    """The tentpole's correctness bar: the SAME stream drained over the
+    shm tier is bit-identical to the legacy pickle tier, and the shm
+    pass's per-chunk serialize cost is ~0 (descriptor-only)."""
+    if not wire.shm_available():
+        pytest.skip('shm unavailable')
+    sids = [0, 100, 200, 300, 400, 500]
+    base, grants = _drain_tier(wire.TRANSPORT_PICKLE, sids)
+    assert set(grants.values()) == {wire.TRANSPORT_PICKLE}
+
+    from petastorm_tpu import metrics as metrics_mod
+
+    def _ser():
+        fam = metrics_mod.get_registry().collect().get(
+            'pst_wire_serialize_seconds') or {'samples': []}
+        tot = {'sum': 0.0, 'count': 0}
+        for s in fam['samples']:
+            tot['sum'] += s.get('sum', 0.0)
+            tot['count'] += s.get('count', 0)
+        return tot
+
+    before = _ser()
+    got, grants = _drain_tier(wire.TRANSPORT_SHM, sids)
+    after = _ser()
+    assert set(grants.values()) == {wire.TRANSPORT_SHM}
+    assert len(got) == len(base) == len(sids)
+    for (v1, s1), (v2, s2) in zip(base, got):
+        assert v1.tobytes() == v2.tobytes()
+        assert s1.tobytes() == s2.tobytes()
+    n = after['count'] - before['count']
+    if n:   # descriptor json.dumps only: ~10us, never ms
+        assert (after['sum'] - before['sum']) / n < 1e-3
+    assert shm_ring.list_segments(wire.SEGMENT_PREFIX) == []
+
+
+def test_mixed_version_fleet_tier_mix_in_fleet_metrics():
+    """One shm-granting server + one pickle-only server (an old build in
+    miniature): the consumer decodes both per the per-chunk tags, the
+    union is complete, and fleet_metrics()['wire'] shows the per-endpoint
+    tier mix an operator needs to spot who is paying serialization."""
+    if not wire.shm_available():
+        pytest.skip('shm unavailable')
+    srv_new = _serve_attached(_make_stream_reader([0, 100, 200]), None)
+    srv_old = _serve_attached(_make_stream_reader([1000, 1100]),
+                              wire.TRANSPORT_PICKLE)
+    try:
+        endpoints = [srv_new.data_endpoint, srv_old.data_endpoint]
+        with RemoteReader(endpoints) as remote:
+            _release_on_attach(srv_new)
+            _release_on_attach(srv_old)
+            chunks = _drain_chunks(remote)
+            tier_mix = dict(remote.fleet_metrics(timeout_ms=2000)['wire'])
+    finally:
+        srv_new.stop()
+        srv_old.stop()
+    ids = sorted(int(i) for _, sid in chunks for i in sid)
+    want = sorted(i for base in (0, 100, 200, 1000, 1100)
+                  for i in range(base, base + CHUNK_ROWS))
+    assert ids == want
+    # The mix is keyed by the rpc endpoint the attach grant came over.
+    assert tier_mix[srv_new.rpc_endpoint] == wire.TRANSPORT_SHM
+    assert tier_mix[srv_old.rpc_endpoint] == wire.TRANSPORT_PICKLE
+    assert shm_ring.list_segments(wire.SEGMENT_PREFIX) == []
+
+
+def test_midstream_restart_renegotiates_and_loses_nothing(monkeypatch):
+    """Server A (shm grant) ends; a REPLACEMENT server binds the same
+    endpoints with a pickle-only wire. The consumer re-attaches, the
+    grant for that endpoint renegotiates down, every chunk from both
+    incarnations arrives exactly once, and per-server chunk ordering
+    survives the swap (the resequencer keys on server identity)."""
+    if not wire.shm_available():
+        pytest.skip('shm unavailable')
+    # Grants renegotiate on the lease-renew beat; shrink it so the
+    # demotion is observable without a 10s wait.
+    monkeypatch.setenv('PETASTORM_TPU_LEASE_S', '1.0')
+    a_sids = [0, 100, 200]
+    b_sids = [300, 400]
+    keeper = DataServer(_make_stream_reader([5000], forever=True),
+                        'tcp://127.0.0.1:*', wire=wire.TRANSPORT_PICKLE)
+    keeper.start()
+    srv_a = _serve_attached(_make_stream_reader(a_sids), None)
+    endpoints = (srv_a.data_endpoint, srv_a.control_endpoint,
+                 srv_a.rpc_endpoint)
+    seen = []
+    want = {i for base in a_sids + b_sids
+            for i in range(base, base + CHUNK_ROWS)}
+    srv_b = None
+    try:
+        with RemoteReader([srv_a.data_endpoint, keeper.data_endpoint]) \
+                as remote:
+            _release_on_attach(srv_a)
+            it = iter(remote)
+            tier_a = None
+            deadline = time.monotonic() + 30
+            while tier_a != wire.TRANSPORT_SHM:
+                assert time.monotonic() < deadline, 'no shm grant for A'
+                tier_a = remote.fleet_metrics(
+                    timeout_ms=1000)['wire'].get(srv_a.rpc_endpoint)
+                time.sleep(0.05)
+            a_from_a = set()
+            while len(a_from_a) < len(a_sids) * CHUNK_ROWS:
+                chunk = next(it)
+                ids = [int(i) for i in chunk.sid]
+                seen.extend(ids)
+                if ids[0] < 1000:
+                    a_from_a.update(ids)
+            srv_a.stop()
+            srv_b = DataServer(_make_stream_reader(b_sids), endpoints[0],
+                               control_bind=endpoints[1],
+                               rpc_bind=endpoints[2],
+                               wire=wire.TRANSPORT_PICKLE)
+            srv_b.start()
+            deadline = time.monotonic() + 60
+            while not want.issubset(seen):
+                assert time.monotonic() < deadline, 'restart drain stalled'
+                chunk = next(it)
+                seen.extend(int(i) for i in chunk.sid)
+            tier_after = None
+            deadline = time.monotonic() + 30
+            while tier_after != wire.TRANSPORT_PICKLE:
+                assert time.monotonic() < deadline, (
+                    'replacement grant never renegotiated down, stuck at %r'
+                    % (tier_after,))
+                tier_after = remote.fleet_metrics(
+                    timeout_ms=1000)['wire'].get(endpoints[2])
+                time.sleep(0.1)
+    finally:
+        if srv_b is not None:
+            srv_b.stop()
+        keeper.stop()
+    deliveries = [i for i in seen if i < 1000]
+    assert sorted(deliveries) == sorted(want), (
+        'chunks lost or duplicated across the restart')
+    # Per-incarnation ordering: each server's chunks arrive seq-ordered,
+    # so the sid bases of each incarnation appear in serve order.
+    bases = [i for i in deliveries if i % 100 == 0 and i // 100 < 10]
+    a_bases = [b for b in bases if b in (0, 100, 200)]
+    b_bases = [b for b in bases if b in (300, 400)]
+    assert a_bases == [0, 100, 200]
+    assert b_bases == [300, 400]
+    assert shm_ring.list_segments(wire.SEGMENT_PREFIX) == []
